@@ -1,0 +1,42 @@
+"""stateright_tpu — a TPU-native explicit-state model checker.
+
+A brand-new framework with the capability surface of the reference Rust
+library *stateright* (mounted read-only at /root/reference; see SURVEY.md):
+a ``Model`` abstraction, always/sometimes/eventually properties, parallel
+BFS/DFS/on-demand/simulation checkers with fingerprint dedup and path
+reconstruction, symmetry reduction, an actor framework with pluggable
+network semantics plus a real UDP runtime, linearizability and sequential
+consistency testers, and a web Explorer — with the checker's hot loop
+(successor expansion + frontier dedup + property evaluation) compiled to
+JAX/XLA as a vmapped wavefront over bit-packed states with an HBM-resident
+fingerprint hash set, sharded across chips with collectives.
+"""
+
+from .core.model import Model, Property, Expectation
+from .core.checker import Checker, CheckerBuilder
+from .core.path import Path, NondeterminismError
+from .core.has_discoveries import HasDiscoveries
+from .core.visitor import CheckerVisitor, PathRecorder, StateRecorder
+from .core.report import ReportData, ReportDiscovery, Reporter, WriteReporter
+from .ops.fingerprint import fingerprint
+
+__all__ = [
+    "Model",
+    "Property",
+    "Expectation",
+    "Checker",
+    "CheckerBuilder",
+    "Path",
+    "NondeterminismError",
+    "HasDiscoveries",
+    "CheckerVisitor",
+    "PathRecorder",
+    "StateRecorder",
+    "ReportData",
+    "ReportDiscovery",
+    "Reporter",
+    "WriteReporter",
+    "fingerprint",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,267 @@
+"""Checker surface and fluent builder.
+
+Reference: src/checker.rs — ``CheckerBuilder`` (fluent config + spawn_bfs /
+spawn_dfs / spawn_on_demand / spawn_simulation / serve) and the ``Checker``
+trait (counts, discoveries, join/report, assertion helpers).  This module
+adds ``spawn_tpu`` — the TPU wavefront engine that is the point of this
+framework — as a first-class sibling of the reference spawn methods.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .has_discoveries import HasDiscoveries
+from .model import Expectation, Model
+from .path import Path
+from .report import ReportData, ReportDiscovery, Reporter
+from .visitor import as_visitor
+
+
+class CheckerBuilder:
+    def __init__(self, model: Model):
+        self.model = model
+        self._symmetry = None
+        self._target_state_count: Optional[int] = None
+        self._target_max_depth: Optional[int] = None
+        self._thread_count = 1
+        self._visitor = None
+        self._finish_when: HasDiscoveries = HasDiscoveries.ALL
+        self._timeout: Optional[float] = None
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enable symmetry reduction via the state's ``representative()``
+        method.  Reference: src/checker.rs:222-227."""
+        return self.symmetry_fn(lambda s: s.representative())
+
+    def symmetry_fn(self, representative) -> "CheckerBuilder":
+        self._symmetry = representative
+        return self
+
+    def finish_when(self, has_discoveries: HasDiscoveries) -> "CheckerBuilder":
+        self._finish_when = has_discoveries
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        self._target_state_count = count if count > 0 else None
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        self._target_max_depth = depth if depth > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        if thread_count < 1:
+            raise ValueError("thread_count must be >= 1")
+        self._thread_count = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        self._visitor = as_visitor(visitor)
+        return self
+
+    def timeout(self, seconds: float) -> "CheckerBuilder":
+        self._timeout = seconds
+        return self
+
+    def spawn_bfs(self) -> "Checker":
+        from .engine import GraphChecker
+
+        return GraphChecker(self, dfs=False)
+
+    def spawn_dfs(self) -> "Checker":
+        from .engine import GraphChecker
+
+        return GraphChecker(self, dfs=True)
+
+    def spawn_simulation(self, seed: int, chooser=None) -> "Checker":
+        from .simulation import SimulationChecker, UniformChooser
+
+        return SimulationChecker(self, seed, chooser or UniformChooser())
+
+    def spawn_on_demand(self) -> "Checker":
+        from .on_demand import OnDemandChecker
+
+        return OnDemandChecker(self)
+
+    def spawn_tpu(self, **kwargs) -> "Checker":
+        """Spawn the TPU wavefront checker: successor expansion, frontier
+        dedup, and property evaluation run on-device as a vmapped wavefront
+        BFS (the replacement for the reference's thread-pool hot loop,
+        src/checker/bfs.rs:177-335)."""
+        from ..parallel.wavefront import TpuChecker
+
+        return TpuChecker(self, **kwargs)
+
+    def serve(self, address) -> "Checker":
+        from ..explorer.server import serve
+
+        return serve(self, address)
+
+
+class Checker:
+    """Base checker surface.  Reference: the ``Checker`` trait,
+    src/checker.rs:294-578."""
+
+    def __init__(self, model: Model):
+        self._model = model
+
+    # --- interface implemented by engines -----------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        raise NotImplementedError
+
+    def unique_state_count(self) -> int:
+        raise NotImplementedError
+
+    def max_depth(self) -> int:
+        raise NotImplementedError
+
+    def discoveries(self) -> Dict[str, Path]:
+        raise NotImplementedError
+
+    def handles(self) -> list:
+        return []
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def join(self) -> "Checker":
+        for h in self.handles():
+            h.join()
+        return self
+
+    def check_fingerprint(self, fingerprint: int) -> None:
+        pass  # only meaningful for on-demand checking
+
+    def run_to_completion(self) -> None:
+        pass  # only meaningful for on-demand checking
+
+    # --- shared functionality -----------------------------------------------
+
+    def discovery(self, name: str) -> Optional[Path]:
+        return self.discoveries().get(name)
+
+    def discovery_classification(self, name: str) -> str:
+        prop = self._model.property(name)
+        return "example" if prop.expectation is Expectation.SOMETIMES else "counterexample"
+
+    def _report_data(self, start: float, done: bool) -> ReportData:
+        return ReportData(
+            total_states=self.state_count(),
+            unique_states=self.unique_state_count(),
+            max_depth=self.max_depth(),
+            duration=time.monotonic() - start,
+            done=done,
+        )
+
+    def _report_final(self, reporter: Reporter, start: float) -> None:
+        reporter.report_checking(self._report_data(start, done=True))
+        discoveries = {
+            name: ReportDiscovery(path, self.discovery_classification(name))
+            for name, path in self.discoveries().items()
+        }
+        reporter.report_discoveries(self._model, discoveries)
+
+    def report(self, reporter: Reporter) -> "Checker":
+        """Reference: src/checker.rs:412-452."""
+        start = time.monotonic()
+        while not self.is_done():
+            reporter.report_checking(self._report_data(start, done=False))
+            time.sleep(reporter.delay())
+        self._report_final(reporter, start)
+        return self
+
+    def join_and_report(self, reporter: Reporter) -> "Checker":
+        """Join while reporting; final timing is accurate rather than rounded
+        to the polling interval.  Reference: src/checker.rs:351-409."""
+        import threading
+
+        start = time.monotonic()
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set() and not self.is_done():
+                reporter.report_checking(self._report_data(start, done=False))
+                stop.wait(reporter.delay())
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        self.join()
+        stop.set()
+        poller.join()
+        self._report_final(reporter, start)
+        return self
+
+    # --- assertion helpers (src/checker.rs:468-577) -------------------------
+
+    def assert_properties(self) -> None:
+        for p in self._model.properties():
+            if p.expectation is Expectation.SOMETIMES:
+                self.assert_any_discovery(p.name)
+            else:
+                self.assert_no_discovery(p.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        found = self.discovery(name)
+        if found is not None:
+            return found
+        assert self.is_done(), (
+            f'Discovery for "{name}" not found, but model checking is incomplete.'
+        )
+        raise AssertionError(f'Discovery for "{name}" not found.')
+
+    def assert_no_discovery(self, name: str) -> None:
+        found = self.discovery(name)
+        if found is not None:
+            raise AssertionError(
+                f'Unexpected "{name}" {self.discovery_classification(name)} '
+                f"{found}Last state: {found.last_state()!r}\n"
+            )
+        assert self.is_done(), (
+            f'Discovery for "{name}" not found, but model checking is incomplete.'
+        )
+
+    def assert_discovery(self, name: str, actions: List[Any]) -> None:
+        """Re-execute ``actions`` and validate they constitute a genuine
+        discovery per the property's semantics.  Reference:
+        src/checker.rs:521-577."""
+        additional_info: List[str] = []
+        found = self.assert_any_discovery(name)
+        model = self._model
+        for init_state in model.init_states():
+            path = Path.from_actions(model, init_state, actions)
+            if path is None:
+                continue
+            prop = model.property(name)
+            if prop.expectation is Expectation.ALWAYS:
+                if not prop.condition(model, path.last_state()):
+                    return
+            elif prop.expectation is Expectation.EVENTUALLY:
+                states = path.into_states()
+                is_liveness_satisfied = any(
+                    prop.condition(model, s) for s in states
+                )
+                acts: List[Any] = []
+                model.actions(states[-1], acts)
+                is_path_terminal = not acts
+                if not is_liveness_satisfied and is_path_terminal:
+                    return
+                if is_liveness_satisfied:
+                    additional_info.append(
+                        "incorrect counterexample satisfies eventually property"
+                    )
+                if not is_path_terminal:
+                    additional_info.append("incorrect counterexample is nonterminal")
+            else:  # SOMETIMES
+                if prop.condition(model, path.last_state()):
+                    return
+        extra = f" ({'; '.join(additional_info)})" if additional_info else ""
+        raise AssertionError(
+            f'Invalid discovery for "{name}"{extra}, but a valid one was found. '
+            f"found={found.into_actions()!r}"
+        )

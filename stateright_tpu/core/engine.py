@@ -1,0 +1,289 @@
+"""Host graph-search checker engines (BFS and DFS).
+
+Reference: src/checker/bfs.rs and src/checker/dfs.rs.  The two engines share
+one worker skeleton here, parameterized by the three points where they
+genuinely differ (the reference deliberately keeps them unfactored pending
+DPOR work — src/checker/bfs.rs:17-18):
+
+- queue discipline: BFS pops from the back and pushes successors to the
+  front (FIFO level order); DFS pushes to the back (LIFO).
+- discovery representation: BFS stores one fingerprint per discovery and
+  reconstructs the path by walking a predecessor map
+  (src/checker/bfs.rs:380-409); DFS jobs carry their full fingerprint trail.
+- symmetry reduction is honored only by DFS (BFS ignores the option, noted
+  in SURVEY §2.1): dedup keys on the canonicalized state's fingerprint while
+  the path continues with the original state (src/checker/dfs.rs:309-334).
+
+Eventually-property machinery: one bit per `eventually` property travels
+with each job; a bit is cleared when the property's condition holds at a
+state along the path; bits remaining at a terminal state are
+counterexamples.  The reference's two documented false negatives (cycles
+treated as DAG joins; ebits excluded from the dedup fingerprint) are
+reproduced intentionally so discovery sets match (src/checker/bfs.rs:295-315).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .has_discoveries import HasDiscoveries
+from .job_market import JobMarket
+from .model import Expectation
+from .path import Path
+from .checker import Checker
+
+BLOCK_SIZE = 1500  # states between market interactions (src/checker/bfs.rs:130)
+
+
+class GraphChecker(Checker):
+    """Shared implementation of the BFS and DFS checkers."""
+
+    def __init__(self, options, dfs: bool):
+        super().__init__(options.model)
+        self._dfs = dfs
+        self._options = options
+        # Per reference behavior BFS ignores the symmetry option (it is only
+        # read in DFS spawn); see SURVEY §2.1 / src/checker/bfs.rs.
+        self._symmetry = options._symmetry if dfs else None
+        self._properties = self._model.properties()
+        self._visitor = options._visitor
+        self._finish_when: HasDiscoveries = options._finish_when
+        self._target_state_count = options._target_state_count
+        self._target_max_depth = options._target_max_depth
+        thread_count = options._thread_count
+
+        model = self._model
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._max_depth = 0
+        self._count_lock = threading.Lock()
+
+        # BFS: fp -> Optional[parent fp] (predecessor tree).  DFS: set of fps.
+        self._generated: Dict[int, Optional[int]] = {}
+        self._gen_lock = threading.Lock()
+        for s in init_states:
+            if self._symmetry is not None:
+                self._generated.setdefault(
+                    model.fingerprint(self._symmetry(s)), None
+                )
+            else:
+                self._generated.setdefault(model.fingerprint(s), None)
+
+        ebits = frozenset(
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation is Expectation.EVENTUALLY
+        )
+        pending = deque()
+        for s in init_states:
+            fp = model.fingerprint(s)
+            # DFS jobs carry their full fingerprint trail (reference:
+            # src/checker/dfs.rs:31) — represented as cons cells so pushing a
+            # successor is O(1) instead of an O(depth) copy.
+            trail = (fp, None) if dfs else fp
+            pending.append((s, trail, ebits, 1))
+
+        # name -> fp (BFS) | trail list (DFS); first writer wins, races fine
+        # (src/checker/bfs.rs:243).
+        self._discoveries: Dict[str, Any] = {}
+
+        close_at = (
+            time.monotonic() + options._timeout if options._timeout is not None else None
+        )
+        self._close_at = close_at
+        self._market: JobMarket = JobMarket(thread_count, close_at)
+        self._market.push(pending)
+
+        self._errors: List[BaseException] = []
+        self._handles: List[threading.Thread] = []
+        for t in range(thread_count):
+            th = threading.Thread(
+                target=self._worker, name=f"checker-{t}", daemon=True
+            )
+            self._handles.append(th)
+        for th in self._handles:
+            th.start()
+
+    # --- worker loop (src/checker/bfs.rs:103-161) ---------------------------
+
+    def _worker(self) -> None:
+        try:
+            pending: deque = deque()
+            while True:
+                if not pending:
+                    pending = self._market.pop()
+                    if not pending:
+                        return
+                self._check_block(pending, BLOCK_SIZE)
+                if (
+                    self._close_at is not None
+                    and time.monotonic() >= self._close_at
+                ):
+                    return
+                if self._finish_when.matches(
+                    frozenset(self._discoveries), self._properties
+                ):
+                    return
+                if (
+                    self._target_state_count is not None
+                    and self._target_state_count <= self._state_count
+                ):
+                    return
+                if len(pending) > 1 and len(self._handles) > 1:
+                    self._market.split_and_push(pending)
+        except BaseException as e:  # propagate at join (src/checker/bfs.rs:479-488)
+            self._errors.append(e)
+        finally:
+            self._market.worker_done()
+
+    def _check_block(self, pending: deque, max_count: int) -> None:
+        model = self._model
+        properties = self._properties
+        dfs = self._dfs
+        symmetry = self._symmetry
+        generated = self._generated
+        discoveries = self._discoveries
+        target_max_depth = self._target_max_depth
+        local_state_count = 0
+        local_max_depth = self._max_depth
+
+        try:
+            while True:
+                if max_count == 0:
+                    return
+                max_count -= 1
+                if not pending:
+                    return
+                state, trail, ebits, depth = pending.pop()
+                state_fp = trail[0] if dfs else trail
+
+                if depth > local_max_depth:
+                    local_max_depth = depth
+
+                if target_max_depth is not None and depth >= target_max_depth:
+                    continue
+
+                if self._visitor is not None:
+                    self._visitor.visit(model, self._reconstruct(trail))
+
+                # Property evaluation (src/checker/bfs.rs:230-281).
+                is_awaiting_discoveries = False
+                for i, prop in enumerate(properties):
+                    if prop.name in discoveries:
+                        continue
+                    if prop.expectation is Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            discoveries.setdefault(prop.name, trail)
+                        else:
+                            is_awaiting_discoveries = True
+                    elif prop.expectation is Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            discoveries.setdefault(prop.name, trail)
+                        else:
+                            is_awaiting_discoveries = True
+                    else:  # EVENTUALLY: only discovered at terminal states.
+                        is_awaiting_discoveries = True
+                        if prop.condition(model, state):
+                            ebits = ebits - {i}
+                if not is_awaiting_discoveries:
+                    return
+
+                # Expand successors (src/checker/bfs.rs:283-325).
+                is_terminal = True
+                actions: List[Any] = []
+                model.actions(state, actions)
+                for action in actions:
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    local_state_count += 1
+
+                    if symmetry is not None:
+                        rep_fp = model.fingerprint(symmetry(next_state))
+                        with self._gen_lock:
+                            if rep_fp in generated:
+                                is_terminal = False
+                                continue
+                            generated[rep_fp] = None
+                        # Continue the path with the pre-canonicalized state
+                        # (src/checker/dfs.rs:315-318).
+                        next_fp = model.fingerprint(next_state)
+                    else:
+                        next_fp = model.fingerprint(next_state)
+                        with self._gen_lock:
+                            if next_fp in generated:
+                                is_terminal = False
+                                continue
+                            generated[next_fp] = None if dfs else state_fp
+
+                    is_terminal = False
+                    next_trail = (next_fp, trail) if dfs else next_fp
+                    job = (next_state, next_trail, ebits, depth + 1)
+                    if dfs:
+                        pending.append(job)
+                    else:
+                        pending.appendleft(job)
+
+                if is_terminal:
+                    for i, prop in enumerate(properties):
+                        if i in ebits:
+                            discoveries.setdefault(prop.name, trail)
+        finally:
+            with self._count_lock:
+                self._state_count += local_state_count
+                if local_max_depth > self._max_depth:
+                    self._max_depth = local_max_depth
+
+    # --- Checker surface ----------------------------------------------------
+
+    def _reconstruct(self, trail) -> Path:
+        if self._dfs:
+            fps: deque = deque()
+            cell = trail
+            while cell is not None:
+                fps.appendleft(cell[0])
+                cell = cell[1]
+            return Path.from_fingerprints(self._model, list(fps))
+        # BFS: walk the predecessor map back to a root
+        # (src/checker/bfs.rs:380-409).
+        fps: deque = deque()
+        next_fp: Optional[int] = trail
+        while next_fp is not None and next_fp in self._generated:
+            fps.appendleft(next_fp)
+            next_fp = self._generated[next_fp]
+        return Path.from_fingerprints(self._model, list(fps))
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct(trail)
+            for name, trail in list(self._discoveries.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        return self._handles
+
+    def is_done(self) -> bool:
+        return self._market.is_closed or len(self._discoveries) == len(
+            self._properties
+        )
+
+    def join(self) -> "GraphChecker":
+        for h in self._handles:
+            h.join()
+        if self._errors:
+            raise self._errors[0]
+        return self

@@ -1,0 +1,108 @@
+"""Work-sharing market coordinating host checker threads.
+
+Reference: src/job_market.rs.  Semantics mirrored exactly:
+
+- ``open_count`` starts at the worker count; a worker idling inside ``pop``
+  decrements it, and the last idle worker closes the market (distributed
+  termination detection, src/job_market.rs:100-111).
+- Any worker exiting — normal return *or* exception — closes the market and
+  clears outstanding batches (the reference does this via ``Drop``,
+  src/job_market.rs:24-36), which is how early-exit and panic shutdown
+  propagate to sibling threads.
+- ``split_and_push`` hands ``1 + min(idle, len)`` pieces off the back of the
+  worker's deque to idle workers (src/job_market.rs:140-167).
+- An optional deadline closes the market when reached (src/job_market.rs:64-77).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class JobMarket(Generic[T]):
+    def __init__(self, thread_count: int, close_at: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._batches: List[Deque[T]] = []
+        self._open = True
+        self._thread_count = thread_count
+        self._open_count = thread_count
+        self._close_at = close_at
+
+    def push(self, jobs: Deque[T]) -> None:
+        with self._cond:
+            if not self._open:
+                return
+            self._batches.append(jobs)
+            self._cond.notify()
+
+    def pop(self) -> Deque[T]:
+        """Pop a batch; empty deque means no more jobs are coming."""
+        with self._cond:
+            if not self._open:
+                return deque()
+            while True:
+                if self._close_at is not None and time.monotonic() >= self._close_at:
+                    self._open = False
+                    self._cond.notify_all()
+                    return deque()
+                if self._batches:
+                    return self._batches.pop()
+                self._open_count -= 1
+                if self._open_count == 0:
+                    self._open = False
+                    self._cond.notify_all()
+                    return deque()
+                if not self._open:
+                    # Market closed while we were working; drain out.
+                    self._cond.notify_all()
+                    return deque()
+                if self._close_at is not None:
+                    timeout = max(0.0, self._close_at - time.monotonic())
+                    self._cond.wait(timeout=min(timeout, 0.25))
+                else:
+                    self._cond.wait()
+                self._open_count += 1
+
+    def split_and_push(self, jobs: Deque[T]) -> None:
+        with self._cond:
+            if not self._open:
+                jobs.clear()
+                return
+            pieces = 1 + min(self._thread_count - self._open_count, len(jobs))
+            size = len(jobs) // pieces
+            if size == 0:
+                return
+            for _ in range(pieces - 1):
+                batch: Deque[T] = deque()
+                for _ in range(size):
+                    batch.append(jobs.pop())
+                batch.reverse()
+                if batch:
+                    self._batches.append(batch)
+                    self._cond.notify()
+
+    def worker_done(self) -> None:
+        """A worker exited (normally or exceptionally).  The reference models
+        this via ``Drop`` on the broker clone: close the market, discard
+        outstanding work, wake everyone (src/job_market.rs:24-36)."""
+        with self._cond:
+            self._open = False
+            self._batches.clear()
+            self._open_count = max(0, self._open_count - 1)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._open = False
+            self._cond.notify_all()
+
+    @property
+    def is_closed(self) -> bool:
+        with self._lock:
+            return not self._open and not self._batches and self._open_count == 0

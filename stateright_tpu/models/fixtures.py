@@ -1,0 +1,154 @@
+"""Fixture models for tests (and docs).
+
+Reference: src/test_util.rs — binary_clock, dgraph, linear_equation_solver,
+and panicker, reproduced with the same state spaces so the reference's
+golden counts (e.g. 65,536 states for full LinearEquation enumeration) pin
+this implementation too.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..core.model import Model, Property
+
+
+class BinaryClock(Model):
+    """2-state cycle; the smallest possible model (src/test_util.rs:4-47)."""
+
+    class Action(enum.Enum):
+        GO_LOW = "GoLow"
+        GO_HIGH = "GoHigh"
+
+        def __repr__(self) -> str:
+            return self.value
+
+    def init_states(self):
+        return [0, 1]
+
+    def actions(self, state, actions):
+        if state == 0:
+            actions.append(BinaryClock.Action.GO_HIGH)
+        else:
+            actions.append(BinaryClock.Action.GO_LOW)
+
+    def next_state(self, state, action):
+        return 1 if action is BinaryClock.Action.GO_HIGH else 0
+
+    def properties(self):
+        return [Property.always("in [0, 1]", lambda _m, s: 0 <= s <= 1)]
+
+
+@dataclass
+class DGraph(Model):
+    """A directed graph specified via paths from initial states; the harness
+    for eventually-property semantics tests (src/test_util.rs:50-116)."""
+
+    inits: Set[int] = field(default_factory=set)
+    edges: Dict[int, Set[int]] = field(default_factory=dict)
+    props: List[Property] = field(default_factory=list)
+
+    @staticmethod
+    def with_property(prop: Property) -> "DGraph":
+        return DGraph(props=[prop])
+
+    def with_path(self, path: List[int]) -> "DGraph":
+        src = path[0]
+        self.inits.add(src)
+        for dst in path[1:]:
+            self.edges.setdefault(src, set()).add(dst)
+            src = dst
+        return self
+
+    def check(self):
+        return self.checker().spawn_bfs().join()
+
+    def init_states(self):
+        return sorted(self.inits)
+
+    def actions(self, state, actions):
+        actions.extend(sorted(self.edges.get(state, ())))
+
+    def next_state(self, state, action):
+        return action
+
+    def properties(self):
+        return list(self.props)
+
+
+@dataclass
+class LinearEquation(Model):
+    """Finds x, y with a*x + b*y = c (mod 256); the standard checker test —
+    full enumeration is 65,536 states (src/test_util.rs:140-192)."""
+
+    a: int
+    b: int
+    c: int
+
+    class Guess(enum.Enum):
+        INCREASE_X = "IncreaseX"
+        INCREASE_Y = "IncreaseY"
+
+        def __repr__(self) -> str:
+            return self.value
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.append(LinearEquation.Guess.INCREASE_X)
+        actions.append(LinearEquation.Guess.INCREASE_Y)
+
+    def next_state(self, state, action):
+        x, y = state
+        if action is LinearEquation.Guess.INCREASE_X:
+            return ((x + 1) % 256, y)
+        return (x, (y + 1) % 256)
+
+    def properties(self):
+        def solvable(model, solution):
+            x, y = solution
+            return (model.a * x + model.b * y) % 256 == model.c
+
+        return [Property.sometimes("solvable", solvable)]
+
+
+class Panicker(Model):
+    """Raises mid-exploration to test clean thread shutdown
+    (src/test_util.rs:195-228)."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        actions.append(1)
+
+    def next_state(self, last_state, action):
+        if last_state == 5:
+            raise RuntimeError("reached panic state")
+        return last_state + action
+
+    def properties(self):
+        return [Property.always("true", lambda _m, _s: True)]
+
+
+class FnModel(Model):
+    """A model defined by a function ``fn(prev_state_or_None, out_list)`` —
+    the analog of the reference's blanket Model impl for functions
+    (src/test_util.rs:119-137)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def init_states(self):
+        out: list = []
+        self._fn(None, out)
+        return out
+
+    def actions(self, state, actions):
+        self._fn(state, actions)
+
+    def next_state(self, state, action):
+        return action

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+TPU/JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without hardware; set up before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

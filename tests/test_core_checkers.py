@@ -1,0 +1,334 @@
+"""Core checker golden tests.
+
+Mirrors the reference's inline test modules for BFS (src/checker/bfs.rs),
+DFS (src/checker/dfs.rs), eventually-property semantics (src/checker.rs:589-681),
+path reconstruction (src/checker.rs:683-707), and report format
+(src/checker.rs:709-799).  The golden numbers (15/12/4 BFS, 55/55/28 DFS,
+65,536 full enumeration, 9→6 symmetry) are the reference's own.
+"""
+
+import io
+import re
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import pytest
+
+from stateright_tpu import (
+    HasDiscoveries,
+    NondeterminismError,
+    Path,
+    PathRecorder,
+    Property,
+    StateRecorder,
+    WriteReporter,
+    fingerprint,
+)
+from stateright_tpu.core.model import Model
+from stateright_tpu.models.fixtures import (
+    BinaryClock,
+    DGraph,
+    FnModel,
+    LinearEquation,
+    Panicker,
+)
+
+Guess = LinearEquation.Guess
+
+
+# --- BFS (src/checker/bfs.rs:411-489) ---------------------------------------
+
+
+def test_visits_states_in_bfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(a=2, b=10, c=14).checker().visitor(recorder).spawn_bfs().join()
+    assert accessor() == [
+        (0, 0),
+        (1, 0),
+        (0, 1),
+        (2, 0),
+        (1, 1),
+        (0, 2),
+        (3, 0),
+        (2, 1),
+    ]
+
+
+def test_bfs_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(a=2, b=4, c=7).checker().spawn_bfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_bfs_can_complete_by_eliminating_properties():
+    checker = LinearEquation(a=2, b=10, c=14).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 12
+    assert checker.discovery("solvable").into_actions() == [
+        Guess.INCREASE_X,
+        Guess.INCREASE_X,
+        Guess.INCREASE_Y,
+    ]
+    checker.assert_discovery("solvable", [Guess.INCREASE_Y] * 27)
+
+
+def test_bfs_handles_panics_gracefully():
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().threads(2).spawn_bfs().join()
+
+
+# --- DFS (src/checker/dfs.rs:404-585) ---------------------------------------
+
+
+def test_visits_states_in_dfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(a=2, b=10, c=14).checker().visitor(recorder).spawn_dfs().join()
+    assert accessor() == [(0, y) for y in range(28)]
+
+
+def test_dfs_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(a=2, b=4, c=7).checker().spawn_dfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_dfs_can_complete_by_eliminating_properties():
+    checker = LinearEquation(a=2, b=10, c=14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 55
+    assert checker.discovery("solvable").into_actions() == [Guess.INCREASE_Y] * 27
+    checker.assert_discovery(
+        "solvable", [Guess.INCREASE_X, Guess.INCREASE_Y, Guess.INCREASE_X]
+    )
+
+
+def test_dfs_handles_panics_gracefully():
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().threads(2).spawn_dfs().join()
+
+
+# --- Symmetry reduction (src/checker/dfs.rs:486-573) ------------------------
+
+PAUSED, LOADING, RUNNING = 0, 1, 2  # Paused < Loading < Running, as reference
+
+
+class SymSys(Model):
+    def init_states(self):
+        return [(LOADING, LOADING)]
+
+    def actions(self, state, actions):
+        actions.extend([0, 1])
+
+    def next_state(self, state, action):
+        procs = list(state)
+        p = procs[action]
+        procs[action] = RUNNING if p in (LOADING, PAUSED) else PAUSED
+        return tuple(procs)
+
+    def properties(self):
+        return [
+            Property.always("visit all states", lambda _m, _s: True),
+            Property.sometimes(
+                "a process pauses", lambda _m, s: PAUSED in s
+            ),
+        ]
+
+
+def test_can_apply_symmetry_reduction():
+    checker = SymSys().checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 9
+    checker = SymSys().checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 9
+
+    visitor, _ = PathRecorder.new_with_accessor()
+    checker = (
+        SymSys()
+        .checker()
+        .symmetry_fn(lambda s: tuple(sorted(s)))
+        .visitor(visitor)
+        .spawn_dfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 6
+
+
+# --- eventually-property semantics (src/checker.rs:589-681) -----------------
+
+
+def eventually_odd():
+    return Property.eventually("odd", lambda _m, s: s % 2 == 1)
+
+
+def test_eventually_can_validate():
+    (
+        DGraph.with_property(eventually_odd())
+        .with_path([1])
+        .with_path([2, 3])
+        .with_path([2, 6, 7])
+        .with_path([4, 9, 10])
+        .check()
+        .assert_properties()
+    )
+    for path in ([1], [2, 3], [2, 6, 7], [4, 9, 10]):
+        DGraph.with_property(eventually_odd()).with_path(
+            list(path)
+        ).check().assert_properties()
+
+
+def test_eventually_can_discover_counterexample():
+    d = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 1])
+        .with_path([0, 2])
+        .check()
+        .discovery("odd")
+    )
+    assert d.into_states() == [0, 2]
+    d = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 1])
+        .with_path([2, 4])
+        .check()
+        .discovery("odd")
+    )
+    assert d.into_states() == [2, 4]
+    d = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 1, 4, 6])
+        .with_path([2, 4, 8])
+        .check()
+        .discovery("odd")
+    )
+    assert d.into_states() == [2, 4, 6]
+
+
+def test_fixme_can_miss_counterexample_when_revisiting_a_state():
+    # The reference's documented false negative, intentionally reproduced
+    # (src/checker.rs:663-680).
+    assert (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 2, 4, 2])
+        .check()
+        .discovery("odd")
+        is None
+    )
+    assert (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 2, 4])
+        .with_path([1, 4, 6])
+        .check()
+        .discovery("odd")
+        is None
+    )
+
+
+# --- Path (src/checker.rs:683-707, src/checker/path.rs:223-256) -------------
+
+
+def test_can_build_path_from_fingerprints():
+    model = LinearEquation(a=2, b=10, c=14)
+    fps = [
+        fingerprint((0, 0)),
+        fingerprint((0, 1)),
+        fingerprint((1, 1)),
+        fingerprint((2, 1)),
+    ]
+    path = Path.from_fingerprints(model, fps)
+    assert path.last_state() == (2, 1)
+    assert path.last_state() == Path.final_state(model, fps)
+
+
+def test_panics_if_unable_to_reconstruct_init_state():
+    def fn(prev, out):
+        if prev is None:
+            out.append("UNEXPECTED")
+
+    with pytest.raises(NondeterminismError):
+        Path.from_fingerprints(FnModel(fn), [fingerprint("expected")])
+
+
+def test_panics_if_unable_to_reconstruct_next_state():
+    def fn(prev, out):
+        if prev is None:
+            out.append("expected")
+        else:
+            out.append("UNEXPECTED")
+
+    with pytest.raises(NondeterminismError):
+        Path.from_fingerprints(
+            FnModel(fn), [fingerprint("expected"), fingerprint("expected")]
+        )
+
+
+# --- report format (src/checker.rs:709-799) ---------------------------------
+
+
+def test_report_includes_property_names_and_paths():
+    # BFS
+    written = io.StringIO()
+    LinearEquation(a=2, b=10, c=14).checker().spawn_bfs().report(
+        WriteReporter(written, delay=0.02)
+    )
+    output = written.getvalue()
+    assert re.search(r"Done\. states=15, unique=12, depth=4, sec=", output), output
+    assert (
+        'Discovered "solvable" example Path[3]:\n'
+        "- IncreaseX\n- IncreaseX\n- IncreaseY\nFingerprint path: " in output
+    ), output
+    # the fingerprint path has 4 fingerprints
+    m = re.search(r"Fingerprint path: ([0-9/]+)\n", output)
+    assert m and len(m.group(1).split("/")) == 4
+
+    # DFS
+    written = io.StringIO()
+    LinearEquation(a=2, b=10, c=14).checker().spawn_dfs().report(
+        WriteReporter(written, delay=0.02)
+    )
+    output = written.getvalue()
+    assert re.search(r"Done\. states=55, unique=55, depth=28, sec=", output), output
+    assert 'Discovered "solvable" example Path[27]:\n' + "- IncreaseY\n" * 27 in output
+    m = re.search(r"Fingerprint path: ([0-9/]+)\n", output)
+    assert m and len(m.group(1).split("/")) == 28
+
+
+# --- misc surface -----------------------------------------------------------
+
+
+def test_binary_clock():
+    checker = BinaryClock().checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 2
+
+
+def test_finish_when_any():
+    checker = (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .finish_when(HasDiscoveries.ANY)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.discovery("solvable") is not None
+
+
+def test_target_max_depth():
+    checker = (
+        LinearEquation(a=2, b=4, c=7).checker().target_max_depth(3).spawn_bfs().join()
+    )
+    assert checker.is_done()
+    # depth-3 states are generated but skipped, not expanded: 1 + 2 + 3
+    assert checker.unique_state_count() == 6
+
+
+def test_target_state_count():
+    checker = (
+        LinearEquation(a=2, b=4, c=7)
+        .checker()
+        .target_state_count(100)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.state_count() >= 100
+    assert checker.unique_state_count() < 256 * 256
