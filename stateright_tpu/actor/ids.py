@@ -1,0 +1,39 @@
+"""Actor identifiers.
+
+Reference: src/actor.rs:110-158 — ``Id(u64)`` doubles as a model index
+(0, 1, 2, …) and an encoded IPv4 socket address (``ip << 16 | port``) for
+the real UDP runtime.  It is also the marker type that symmetry rewrite
+plans renumber (src/checker/rewrite.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+
+class Id(int):
+    """An actor identifier; an ``int`` subclass so it can index vectors
+    directly while staying distinguishable for symmetry rewriting."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # match the reference's Display (the index)
+        return f"Id({int(self)})"
+
+    @staticmethod
+    def from_socket_addr(ip: Tuple[int, int, int, int], port: int) -> "Id":
+        ip_u32 = (ip[0] << 24) | (ip[1] << 16) | (ip[2] << 8) | ip[3]
+        return Id((ip_u32 << 16) | port)
+
+    def to_socket_addr(self) -> Tuple[Tuple[int, int, int, int], int]:
+        v = int(self)
+        ip_u32 = v >> 16
+        port = v & 0xFFFF
+        return (
+            ((ip_u32 >> 24) & 0xFF, (ip_u32 >> 16) & 0xFF, (ip_u32 >> 8) & 0xFF, ip_u32 & 0xFF),
+            port,
+        )
+
+    @staticmethod
+    def vec_from(values: Iterable[int]) -> List["Id"]:
+        return [Id(v) for v in values]

@@ -76,13 +76,21 @@ class CheckerBuilder:
         return GraphChecker(self, dfs=True)
 
     def spawn_simulation(self, seed: int, chooser=None) -> "Checker":
-        from .simulation import SimulationChecker, UniformChooser
-
+        try:
+            from .simulation import SimulationChecker, UniformChooser
+        except ImportError as e:
+            raise NotImplementedError(
+                "simulation checker not yet implemented in this build"
+            ) from e
         return SimulationChecker(self, seed, chooser or UniformChooser())
 
     def spawn_on_demand(self) -> "Checker":
-        from .on_demand import OnDemandChecker
-
+        try:
+            from .on_demand import OnDemandChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "on-demand checker not yet implemented in this build"
+            ) from e
         return OnDemandChecker(self)
 
     def spawn_tpu(self, **kwargs) -> "Checker":
@@ -90,13 +98,21 @@ class CheckerBuilder:
         dedup, and property evaluation run on-device as a vmapped wavefront
         BFS (the replacement for the reference's thread-pool hot loop,
         src/checker/bfs.rs:177-335)."""
-        from ..parallel.wavefront import TpuChecker
-
+        try:
+            from ..parallel.wavefront import TpuChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "TPU wavefront checker not yet implemented in this build"
+            ) from e
         return TpuChecker(self, **kwargs)
 
     def serve(self, address) -> "Checker":
-        from ..explorer.server import serve
-
+        try:
+            from ..explorer.server import serve
+        except ImportError as e:
+            raise NotImplementedError(
+                "explorer server not yet implemented in this build"
+            ) from e
         return serve(self, address)
 
 

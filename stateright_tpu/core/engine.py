@@ -157,6 +157,15 @@ class GraphChecker(Checker):
                 max_count -= 1
                 if not pending:
                     return
+                if local_state_count >= 64:
+                    # Flush periodically (not per 1500-state block) so
+                    # concurrent reporters see a live view without taking the
+                    # lock on every evaluated state.
+                    with self._count_lock:
+                        self._state_count += local_state_count
+                        if local_max_depth > self._max_depth:
+                            self._max_depth = local_max_depth
+                    local_state_count = 0
                 state, trail, ebits, depth = pending.pop()
                 state_fp = trail[0] if dfs else trail
 

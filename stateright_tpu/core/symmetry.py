@@ -1,0 +1,116 @@
+"""Symmetry reduction: representatives and rewrite plans.
+
+Reference: src/checker/representative.rs, src/checker/rewrite.rs,
+src/checker/rewrite_plan.rs.  A state's ``representative()`` maps it to a
+canonical member of its symmetry equivalence class; the DFS checker dedups
+on the representative's fingerprint while continuing paths with original
+states (src/checker/dfs.rs:309-334).
+
+``RewritePlan.from_values_to_sort`` builds a permutation by stable-sorting
+values (e.g. per-actor states); ``rewrite(i)`` maps an old index to its new
+index, and ``reindex`` permutes an indexed collection while recursively
+rewriting the elements (src/checker/rewrite_plan.rs:81-123).
+
+Where the reference dispatches on the ``Rewrite<Id>`` trait to renumber
+``Id`` values nested inside state, Python has no type-directed dispatch, so
+``rewrite_value`` recurses structurally and rewrites values of the marker
+type (``stateright_tpu.actor.Id`` by default); data that should not be
+rewritten simply doesn't use the marker type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class Representative:
+    """Duck-typed marker: states implementing ``representative()`` can use
+    ``CheckerBuilder.symmetry()``.  Reference: src/checker/representative.rs."""
+
+    def representative(self):
+        raise NotImplementedError
+
+
+class RewritePlan:
+    __slots__ = ("_map", "_inverse", "_rewritten_type")
+
+    def __init__(self, mapping: Sequence[int], rewritten_type: Optional[type] = None):
+        """``mapping[old_index] = new_index``."""
+        self._map = list(mapping)
+        inverse = [0] * len(self._map)
+        for old_i, new_i in enumerate(self._map):
+            inverse[new_i] = old_i
+        self._inverse = inverse  # inverse[new_index] = old_index
+        self._rewritten_type = rewritten_type
+
+    @staticmethod
+    def from_values_to_sort(
+        values: Sequence[Any], rewritten_type: Optional[type] = None
+    ) -> "RewritePlan":
+        """Build the permutation that stable-sorts ``values``.
+        Reference: src/checker/rewrite_plan.rs:81-106."""
+        order = sorted(range(len(values)), key=lambda i: values[i])
+        mapping = [0] * len(values)
+        for new_i, old_i in enumerate(order):
+            mapping[old_i] = new_i
+        return RewritePlan(mapping, rewritten_type)
+
+    def rewrite(self, x: int) -> int:
+        return self._map[int(x)]
+
+    def reindex(self, indexed: Sequence[Any], rewrite_elems: bool = True) -> List[Any]:
+        """Permute ``indexed`` so the value at old index i lands at new index
+        ``mapping[i]``, recursively rewriting elements.
+        Reference: src/checker/rewrite_plan.rs:110-123."""
+        if rewrite_elems:
+            return [rewrite_value(indexed[old_i], self) for old_i in self._inverse]
+        return [indexed[old_i] for old_i in self._inverse]
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __repr__(self) -> str:
+        return f"RewritePlan({self._map})"
+
+
+def rewrite_value(value: Any, plan: RewritePlan) -> Any:
+    """Structurally rewrite index-like marker values nested inside ``value``.
+
+    The analog of the reference's blanket ``Rewrite`` impls for scalars,
+    tuples, collections, and maps (src/checker/rewrite.rs).
+    """
+    rt = plan._rewritten_type
+    if rt is None:
+        from ..actor.ids import Id as rt  # default marker type
+
+    t = type(value)
+    if t is rt:
+        return t(plan.rewrite(value))
+    if value is None or t in (bool, int, float, str, bytes):
+        return value
+    if t is tuple or t is list:
+        return t(rewrite_value(v, plan) for v in value)
+    if t is frozenset or t is set:
+        return t(rewrite_value(v, plan) for v in value)
+    if t is dict:
+        return {
+            rewrite_value(k, plan): rewrite_value(v, plan) for k, v in value.items()
+        }
+    from ..utils.dense_nat_map import DenseNatMap
+
+    if t is DenseNatMap:
+        # Reference impl for DenseNatMap permutes entries by the plan and
+        # rewrites the values (src/util/densenatmap.rs Rewrite impl).
+        return DenseNatMap(plan.reindex(value.values(), rewrite_elems=True))
+    rw = getattr(value, "rewrite", None)
+    if rw is not None:
+        return rw(plan)
+    if dataclasses.is_dataclass(value):
+        return t(
+            **{
+                f.name: rewrite_value(getattr(value, f.name), plan)
+                for f in dataclasses.fields(value)
+            }
+        )
+    return value

@@ -1,0 +1,125 @@
+"""Racy shared-counter models ("threads" as a direct Model).
+
+Reference: examples/increment.rs (no lock — the "fin" invariant is
+violated; 13 unique states at 2 threads, 8 with symmetry reduction per the
+worked example in its module docs) and examples/increment_lock.rs (with a
+lock — both invariants hold).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+from ..core.model import Model, Property
+
+
+@dataclass(frozen=True)
+class IncrementState:
+    i: int
+    # each thread: (t, pc)
+    s: Tuple[Tuple[int, int], ...]
+
+    def representative(self) -> "IncrementState":
+        # Reference: examples/increment.rs:142-151 — just sort thread states.
+        return IncrementState(self.i, tuple(sorted(self.s)))
+
+
+@dataclass(frozen=True)
+class Increment(Model):
+    """SHARED = 0; N threads each do: 1: local = SHARED; 2: SHARED = local+1."""
+
+    thread_count: int
+
+    def init_states(self):
+        return [IncrementState(0, ((0, 1),) * self.thread_count)]
+
+    def actions(self, state, actions):
+        for tid in range(self.thread_count):
+            pc = state.s[tid][1]
+            if pc == 1:
+                actions.append(("Read", tid))
+            elif pc == 2:
+                actions.append(("Write", tid))
+
+    def next_state(self, st, action):
+        kind, n = action
+        s = list(st.s)
+        if kind == "Read":
+            s[n] = (st.i, 2)
+            return IncrementState(st.i, tuple(s))
+        else:  # Write
+            t = st.s[n][0]
+            s[n] = (t, 3)
+            return IncrementState(t + 1, tuple(s))
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda _m, st: sum(1 for (_t, pc) in st.s if pc == 3) == st.i,
+            )
+        ]
+
+
+@dataclass(frozen=True)
+class IncrementLockState:
+    i: int
+    lock: bool
+    s: Tuple[Tuple[int, int], ...]
+
+    def representative(self) -> "IncrementLockState":
+        return IncrementLockState(self.i, self.lock, tuple(sorted(self.s)))
+
+
+@dataclass(frozen=True)
+class IncrementLock(Model):
+    """Same counter with a lock; the invariants hold.
+    Reference: examples/increment_lock.rs."""
+
+    thread_count: int
+
+    def init_states(self):
+        return [IncrementLockState(0, False, ((0, 0),) * self.thread_count)]
+
+    def actions(self, state, actions):
+        for tid in range(self.thread_count):
+            pc = state.s[tid][1]
+            if pc == 0 and not state.lock:
+                actions.append(("Lock", tid))
+            elif pc == 1:
+                actions.append(("Read", tid))
+            elif pc == 2:
+                actions.append(("Write", tid))
+            elif pc == 3 and state.lock:
+                actions.append(("Release", tid))
+
+    def next_state(self, st, action):
+        kind, n = action
+        s = list(st.s)
+        t, _pc = st.s[n]
+        if kind == "Lock":
+            s[n] = (t, 1)
+            return IncrementLockState(st.i, True, tuple(s))
+        if kind == "Read":
+            s[n] = (st.i, 2)
+            return IncrementLockState(st.i, st.lock, tuple(s))
+        if kind == "Write":
+            s[n] = (t, 3)
+            return IncrementLockState(t + 1, st.lock, tuple(s))
+        # Release
+        s[n] = (t, 4)
+        return IncrementLockState(st.i, False, tuple(s))
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda _m, st: sum(1 for (_t, pc) in st.s if pc >= 3) == st.i,
+            ),
+            Property.always(
+                "mutex",
+                lambda _m, st: sum(1 for (_t, pc) in st.s if 1 <= pc < 4) <= 1,
+            ),
+        ]

@@ -222,7 +222,10 @@ def fingerprint(obj: Any) -> int:
         words: List[int] = []
         canon_words(obj, words)
         fp = fp64_words(words)
-        object.__setattr__(obj, "_cached_fp", fp)
+        try:
+            object.__setattr__(obj, "_cached_fp", fp)
+        except AttributeError:
+            pass  # slots=True dataclass: no __dict__ to cache in
         return fp
     words = []
     canon_words(obj, words)

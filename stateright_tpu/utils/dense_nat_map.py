@@ -1,0 +1,63 @@
+"""DenseNatMap: a type-safe vector keyed by index-like values.
+
+Reference: src/util/densenatmap.rs — a ``Vec`` keyed by newtypes convertible
+to/from ``usize`` (e.g. actor ``Id``), insert-in-order only; the basis of
+``RewritePlan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, Iterable, Iterator, List, Tuple, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class DenseNatMap(Generic[K, V]):
+    __slots__ = ("_values",)
+
+    def __init__(self, values: Iterable[V] = ()):
+        self._values: List[V] = list(values)
+
+    def insert(self, key: K, value: V) -> None:
+        i = int(key)
+        if i != len(self._values):
+            raise KeyError(
+                f"DenseNatMap requires in-order insertion; next={len(self._values)}, got {i}"
+            )
+        self._values.append(value)
+
+    def get(self, key: K) -> V:
+        return self._values[int(key)]
+
+    def __getitem__(self, key: K) -> V:
+        return self._values[int(key)]
+
+    def __setitem__(self, key: K, value: V) -> None:
+        self._values[int(key)] = value
+
+    def values(self) -> List[V]:
+        return list(self._values)
+
+    def items(self) -> Iterator[Tuple[int, V]]:
+        return enumerate(self._values)
+
+    def __iter__(self) -> Iterator[V]:
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, DenseNatMap) and self._values == other._values
+
+    def __hash__(self) -> int:
+        return hash(tuple(self._values))
+
+    def __canon_words__(self, out: List[int]) -> None:
+        from ..ops.fingerprint import canon_words
+
+        canon_words(tuple(self._values), out)
+
+    def __repr__(self) -> str:
+        return f"DenseNatMap({self._values!r})"

@@ -1,0 +1,87 @@
+"""Direct-model workload golden tests.
+
+Reference anchors: examples/2pc.rs:151-170 (288 / 8,832 / 665),
+examples/increment.rs module docs (13 → 8 with symmetry for 2 threads),
+examples/increment_lock.rs (invariants hold).
+"""
+
+from stateright_tpu import Property
+from stateright_tpu.core.symmetry import RewritePlan
+from stateright_tpu.models.increment import Increment, IncrementLock
+from stateright_tpu.models.twophase import TwoPhaseSys
+
+
+def test_can_model_2pc():
+    checker = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+    checker = TwoPhaseSys(rm_count=5).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+    checker = TwoPhaseSys(rm_count=5).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 665
+    checker.assert_properties()
+
+
+class _Exhaustive:
+    """Mixin adding an unsatisfiable `sometimes` property so the checker
+    explores the full space instead of early-exiting once the (violated)
+    invariant's discovery is found."""
+
+    def properties(self):
+        return super().properties() + [
+            Property.sometimes("unreachable", lambda _m, _s: False)
+        ]
+
+
+class ExhaustiveIncrement(_Exhaustive, Increment):
+    pass
+
+
+class ExhaustiveIncrementLock(_Exhaustive, IncrementLock):
+    pass
+
+
+def test_increment_finds_race():
+    checker = Increment(thread_count=2).checker().spawn_bfs().join()
+    # The naive counter's "fin" invariant is violated (the whole point).
+    assert checker.discovery("fin") is not None
+
+
+def test_increment_state_space_13_to_8_with_symmetry():
+    # examples/increment.rs:36-105 documents 13 unique states for 2 threads,
+    # reduced to 8 under symmetry.
+    checker = ExhaustiveIncrement(thread_count=2).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 13
+    checker = (
+        ExhaustiveIncrement(thread_count=2).checker().symmetry().spawn_dfs().join()
+    )
+    assert checker.unique_state_count() == 8
+
+
+def test_increment_lock_invariants_hold():
+    checker = IncrementLock(thread_count=2).checker().spawn_bfs().join()
+    checker.assert_no_discovery("fin")
+    checker.assert_no_discovery("mutex")
+    checker = ExhaustiveIncrementLock(thread_count=3).checker().spawn_dfs().join()
+    checker.assert_no_discovery("fin")
+    checker.assert_no_discovery("mutex")
+
+
+def test_rewrite_plan_from_sort_sorts():
+    # Reference: src/checker/rewrite_plan.rs:132-138.
+    original = ["B", "D", "C", "A"]
+    plan = RewritePlan.from_values_to_sort(original, rewritten_type=int)
+    assert plan.reindex(original, rewrite_elems=False) == ["A", "B", "C", "D"]
+    assert plan.reindex([1, 3, 2, 0], rewrite_elems=False) == [0, 1, 2, 3]
+
+
+def test_rewrite_plan_can_reindex():
+    # Reference: src/checker/rewrite_plan.rs:141-159.
+    swap = RewritePlan.from_values_to_sort([2, 1, 0], rewritten_type=int)
+    rot = RewritePlan.from_values_to_sort([2, 0, 1], rewritten_type=int)
+    original = ["A", "B", "C"]
+    assert swap.reindex(original, rewrite_elems=False) == ["C", "B", "A"]
+    assert rot.reindex(original, rewrite_elems=False) == ["B", "C", "A"]
