@@ -1,0 +1,37 @@
+"""Actor framework: model-checkable actors that also run on a real UDP
+network.  Reference: src/actor.rs and submodules."""
+
+from .ids import Id
+from .base import (
+    Actor,
+    Out,
+    SendCmd,
+    SetTimerCmd,
+    CancelTimerCmd,
+    ChooseRandomCmd,
+    SaveCmd,
+    is_no_op,
+    is_no_op_with_timer,
+    majority,
+    model_peers,
+    model_timeout,
+)
+from .network import Envelope, Network
+from .model import (
+    ActorModel,
+    ActorModelState,
+    Deliver,
+    Drop,
+    Timeout,
+    Crash,
+    Recover,
+    SelectRandom,
+)
+
+__all__ = [
+    "Id", "Actor", "Out", "SendCmd", "SetTimerCmd", "CancelTimerCmd",
+    "ChooseRandomCmd", "SaveCmd", "is_no_op", "is_no_op_with_timer",
+    "majority", "model_peers", "model_timeout", "Envelope", "Network",
+    "ActorModel", "ActorModelState", "Deliver", "Drop", "Timeout", "Crash",
+    "Recover", "SelectRandom",
+]

@@ -1,0 +1,389 @@
+"""``ActorModel``: adapts an actor system to the ``Model`` interface.
+
+Reference: src/actor/model.rs and src/actor/model_state.rs.  The system
+snapshot holds per-actor states, the network, pending timers, pending
+random-choice sets, crash flags, auxiliary history (TLA-style — this is
+where consistency testers plug in), and per-actor persistent storage.
+
+Action families enumerated (src/actor/model.rs:269-333): Deliver (channel
+heads only for ordered nets), Drop (if lossy), Timeout (per pending timer),
+Crash (bounded by max_crashes), Recover, SelectRandom.  Handler no-ops are
+suppressed — except on ordered networks, where consuming the channel head
+matters (src/actor/model.rs:364).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..core.model import Model, Property
+from ..core.symmetry import RewritePlan, rewrite_value
+from ..ops.fingerprint import fingerprint
+from .base import (
+    Actor,
+    CancelTimerCmd,
+    ChooseRandomCmd,
+    Out,
+    SaveCmd,
+    SendCmd,
+    SetTimerCmd,
+    is_no_op,
+    is_no_op_with_timer,
+)
+from .ids import Id
+from .network import Envelope, Network
+
+
+# --- actions (reference: ActorModelAction, src/actor/model.rs:43-65) --------
+
+
+@dataclass(frozen=True)
+class Deliver:
+    src: Id
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Drop:
+    envelope: Envelope
+
+
+@dataclass(frozen=True)
+class Timeout:
+    id: Id
+    timer: Any
+
+
+@dataclass(frozen=True)
+class Crash:
+    id: Id
+
+
+@dataclass(frozen=True)
+class Recover:
+    id: Id
+
+
+@dataclass(frozen=True)
+class SelectRandom:
+    actor: Id
+    key: str
+    random: Any
+
+
+# --- system state (reference: ActorModelState, src/actor/model_state.rs) ----
+
+
+@dataclass(frozen=True)
+class ActorModelState:
+    actor_states: Tuple[Any, ...]
+    network: Network
+    timers_set: Tuple[Any, ...]  # per actor: frozenset of timers
+    random_choices: Tuple[Any, ...]  # per actor: tuple of (key, (choices...)) sorted
+    crashed: Tuple[bool, ...]
+    history: Any
+    actor_storages: Tuple[Any, ...]
+
+    def representative(self) -> "ActorModelState":
+        """Canonicalize under actor renaming: sort actor states, then rewrite
+        every nested Id.  Reference: src/actor/model_state.rs:176-197."""
+        plan = RewritePlan.from_values_to_sort(
+            [fingerprint(s) for s in self.actor_states]
+        )
+        return ActorModelState(
+            actor_states=tuple(plan.reindex(self.actor_states)),
+            network=self.network.rewrite(plan),
+            timers_set=tuple(plan.reindex(self.timers_set)),
+            random_choices=tuple(plan.reindex(self.random_choices)),
+            crashed=tuple(plan.reindex(self.crashed)),
+            history=rewrite_value(self.history, plan),
+            actor_storages=tuple(plan.reindex(self.actor_storages)),
+        )
+
+
+class _MutState:
+    """Unfrozen working copy used while applying an action."""
+
+    __slots__ = (
+        "actor_states",
+        "network",
+        "timers_set",
+        "random_choices",
+        "crashed",
+        "history",
+        "actor_storages",
+    )
+
+    def __init__(self, s: Optional[ActorModelState] = None):
+        if s is not None:
+            self.actor_states = list(s.actor_states)
+            self.network = s.network
+            self.timers_set = list(s.timers_set)
+            self.random_choices = [dict(rc) for rc in s.random_choices]
+            self.crashed = list(s.crashed)
+            self.history = s.history
+            self.actor_storages = list(s.actor_storages)
+
+    def freeze(self) -> ActorModelState:
+        return ActorModelState(
+            actor_states=tuple(self.actor_states),
+            network=self.network,
+            timers_set=tuple(self.timers_set),
+            random_choices=tuple(
+                tuple(sorted(rc.items())) for rc in self.random_choices
+            ),
+            crashed=tuple(self.crashed),
+            history=self.history,
+            actor_storages=tuple(self.actor_storages),
+        )
+
+
+class ActorModel(Model):
+    """Reference: src/actor/model.rs:24-188 (builder) and the Model impl."""
+
+    def __init__(self, cfg: Any = None, init_history: Any = None):
+        self.actors: List[Actor] = []
+        self.cfg = cfg
+        self.init_history = init_history
+        self.init_network: Network = Network.new_unordered_duplicating()
+        self.lossy_network: bool = False
+        self.max_crashes: int = 0
+        self._properties: List[Property] = []
+        self._record_msg_in: Callable = lambda cfg, h, env: None
+        self._record_msg_out: Callable = lambda cfg, h, env: None
+        self._within_boundary: Callable = lambda cfg, state: True
+
+    # --- fluent builder -----------------------------------------------------
+
+    def actor(self, actor: Actor) -> "ActorModel":
+        self.actors.append(actor)
+        return self
+
+    def add_actors(self, actors) -> "ActorModel":
+        self.actors.extend(actors)
+        return self
+
+    def init_network_(self, network: Network) -> "ActorModel":
+        self.init_network = network
+        return self
+
+    def lossy_network_(self, lossy: bool) -> "ActorModel":
+        self.lossy_network = lossy
+        return self
+
+    def max_crashes_(self, n: int) -> "ActorModel":
+        self.max_crashes = n
+        return self
+
+    def property(self, expectation, name: str, condition) -> "ActorModel":
+        self._properties.append(Property(expectation, name, condition))
+        return self
+
+    def record_msg_in(self, fn) -> "ActorModel":
+        """fn(cfg, history, envelope) -> new history or None."""
+        self._record_msg_in = fn
+        return self
+
+    def record_msg_out(self, fn) -> "ActorModel":
+        self._record_msg_out = fn
+        return self
+
+    def within_boundary_(self, fn) -> "ActorModel":
+        self._within_boundary = fn
+        return self
+
+    # --- Model impl ---------------------------------------------------------
+
+    def properties(self) -> List[Property]:
+        return list(self._properties)
+
+    def within_boundary(self, state) -> bool:
+        return self._within_boundary(self.cfg, state)
+
+    def _process_commands(self, id: Id, out: Out, s: _MutState) -> None:
+        """Apply actor commands to the system snapshot.
+        Reference: src/actor/model.rs:191-235."""
+        index = int(id)
+        for c in out.commands:
+            if isinstance(c, SendCmd):
+                env = Envelope(id, c.dst, c.msg)
+                history = self._record_msg_out(self.cfg, s.history, env)
+                if history is not None:
+                    s.history = history
+                s.network = s.network.send(env)
+            elif isinstance(c, SetTimerCmd):
+                while len(s.timers_set) <= index:
+                    s.timers_set.append(frozenset())
+                s.timers_set[index] = s.timers_set[index] | {c.timer}
+            elif isinstance(c, CancelTimerCmd):
+                s.timers_set[index] = s.timers_set[index] - {c.timer}
+            elif isinstance(c, ChooseRandomCmd):
+                if not c.choices:
+                    s.random_choices[index].pop(c.key, None)
+                else:
+                    s.random_choices[index][c.key] = tuple(c.choices)
+            elif isinstance(c, SaveCmd):
+                while len(s.actor_storages) <= index:
+                    s.actor_storages.append(None)
+                s.actor_storages[index] = c.storage
+            else:
+                raise TypeError(f"unknown command {c!r}")
+
+    def init_states(self) -> List[ActorModelState]:
+        s = _MutState()
+        n = len(self.actors)
+        s.actor_states = []
+        s.network = self.init_network
+        s.timers_set = [frozenset() for _ in range(n)]
+        s.random_choices = [dict() for _ in range(n)]
+        s.crashed = [False] * n
+        s.history = self.init_history
+        s.actor_storages = [None] * n
+        for index, actor in enumerate(self.actors):
+            id = Id(index)
+            out = Out()
+            state = actor.on_start(id, s.actor_storages[index], out)
+            s.actor_states.append(state)
+            self._process_commands(id, out, s)
+        return [s.freeze()]
+
+    def actions(self, state: ActorModelState, actions: List[Any]) -> None:
+        # Reference: src/actor/model.rs:269-333 (same enumeration order).
+        for env in state.network.iter_deliverable():
+            if self.lossy_network:
+                actions.append(Drop(env))
+            if int(env.dst) < len(self.actors):
+                actions.append(Deliver(env.src, env.dst, env.msg))
+
+        for index, timers in enumerate(state.timers_set):
+            for timer in sorted(timers, key=fingerprint):
+                actions.append(Timeout(Id(index), timer))
+
+        n_crashed = sum(state.crashed)
+        if n_crashed < self.max_crashes:
+            for index, crashed in enumerate(state.crashed):
+                if not crashed:
+                    actions.append(Crash(Id(index)))
+
+        for index, crashed in enumerate(state.crashed):
+            if crashed:
+                actions.append(Recover(Id(index)))
+
+        for index, choices in enumerate(state.random_choices):
+            for key, decision in choices:
+                for choice in decision:
+                    actions.append(SelectRandom(Id(index), key, choice))
+
+    def next_state(
+        self, last: ActorModelState, action: Any
+    ) -> Optional[ActorModelState]:
+        # Reference: src/actor/model.rs:335-457.
+        if isinstance(action, Drop):
+            s = _MutState(last)
+            s.network = s.network.on_drop(action.envelope)
+            return s.freeze()
+
+        if isinstance(action, Deliver):
+            index = int(action.dst)
+            if index >= len(last.actor_states):
+                return None
+            if last.crashed[index]:
+                return None
+            last_actor_state = last.actor_states[index]
+            out = Out()
+            next_actor_state = self.actors[index].on_msg(
+                action.dst, last_actor_state, action.src, action.msg, out
+            )
+            if is_no_op(next_actor_state, out) and not self.init_network.is_ordered:
+                return None
+            env = Envelope(action.src, action.dst, action.msg)
+            history = self._record_msg_in(self.cfg, last.history, env)
+            s = _MutState(last)
+            s.network = s.network.on_deliver(env)
+            if next_actor_state is not None:
+                s.actor_states[index] = next_actor_state
+            if history is not None:
+                s.history = history
+            self._process_commands(action.dst, out, s)
+            return s.freeze()
+
+        if isinstance(action, Timeout):
+            index = int(action.id)
+            out = Out()
+            next_actor_state = self.actors[index].on_timeout(
+                action.id, last.actor_states[index], action.timer, out
+            )
+            if is_no_op_with_timer(next_actor_state, out, action.timer):
+                return None
+            s = _MutState(last)
+            s.timers_set[index] = s.timers_set[index] - {action.timer}
+            if next_actor_state is not None:
+                s.actor_states[index] = next_actor_state
+            self._process_commands(action.id, out, s)
+            return s.freeze()
+
+        if isinstance(action, Crash):
+            index = int(action.id)
+            s = _MutState(last)
+            s.timers_set[index] = frozenset()
+            s.random_choices[index] = {}
+            s.crashed[index] = True
+            return s.freeze()
+
+        if isinstance(action, Recover):
+            index = int(action.id)
+            assert last.crashed[index]
+            out = Out()
+            state = self.actors[index].on_start(
+                action.id, last.actor_storages[index], out
+            )
+            s = _MutState(last)
+            s.actor_states[index] = state
+            s.crashed[index] = False
+            self._process_commands(action.id, out, s)
+            return s.freeze()
+
+        if isinstance(action, SelectRandom):
+            index = int(action.actor)
+            out = Out()
+            next_actor_state = self.actors[index].on_random(
+                action.actor, last.actor_states[index], action.random, out
+            )
+            s = _MutState(last)
+            s.random_choices[index].pop(action.key, None)
+            if next_actor_state is not None:
+                s.actor_states[index] = next_actor_state
+            self._process_commands(action.actor, out, s)
+            return s.freeze()
+
+        raise TypeError(f"unknown action {action!r}")
+
+    # --- formatting (reference: src/actor/model.rs:459-597) -----------------
+
+    def format_action(self, action) -> str:
+        if isinstance(action, Deliver):
+            return f"{action.src!r} → {action.msg!r} → {action.dst!r}"
+        if isinstance(action, SelectRandom):
+            return f"{action.actor!r} select random {action.random!r}"
+        return repr(action)
+
+    def format_step(self, last_state, action) -> Optional[str]:
+        next_state = self.next_state(last_state, action)
+        if next_state is None:
+            index = int(getattr(action, "dst", getattr(action, "id", Id(0))))
+            if index < len(last_state.actor_states):
+                return f"UNCHANGED: {last_state.actor_states[index]!r}"
+            return None
+        index = int(
+            getattr(action, "dst", getattr(action, "id", getattr(action, "actor", Id(0))))
+        )
+        if isinstance(action, Drop):
+            return f"DROP: {action.envelope!r}"
+        if index < len(last_state.actor_states):
+            return (
+                f"NEXT_STATE: {next_state.actor_states[index]!r}\n\n"
+                f"PREV_STATE: {last_state.actor_states[index]!r}"
+            )
+        return None

@@ -1,0 +1,246 @@
+"""The in-model communication fabric with three pluggable semantics.
+
+Reference: src/actor/network.rs.
+
+- ``unordered_duplicating``: a *set* of envelopes plus a last-delivered
+  marker; delivery leaves the envelope in place (redelivery allowed), and
+  remembering the last message delivered lets a redelivery that doesn't
+  change actor state still change the state fingerprint
+  (src/actor/network.rs:52, 224-228).
+- ``unordered_nonduplicating``: a *multiset* (envelope -> count); delivery
+  and drops decrement counts (src/actor/network.rs:55, 229-242).
+- ``ordered``: per-directed-pair FIFO queues; only channel heads are
+  deliverable (src/actor/network.rs:67, 243-265).
+
+Networks here are immutable values (state snapshots share them); mutating
+ops return new networks.  Iteration is deterministic (sorted by src, dst,
+message fingerprint) so model re-execution is reproducible across
+processes — the analog of the reference's fixed-seed hashers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, FrozenSet, List, Optional, Tuple
+
+from ..ops.fingerprint import fingerprint
+from .ids import Id
+
+UNORDERED_DUPLICATING = "unordered_duplicating"
+UNORDERED_NONDUPLICATING = "unordered_nonduplicating"
+ORDERED = "ordered"
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """Reference: src/actor/network.rs:25-29."""
+
+    src: Id
+    dst: Id
+    msg: Any
+
+    def _sort_key(self):
+        return (int(self.src), int(self.dst), fingerprint(self.msg))
+
+
+@dataclass(frozen=True)
+class Network:
+    kind: str
+    # unordered_duplicating: envelopes = frozenset[Envelope], last_msg
+    # unordered_nonduplicating: counts = frozenset[(Envelope, int)]
+    # ordered: flows = tuple[((src, dst), tuple[msg, ...]), ...] sorted by key
+    envelopes: FrozenSet[Envelope] = frozenset()
+    last_msg: Optional[Envelope] = None
+    counts: FrozenSet[Tuple[Envelope, int]] = frozenset()
+    flows: Tuple[Tuple[Tuple[Id, Id], Tuple[Any, ...]], ...] = ()
+
+    # --- constructors -------------------------------------------------------
+
+    @staticmethod
+    def new_unordered_duplicating(envelopes=()) -> "Network":
+        n = Network(kind=UNORDERED_DUPLICATING)
+        for e in envelopes:
+            n = n.send(e)
+        return n
+
+    @staticmethod
+    def new_unordered_duplicating_with_last_msg(envelopes, last_msg) -> "Network":
+        n = Network.new_unordered_duplicating(envelopes)
+        return Network(
+            kind=UNORDERED_DUPLICATING, envelopes=n.envelopes, last_msg=last_msg
+        )
+
+    @staticmethod
+    def new_unordered_nonduplicating(envelopes=()) -> "Network":
+        n = Network(kind=UNORDERED_NONDUPLICATING)
+        for e in envelopes:
+            n = n.send(e)
+        return n
+
+    @staticmethod
+    def new_ordered(envelopes=()) -> "Network":
+        n = Network(kind=ORDERED)
+        for e in envelopes:
+            n = n.send(e)
+        return n
+
+    @staticmethod
+    def names() -> List[str]:
+        return [ORDERED, UNORDERED_DUPLICATING, UNORDERED_NONDUPLICATING]
+
+    @staticmethod
+    def from_name(name: str) -> "Network":
+        """CLI string-to-network registry (reference src/actor/network.rs:318-331)."""
+        if name == ORDERED:
+            return Network.new_ordered()
+        if name == UNORDERED_DUPLICATING:
+            return Network.new_unordered_duplicating()
+        if name == UNORDERED_NONDUPLICATING:
+            return Network.new_unordered_nonduplicating()
+        raise ValueError(f"unable to parse network name: {name}")
+
+    @property
+    def is_ordered(self) -> bool:
+        return self.kind == ORDERED
+
+    # --- queries ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        if self.kind == UNORDERED_DUPLICATING:
+            return len(self.envelopes)
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return sum(c for (_e, c) in self.counts)
+        return sum(len(msgs) for (_k, msgs) in self.flows)
+
+    def iter_all(self) -> List[Envelope]:
+        """All envelopes (multiset entries repeated; every queued ordered
+        message).  Reference: src/actor/network.rs:169-177."""
+        if self.kind == UNORDERED_DUPLICATING:
+            return sorted(self.envelopes, key=Envelope._sort_key)
+        if self.kind == UNORDERED_NONDUPLICATING:
+            out = []
+            for e, c in sorted(self.counts, key=lambda ec: ec[0]._sort_key()):
+                out.extend([e] * c)
+            return out
+        out = []
+        for (src, dst), msgs in self.flows:
+            for m in msgs:
+                out.append(Envelope(src, dst, m))
+        return out
+
+    def iter_deliverable(self) -> List[Envelope]:
+        """Distinct deliverable envelopes; for ordered networks, only channel
+        heads.  Reference: src/actor/network.rs:180-190."""
+        if self.kind == UNORDERED_DUPLICATING:
+            return sorted(self.envelopes, key=Envelope._sort_key)
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return sorted(
+                (e for (e, _c) in self.counts), key=Envelope._sort_key
+            )
+        return [
+            Envelope(src, dst, msgs[0]) for (src, dst), msgs in self.flows
+        ]
+
+    # --- mutations (returning new networks) ---------------------------------
+
+    def send(self, env: Envelope) -> "Network":
+        """Reference: src/actor/network.rs:203-217."""
+        if self.kind == UNORDERED_DUPLICATING:
+            return Network(
+                kind=self.kind,
+                envelopes=self.envelopes | {env},
+                last_msg=self.last_msg,
+            )
+        if self.kind == UNORDERED_NONDUPLICATING:
+            counts = dict(self.counts)
+            counts[env] = counts.get(env, 0) + 1
+            return Network(kind=self.kind, counts=frozenset(counts.items()))
+        flows = dict(self.flows)
+        key = (env.src, env.dst)
+        flows[key] = flows.get(key, ()) + (env.msg,)
+        return Network(kind=self.kind, flows=tuple(sorted(flows.items())))
+
+    def on_deliver(self, env: Envelope) -> "Network":
+        """Reference: src/actor/network.rs:219-267."""
+        if self.kind == UNORDERED_DUPLICATING:
+            # Envelope stays (duplicating); remember the last delivery so a
+            # no-op redelivery still perturbs the fingerprint.
+            return Network(kind=self.kind, envelopes=self.envelopes, last_msg=env)
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return self._remove_one(env)
+        return self._remove_ordered(env)
+
+    def on_drop(self, env: Envelope) -> "Network":
+        """Reference: src/actor/network.rs:269-315."""
+        if self.kind == UNORDERED_DUPLICATING:
+            return Network(
+                kind=self.kind,
+                envelopes=self.envelopes - {env},
+                last_msg=self.last_msg,
+            )
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return self._remove_one(env)
+        return self._remove_ordered(env)
+
+    def _remove_one(self, env: Envelope) -> "Network":
+        counts = dict(self.counts)
+        if env not in counts:
+            raise KeyError(f"envelope not found: {env!r}")
+        if counts[env] == 1:
+            del counts[env]
+        else:
+            counts[env] -= 1
+        return Network(kind=self.kind, counts=frozenset(counts.items()))
+
+    def _remove_ordered(self, env: Envelope) -> "Network":
+        flows = dict(self.flows)
+        key = (env.src, env.dst)
+        if key not in flows:
+            raise KeyError(f"flow not found: src={env.src!r} dst={env.dst!r}")
+        msgs = flows[key]
+        try:
+            i = msgs.index(env.msg)
+        except ValueError:
+            raise KeyError(f"message not found: {env.msg!r}") from None
+        remaining = msgs[:i] + msgs[i + 1 :]
+        if remaining:
+            flows[key] = remaining
+        else:
+            del flows[key]  # canonicalize: no empty flows
+        return Network(kind=self.kind, flows=tuple(sorted(flows.items())))
+
+    def rewrite(self, plan) -> "Network":
+        """Renumber actor ids for symmetry reduction
+        (reference: src/actor/network.rs:333-348)."""
+        from ..core.symmetry import rewrite_value
+
+        def renv(e: Envelope) -> Envelope:
+            return Envelope(
+                Id(plan.rewrite(e.src)),
+                Id(plan.rewrite(e.dst)),
+                rewrite_value(e.msg, plan),
+            )
+
+        if self.kind == UNORDERED_DUPLICATING:
+            return Network(
+                kind=self.kind,
+                envelopes=frozenset(renv(e) for e in self.envelopes),
+                last_msg=renv(self.last_msg) if self.last_msg else None,
+            )
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return Network(
+                kind=self.kind,
+                counts=frozenset((renv(e), c) for (e, c) in self.counts),
+            )
+        return Network(
+            kind=self.kind,
+            flows=tuple(
+                sorted(
+                    (
+                        (Id(plan.rewrite(src)), Id(plan.rewrite(dst))),
+                        tuple(rewrite_value(m, plan) for m in msgs),
+                    )
+                    for (src, dst), msgs in self.flows
+                )
+            ),
+        )

@@ -75,22 +75,25 @@ class CheckerBuilder:
 
         return GraphChecker(self, dfs=True)
 
+    @staticmethod
+    def _require(module: str, what: str) -> None:
+        """Distinguish "engine not written yet" from a genuinely broken
+        transitive import inside an existing engine module."""
+        import importlib.util
+
+        if importlib.util.find_spec(module) is None:
+            raise NotImplementedError(f"{what} not yet implemented in this build")
+
     def spawn_simulation(self, seed: int, chooser=None) -> "Checker":
-        try:
-            from .simulation import SimulationChecker, UniformChooser
-        except ImportError as e:
-            raise NotImplementedError(
-                "simulation checker not yet implemented in this build"
-            ) from e
+        self._require("stateright_tpu.core.simulation", "simulation checker")
+        from .simulation import SimulationChecker, UniformChooser
+
         return SimulationChecker(self, seed, chooser or UniformChooser())
 
     def spawn_on_demand(self) -> "Checker":
-        try:
-            from .on_demand import OnDemandChecker
-        except ImportError as e:
-            raise NotImplementedError(
-                "on-demand checker not yet implemented in this build"
-            ) from e
+        self._require("stateright_tpu.core.on_demand", "on-demand checker")
+        from .on_demand import OnDemandChecker
+
         return OnDemandChecker(self)
 
     def spawn_tpu(self, **kwargs) -> "Checker":
@@ -98,21 +101,15 @@ class CheckerBuilder:
         dedup, and property evaluation run on-device as a vmapped wavefront
         BFS (the replacement for the reference's thread-pool hot loop,
         src/checker/bfs.rs:177-335)."""
-        try:
-            from ..parallel.wavefront import TpuChecker
-        except ImportError as e:
-            raise NotImplementedError(
-                "TPU wavefront checker not yet implemented in this build"
-            ) from e
+        self._require("stateright_tpu.parallel.wavefront", "TPU wavefront checker")
+        from ..parallel.wavefront import TpuChecker
+
         return TpuChecker(self, **kwargs)
 
     def serve(self, address) -> "Checker":
-        try:
-            from ..explorer.server import serve
-        except ImportError as e:
-            raise NotImplementedError(
-                "explorer server not yet implemented in this build"
-            ) from e
+        self._require("stateright_tpu.explorer.server", "explorer server")
+        from ..explorer.server import serve
+
         return serve(self, address)
 
 
@@ -163,7 +160,7 @@ class Checker:
         return self.discoveries().get(name)
 
     def discovery_classification(self, name: str) -> str:
-        prop = self._model.property(name)
+        prop = self._model.get_property(name)
         return "example" if prop.expectation is Expectation.SOMETIMES else "counterexample"
 
     def _report_data(self, start: float, done: bool) -> ReportData:
@@ -253,7 +250,7 @@ class Checker:
             path = Path.from_actions(model, init_state, actions)
             if path is None:
                 continue
-            prop = model.property(name)
+            prop = model.get_property(name)
             if prop.expectation is Expectation.ALWAYS:
                 if not prop.condition(model, path.last_state()):
                     return

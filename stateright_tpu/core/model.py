@@ -103,12 +103,19 @@ class Model:
     def next_states(self, last_state: Any) -> List[Any]:
         return [s for (_a, s) in self.next_steps(last_state)]
 
-    def property(self, name: str) -> Property:
+    def get_property(self, name: str) -> Property:
+        """Look up a property by name (the reference's ``Model::property``;
+        renamed because ``ActorModel.property`` is the property-*adding*
+        builder method, mirroring the reference's ``ActorModel::property``)."""
         for p in self.properties():
             if p.name == name:
                 return p
         available = [p.name for p in self.properties()]
         raise KeyError(f"Unknown property. requested={name}, available={available}")
+
+    # Alias for reference-API parity on plain models; ActorModel overrides
+    # ``property`` with its builder method.
+    property = get_property
 
     def checker(self) -> "CheckerBuilder":
         from .checker import CheckerBuilder

@@ -113,4 +113,18 @@ def rewrite_value(value: Any, plan: RewritePlan) -> Any:
                 for f in dataclasses.fields(value)
             }
         )
-    return value
+    import enum
+
+    if isinstance(value, enum.Enum):
+        return value
+    if isinstance(value, int):  # bools/int subclasses other than the marker
+        return value
+    # Refusing to guess is load-bearing: silently passing a container of Ids
+    # through unrewritten would make symmetry reduction unsound (two
+    # non-equivalent states could share a representative and the checker
+    # would silently prune reachable states).  The reference enforces this
+    # statically via the Rewrite<Id> bound (src/actor/model_state.rs:176-184).
+    raise TypeError(
+        f"cannot rewrite {type(value).__name__!r} for symmetry reduction; "
+        "define a rewrite(plan) method on it"
+    )

@@ -1,0 +1,104 @@
+"""Ping-pong: the canonical ActorModel fixture.
+
+Reference: src/actor/actor_test_util.rs — two actors incrementing counters
+by exchanging Ping/Pong; six properties spanning all three expectations;
+exact state-space sizes under each network semantics (14 lossy-duplicating
+at max 1; 4,094 at max 5; 11 lossless).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.model import Expectation
+from ..actor import Actor, ActorModel, Id, Out
+
+
+@dataclass(frozen=True)
+class Ping:
+    value: int
+
+
+@dataclass(frozen=True)
+class Pong:
+    value: int
+
+
+class PingPongActor(Actor):
+    def __init__(self, serve_to: Optional[Id]):
+        self.serve_to = serve_to
+
+    def on_start(self, id, storage, o: Out):
+        if self.serve_to is not None:
+            o.send(self.serve_to, Ping(0))
+        return 0
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        if isinstance(msg, Pong) and state == msg.value:
+            o.send(src, Ping(msg.value + 1))
+            return state + 1
+        if isinstance(msg, Ping) and state == msg.value:
+            o.send(src, Pong(msg.value))
+            return state + 1
+        return None
+
+
+@dataclass
+class PingPongCfg:
+    maintains_history: bool
+    max_nat: int
+
+    def into_model(self) -> ActorModel:
+        def rec_in(cfg, history, _env):
+            if cfg.maintains_history:
+                i, o = history
+                return (i + 1, o)
+            return None
+
+        def rec_out(cfg, history, _env):
+            if cfg.maintains_history:
+                i, o = history
+                return (i, o + 1)
+            return None
+
+        return (
+            ActorModel(cfg=self, init_history=(0, 0))
+            .actor(PingPongActor(serve_to=Id(1)))
+            .actor(PingPongActor(serve_to=None))
+            .record_msg_in(rec_in)
+            .record_msg_out(rec_out)
+            .within_boundary_(
+                lambda cfg, state: all(c <= cfg.max_nat for c in state.actor_states)
+            )
+            .property(
+                Expectation.ALWAYS,
+                "delta within 1",
+                lambda _m, s: max(s.actor_states) - min(s.actor_states) <= 1,
+            )
+            .property(
+                Expectation.SOMETIMES,
+                "can reach max",
+                lambda m, s: any(c == m.cfg.max_nat for c in s.actor_states),
+            )
+            .property(
+                Expectation.EVENTUALLY,
+                "must reach max",
+                lambda m, s: any(c == m.cfg.max_nat for c in s.actor_states),
+            )
+            .property(
+                Expectation.EVENTUALLY,
+                "must exceed max",  # falsifiable due to the boundary
+                lambda m, s: any(c == m.cfg.max_nat + 1 for c in s.actor_states),
+            )
+            .property(
+                Expectation.ALWAYS,
+                "#in <= #out",
+                lambda _m, s: s.history[0] <= s.history[1],
+            )
+            .property(
+                Expectation.EVENTUALLY,
+                "#out <= #in + 1",
+                lambda _m, s: s.history[1] <= s.history[0] + 1,
+            )
+        )

@@ -1,0 +1,76 @@
+"""Unreplicated single-copy register — linearizable iff one server.
+
+Reference: examples/single-copy-register.rs.  Golden: 93 unique states with
+2 clients / 1 server (nonduplicating network); linearizability violated
+with 2 servers (20 unique states).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..actor import Actor, ActorModel, Network, Out
+from ..actor.register import (
+    Get,
+    GetOk,
+    Put,
+    PutOk,
+    RegisterClient,
+    RegisterServer,
+    record_invocations,
+    record_returns,
+)
+from ..core.model import Expectation
+from ..semantics import LinearizabilityTester, Register
+
+NULL_VALUE = "\x00"  # the analog of Rust's char::default()
+
+
+class SingleCopyActor(Actor):
+    def on_start(self, id, storage, o: Out):
+        return NULL_VALUE
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        if isinstance(msg, Put):
+            o.send(src, PutOk(msg.request_id))
+            return msg.value
+        if isinstance(msg, Get):
+            o.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+@dataclass
+class SingleCopyModelCfg:
+    client_count: int
+    server_count: int
+    network: Network
+
+    def into_model(self) -> ActorModel:
+        def value_chosen(_m, state):
+            for env in state.network.iter_deliverable():
+                if isinstance(env.msg, GetOk) and env.msg.value != NULL_VALUE:
+                    return True
+            return False
+
+        model = ActorModel(
+            cfg=self, init_history=LinearizabilityTester(Register(NULL_VALUE))
+        )
+        model.add_actors(
+            RegisterServer(SingleCopyActor()) for _ in range(self.server_count)
+        )
+        model.add_actors(
+            RegisterClient(put_count=1, server_count=self.server_count)
+            for _ in range(self.client_count)
+        )
+        return (
+            model.init_network_(self.network)
+            .property(
+                Expectation.ALWAYS,
+                "linearizable",
+                lambda _m, s: s.history.serialized_history() is not None,
+            )
+            .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations)
+        )

@@ -1,0 +1,21 @@
+"""Consistency semantics: sequential specs and concurrent-history testers.
+
+Reference: src/semantics.rs and submodules.
+"""
+
+from .spec import SequentialSpec
+from .register import Register, ReadOp, WriteOp, ReadOk, WriteOk, READ, WRITE_OK
+from .write_once_register import WORegister, WriteFail
+from .vec import VecSpec, Push, Pop, Len, PushOk, PopOk, LenOk
+from .consistency import (
+    ConsistencyTester,
+    LinearizabilityTester,
+    SequentialConsistencyTester,
+)
+
+__all__ = [
+    "SequentialSpec", "Register", "ReadOp", "WriteOp", "ReadOk", "WriteOk",
+    "READ", "WRITE_OK", "WORegister", "WriteFail", "VecSpec", "Push", "Pop",
+    "Len", "PushOk", "PopOk", "LenOk", "ConsistencyTester",
+    "LinearizabilityTester", "SequentialConsistencyTester",
+]

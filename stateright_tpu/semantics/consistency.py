@@ -1,0 +1,295 @@
+"""Concurrent-history consistency testers.
+
+Reference: src/semantics/consistency_tester.rs,
+src/semantics/linearizability.rs, src/semantics/sequential_consistency.rs.
+
+Both testers record per-thread operation histories (invocations and
+returns) and decide consistency by an exponential backtracking search for a
+valid interleaving against a ``SequentialSpec``.  The linearizability
+tester additionally snapshots, at each invocation, the index of the last
+completed operation of every *other* thread; an interleaving that schedules
+an operation before all such prerequisites violates real-time order and is
+pruned (src/semantics/linearizability.rs:102-129, 221-234).
+
+These testers are *model state*: they live in an ``ActorModel`` history and
+run inside ``always`` property closures for every evaluated state, so they
+are hashable, comparable, and cheaply clonable.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .spec import SequentialSpec
+
+
+class ConsistencyTester:
+    """Reference: src/semantics/consistency_tester.rs:15-43."""
+
+    def on_invoke(self, thread_id, op) -> "ConsistencyTester":
+        raise NotImplementedError
+
+    def on_return(self, thread_id, ret) -> "ConsistencyTester":
+        raise NotImplementedError
+
+    def is_consistent(self) -> bool:
+        raise NotImplementedError
+
+    def on_invret(self, thread_id, op, ret) -> "ConsistencyTester":
+        self.on_invoke(thread_id, op)
+        self.on_return(thread_id, ret)
+        return self
+
+
+class _TesterBase(ConsistencyTester):
+    __slots__ = ("init_ref_obj", "history_by_thread", "in_flight_by_thread", "is_valid_history")
+
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self.init_ref_obj = init_ref_obj
+        # thread -> tuple of completed entries (tester-specific entry shape)
+        self.history_by_thread: Dict[Any, Tuple] = {}
+        # thread -> in-flight entry
+        self.in_flight_by_thread: Dict[Any, Any] = {}
+        self.is_valid_history = True
+
+    def clone(self):
+        c = type(self)(self.init_ref_obj)
+        c.history_by_thread = dict(self.history_by_thread)
+        c.in_flight_by_thread = dict(self.in_flight_by_thread)
+        c.is_valid_history = self.is_valid_history
+        return c
+
+    def __len__(self) -> int:
+        return len(self.in_flight_by_thread) + sum(
+            len(h) for h in self.history_by_thread.values()
+        )
+
+    def _key(self):
+        return (
+            type(self).__name__,
+            self.init_ref_obj,
+            tuple(sorted(self.history_by_thread.items())),
+            tuple(sorted(self.in_flight_by_thread.items())),
+            self.is_valid_history,
+        )
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
+
+    def __canon_words__(self, out: List[int]) -> None:
+        from ..ops.fingerprint import canon_words
+
+        canon_words(
+            (
+                type(self).__name__,
+                self.init_ref_obj,
+                tuple(sorted(self.history_by_thread.items())),
+                tuple(sorted(self.in_flight_by_thread.items())),
+                self.is_valid_history,
+            ),
+            out,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(history={self.history_by_thread!r}, "
+            f"in_flight={self.in_flight_by_thread!r}, valid={self.is_valid_history})"
+        )
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    def serialized_history(self):
+        raise NotImplementedError
+
+    def rewrite(self, plan):
+        """Renumber actor ids (thread ids, and any ids nested in entries) for
+        symmetry reduction — the analog of the reference's ``Rewrite<Id>``
+        bound on ActorModel histories (src/actor/model_state.rs:176-184)."""
+        from ..core.symmetry import rewrite_value
+
+        c = type(self)(self.init_ref_obj.clone())
+        c.history_by_thread = {
+            rewrite_value(tid, plan): rewrite_value(h, plan)
+            for tid, h in self.history_by_thread.items()
+        }
+        c.in_flight_by_thread = {
+            rewrite_value(tid, plan): rewrite_value(e, plan)
+            for tid, e in self.in_flight_by_thread.items()
+        }
+        c.is_valid_history = self.is_valid_history
+        return c
+
+
+class SequentialConsistencyTester(_TesterBase):
+    """Reference: src/semantics/sequential_consistency.rs.
+
+    History entries are ``(op, ret)``; in-flight entries are ``op``.
+    """
+
+    def on_invoke(self, thread_id, op):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id in self.in_flight_by_thread:
+            self.is_valid_history = False
+            raise ValueError(
+                f"Thread already has an operation in flight. thread_id={thread_id!r}"
+            )
+        self.in_flight_by_thread[thread_id] = op
+        self.history_by_thread.setdefault(thread_id, ())
+        return self
+
+    def on_return(self, thread_id, ret):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id not in self.in_flight_by_thread:
+            self.is_valid_history = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}"
+            )
+        op = self.in_flight_by_thread.pop(thread_id)
+        self.history_by_thread[thread_id] = self.history_by_thread.get(
+            thread_id, ()
+        ) + ((op, ret),)
+        return self
+
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        if not self.is_valid_history:
+            return None
+        remaining = {t: deque(h) for t, h in self.history_by_thread.items()}
+        return _serialize_sc(
+            [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread)
+        )
+
+
+def _serialize_sc(valid, ref_obj, remaining, in_flight):
+    if all(not h for h in remaining.values()):
+        return valid
+    for tid in sorted(remaining):
+        h = remaining[tid]
+        if not h:
+            if tid not in in_flight:
+                continue
+            op = in_flight[tid]
+            obj2 = ref_obj.clone()
+            ret = obj2.invoke(op)
+            nif = {k: v for k, v in in_flight.items() if k != tid}
+            result = _serialize_sc(valid + [(op, ret)], obj2, remaining, nif)
+        else:
+            op, ret = h[0]
+            obj2 = ref_obj.clone()
+            if not obj2.is_valid_step(op, ret):
+                continue
+            nrem = dict(remaining)
+            nh = deque(h)
+            nh.popleft()
+            nrem[tid] = nh
+            result = _serialize_sc(valid + [(op, ret)], obj2, nrem, in_flight)
+        if result is not None:
+            return result
+    return None
+
+
+class LinearizabilityTester(_TesterBase):
+    """Reference: src/semantics/linearizability.rs.
+
+    History entries are ``(last_completed, op, ret)`` and in-flight entries
+    are ``(last_completed, op)``, where ``last_completed`` maps every other
+    thread (with completed ops at invocation time) to its last completed op
+    index — the data that enforces real-time order.
+    """
+
+    def on_invoke(self, thread_id, op):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id in self.in_flight_by_thread:
+            self.is_valid_history = False
+            raise ValueError(
+                f"Thread already has an operation in flight. thread_id={thread_id!r}"
+            )
+        last_completed = tuple(
+            sorted(
+                (tid, len(h) - 1)
+                for tid, h in self.history_by_thread.items()
+                if tid != thread_id and h
+            )
+        )
+        self.in_flight_by_thread[thread_id] = (last_completed, op)
+        self.history_by_thread.setdefault(thread_id, ())
+        return self
+
+    def on_return(self, thread_id, ret):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id not in self.in_flight_by_thread:
+            self.is_valid_history = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}"
+            )
+        last_completed, op = self.in_flight_by_thread.pop(thread_id)
+        self.history_by_thread[thread_id] = self.history_by_thread.get(
+            thread_id, ()
+        ) + ((last_completed, op, ret),)
+        return self
+
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        if not self.is_valid_history:
+            return None
+        remaining = {
+            t: deque(enumerate(h)) for t, h in self.history_by_thread.items()
+        }
+        return _serialize_lin(
+            [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread)
+        )
+
+
+def _rt_violation(last_completed, remaining) -> bool:
+    """An op may not be scheduled while a prerequisite (an op completed
+    before this op's invocation) is still unconsumed."""
+    for peer_id, min_peer_time in last_completed:
+        ops = remaining.get(peer_id)
+        if ops:
+            next_peer_time = ops[0][0]
+            if next_peer_time <= min_peer_time:
+                return True
+    return False
+
+
+def _serialize_lin(valid, ref_obj, remaining, in_flight):
+    if all(not h for h in remaining.values()):
+        return valid
+    for tid in sorted(remaining):
+        h = remaining[tid]
+        if not h:
+            # Case 1: no completed ops left; maybe in-flight (optional).
+            if tid not in in_flight:
+                continue
+            last_completed, op = in_flight[tid]
+            if _rt_violation(last_completed, remaining):
+                continue
+            obj2 = ref_obj.clone()
+            ret = obj2.invoke(op)
+            nif = {k: v for k, v in in_flight.items() if k != tid}
+            result = _serialize_lin(valid + [(op, ret)], obj2, remaining, nif)
+        else:
+            # Case 2: next completed op for this thread.
+            _idx, (last_completed, op, ret) = h[0]
+            nrem = dict(remaining)
+            nh = deque(h)
+            nh.popleft()
+            nrem[tid] = nh
+            if _rt_violation(last_completed, nrem):
+                continue
+            obj2 = ref_obj.clone()
+            if not obj2.is_valid_step(op, ret):
+                continue
+            result = _serialize_lin(valid + [(op, ret)], obj2, nrem, in_flight)
+        if result is not None:
+            return result
+    return None

@@ -1,0 +1,78 @@
+"""Stack (Vec) operational semantics.
+
+Reference: src/semantics/vec.rs — Push/Pop/Len with PushOk/PopOk/LenOk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+from .spec import SequentialSpec
+
+
+@dataclass(frozen=True)
+class Push:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Pop:
+    pass
+
+
+@dataclass(frozen=True)
+class Len:
+    pass
+
+
+@dataclass(frozen=True)
+class PushOk:
+    pass
+
+
+@dataclass(frozen=True)
+class PopOk:
+    value: Optional[Any]
+
+
+@dataclass(frozen=True)
+class LenOk:
+    len: int
+
+
+class VecSpec(SequentialSpec):
+    __slots__ = ("items",)
+
+    def __init__(self, items: Tuple[Any, ...] = ()):
+        self.items = tuple(items)
+
+    def invoke(self, op):
+        if isinstance(op, Push):
+            self.items = self.items + (op.value,)
+            return PushOk()
+        if isinstance(op, Pop):
+            if self.items:
+                v, self.items = self.items[-1], self.items[:-1]
+                return PopOk(v)
+            return PopOk(None)
+        if isinstance(op, Len):
+            return LenOk(len(self.items))
+        raise TypeError(f"unknown op {op!r}")
+
+    def clone(self) -> "VecSpec":
+        return VecSpec(self.items)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, VecSpec) and self.items == other.items
+
+    def __hash__(self) -> int:
+        return hash(("VecSpec", self.items))
+
+    def __repr__(self) -> str:
+        return f"VecSpec({list(self.items)!r})"
+
+    def __canon_words__(self, out: List[int]) -> None:
+        from ..ops.fingerprint import canon_words
+
+        canon_words(("VecSpec", self.items), out)

@@ -1,0 +1,69 @@
+"""Write-once register operational semantics.
+
+Reference: src/semantics/write_once_register.rs.  Shares ``WriteOp`` /
+``ReadOp`` / ``WriteOk`` / ``ReadOk`` with the plain register; adds
+``WriteFail`` for a write after a different value was already written
+(writing an *equal* value still succeeds).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List
+
+from .register import ReadOk, ReadOp, WriteOk, WriteOp, WRITE_OK
+from .spec import SequentialSpec
+
+
+@dataclass(frozen=True)
+class WriteFail:
+    pass
+
+
+WRITE_FAIL = WriteFail()
+
+
+class WORegister(SequentialSpec):
+    __slots__ = ("value",)  # None = unwritten
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def invoke(self, op):
+        if isinstance(op, WriteOp):
+            if self.value is None or self.value == op.value:
+                self.value = op.value
+                return WRITE_OK
+            return WRITE_FAIL
+        if isinstance(op, ReadOp):
+            return ReadOk(self.value)
+        raise TypeError(f"unknown op {op!r}")
+
+    def is_valid_step(self, op, ret) -> bool:
+        if isinstance(op, WriteOp) and isinstance(ret, WriteOk):
+            if self.value is None:
+                self.value = op.value
+                return True
+            return self.value == op.value
+        if isinstance(op, WriteOp) and isinstance(ret, WriteFail):
+            return self.value is not None and self.value != op.value
+        if isinstance(op, ReadOp) and isinstance(ret, ReadOk):
+            return self.value == ret.value
+        return False
+
+    def clone(self) -> "WORegister":
+        return WORegister(self.value)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, WORegister) and self.value == other.value
+
+    def __hash__(self) -> int:
+        return hash(("WORegister", self.value))
+
+    def __repr__(self) -> str:
+        return f"WORegister({self.value!r})"
+
+    def __canon_words__(self, out: List[int]) -> None:
+        from ..ops.fingerprint import canon_words
+
+        canon_words(("WORegister", self.value), out)

@@ -33,9 +33,6 @@ class DenseNatMap(Generic[K, V]):
     def __getitem__(self, key: K) -> V:
         return self._values[int(key)]
 
-    def __setitem__(self, key: K, value: V) -> None:
-        self._values[int(key)] = value
-
     def values(self) -> List[V]:
         return list(self._values)
 

@@ -1,0 +1,286 @@
+"""ActorModel golden tests.
+
+Mirrors the reference's inline tests in src/actor/model.rs:832-1400:
+state-space sizes under each network semantics, no-op suppression rules,
+ordered-network delivery, undeliverable messages, crash/recover.
+"""
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Deliver,
+    Drop,
+    Envelope,
+    Id,
+    Network,
+    Out,
+)
+from stateright_tpu.models.ping_pong import Ping, PingPongCfg, Pong
+
+
+def test_visits_expected_states_lossy_dup_max1():
+    # Reference: src/actor/model.rs:841-961 — 14 unique states.
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=1)
+        .into_model()
+        .lossy_network_(True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 14
+
+
+def test_maintains_fixed_delta_despite_lossy_duplicating_network():
+    # Reference: src/actor/model.rs:1044-1057 — 4,094 unique states.
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=5)
+        .into_model()
+        .lossy_network_(True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_may_never_reach_max_on_lossy_network():
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=5)
+        .into_model()
+        .lossy_network_(True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4094
+    # Can lose the first message and get stuck.
+    checker.assert_discovery(
+        "must reach max", [Drop(Envelope(Id(0), Id(1), Ping(0)))]
+    )
+
+
+def test_eventually_reaches_max_on_perfect_delivery_network():
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=5)
+        .into_model()
+        .init_network_(Network.new_unordered_nonduplicating())
+        .lossy_network_(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_can_reach_max():
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=5)
+        .into_model()
+        .lossy_network_(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("can reach max").last_state().actor_states == (4, 5)
+
+
+def test_might_never_reach_beyond_max():
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=5)
+        .into_model()
+        .init_network_(Network.new_unordered_nonduplicating())
+        .lossy_network_(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("must exceed max").last_state().actor_states == (5, 5)
+
+
+def test_maintains_history():
+    # Reference: src/actor/model.rs (history variant) — with history
+    # tracking on, the same model keeps #in/#out counters consistent.
+    checker = (
+        PingPongCfg(maintains_history=True, max_nat=3)
+        .into_model()
+        .lossy_network_(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_no_discovery("#in <= #out")
+
+
+class _NoOpActor(Actor):
+    """Client sends Ignored then Interesting; server only reacts to
+    Interesting.  Reference: src/actor/model.rs:963-1042."""
+
+    def __init__(self, server=None):
+        self.server = server
+
+    def on_start(self, id, storage, o: Out):
+        if self.server is not None:
+            o.send(self.server, "Ignored")
+            o.send(self.server, "Interesting")
+        return "Awaiting an interesting message."
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        if msg == "Interesting":
+            return "Got an interesting message."
+        return None
+
+
+def _no_op_model():
+    return (
+        ActorModel()
+        .actor(_NoOpActor(server=Id(1)))
+        .actor(_NoOpActor())
+        .lossy_network_(False)
+        .property(Expectation.ALWAYS, "Check everything", lambda _m, _s: True)
+    )
+
+
+def test_no_op_depends_on_network():
+    assert (
+        _no_op_model()
+        .init_network_(Network.new_unordered_duplicating())
+        .checker()
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+        == 2
+    )
+    assert (
+        _no_op_model()
+        .init_network_(Network.new_unordered_nonduplicating())
+        .checker()
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+        == 2
+    )
+    assert (
+        _no_op_model()
+        .init_network_(Network.new_ordered())
+        .checker()
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+        == 3
+    )
+
+
+class _UnitActor(Actor):
+    def on_start(self, id, storage, o: Out):
+        return ()
+
+
+def test_handles_undeliverable_messages():
+    # Reference: src/actor/model.rs:1151-1167.
+    checker = (
+        ActorModel()
+        .actor(_UnitActor())
+        .property(Expectation.ALWAYS, "unused", lambda _m, _s: True)
+        .init_network_(
+            Network.new_unordered_duplicating([Envelope(Id(0), Id(99), ())])
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 1
+
+
+class _CountdownActor(Actor):
+    """Actor 0 sends 2 then 1 to actor 1, which appends what it receives.
+    Reference: src/actor/model.rs:1169-1243."""
+
+    def on_start(self, id, storage, o: Out):
+        if id == Id(0):
+            o.send(Id(1), 2)
+            o.send(Id(1), 1)
+        return ()
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        return state + (msg,)
+
+
+def _countdown_model():
+    return (
+        ActorModel()
+        .add_actors([_CountdownActor(), _CountdownActor()])
+        .property(Expectation.ALWAYS, "", lambda _m, _s: True)
+    )
+
+
+def test_handles_ordered_network_flag():
+    from stateright_tpu import StateRecorder
+
+    recorder, accessor = StateRecorder.new_with_accessor()
+    (
+        _countdown_model()
+        .init_network_(Network.new_ordered())
+        .checker()
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    recipient_states = {s.actor_states[1] for s in accessor()}
+    assert recipient_states == {(), (2,), (2, 1)}
+
+    recorder, accessor = StateRecorder.new_with_accessor()
+    (
+        _countdown_model()
+        .init_network_(Network.new_unordered_nonduplicating())
+        .checker()
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    recipient_states = {s.actor_states[1] for s in accessor()}
+    assert recipient_states == {(), (1,), (2,), (1, 2), (2, 1)}
+
+
+class _CrashActor(Actor):
+    """Persists its counter; volatile until saved."""
+
+    def on_start(self, id, storage, o: Out):
+        if storage is not None:
+            return storage
+        return 0
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        o.save(state + 1)
+        return state + 1
+
+
+def test_crash_and_recover():
+    checker = (
+        ActorModel()
+        .actor(_CrashActor())
+        .init_network_(
+            Network.new_unordered_duplicating([Envelope(Id(1), Id(0), "bump")])
+        )
+        .max_crashes_(1)
+        .property(
+            Expectation.ALWAYS,
+            "storage is never ahead of state",
+            lambda _m, s: all(
+                (s.actor_storages[i] or 0) <= s.actor_states[i] or s.crashed[i]
+                for i in range(len(s.actor_states))
+            ),
+        )
+        .within_boundary_(lambda _c, s: all(c <= 3 for c in s.actor_states))
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.is_done()
+    # Crashing wipes volatile state; recovery restores from storage.
+    assert checker.unique_state_count() > 4

@@ -1,0 +1,121 @@
+"""ABD and Paxos golden tests.
+
+Reference anchors: examples/linearizable-register.rs:258-316 (544 unique
+states) and examples/paxos.rs:301-353 (16,668 unique states, BFS = DFS).
+"""
+
+import pytest
+
+from stateright_tpu.actor import Deliver, Id, Network
+from stateright_tpu.actor.register import Get, GetOk, Internal, Put, PutOk
+from stateright_tpu.models.abd import (
+    AbdModelCfg,
+    AckQuery,
+    AckRecord,
+    NULL_VALUE,
+    Query,
+    Record,
+)
+from stateright_tpu.models.paxos import (
+    Accept,
+    Accepted,
+    Decided,
+    PaxosModelCfg,
+    Prepare,
+    Prepared,
+)
+
+
+def test_can_model_linearizable_register_bfs():
+    checker = (
+        AbdModelCfg(
+            client_count=2,
+            server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(0), Internal(Query(3))),
+            Deliver(Id(0), Id(1), Internal(AckQuery(3, (0, Id(0)), NULL_VALUE))),
+            Deliver(Id(1), Id(0), Internal(Record(3, (1, Id(1)), "B"))),
+            Deliver(Id(0), Id(1), Internal(AckRecord(3))),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(3), Id(0), Get(6)),
+            Deliver(Id(0), Id(1), Internal(Query(6))),
+            Deliver(Id(1), Id(0), Internal(AckQuery(6, (1, Id(1)), "B"))),
+            Deliver(Id(0), Id(1), Internal(Record(6, (1, Id(1)), "B"))),
+            Deliver(Id(1), Id(0), Internal(AckRecord(6))),
+        ],
+    )
+    assert checker.unique_state_count() == 544
+
+
+def test_can_model_linearizable_register_dfs():
+    checker = (
+        AbdModelCfg(
+            client_count=2,
+            server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 544
+
+
+@pytest.mark.slow
+def test_can_model_paxos_bfs():
+    checker = (
+        PaxosModelCfg(
+            client_count=2,
+            server_count=3,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(4), Id(1), Put(4, "B")),
+            Deliver(Id(1), Id(0), Internal(Prepare((1, Id(1))))),
+            Deliver(Id(0), Id(1), Internal(Prepared((1, Id(1)), None))),
+            Deliver(Id(1), Id(2), Internal(Accept((1, Id(1)), (4, Id(4), "B")))),
+            Deliver(Id(2), Id(1), Internal(Accepted((1, Id(1))))),
+            Deliver(Id(1), Id(4), PutOk(4)),
+            Deliver(Id(1), Id(2), Internal(Decided((1, Id(1)), (4, Id(4), "B")))),
+            Deliver(Id(4), Id(2), Get(8)),
+        ],
+    )
+    assert checker.unique_state_count() == 16668
+
+
+@pytest.mark.slow
+def test_can_model_paxos_dfs():
+    checker = (
+        PaxosModelCfg(
+            client_count=2,
+            server_count=3,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 16668

@@ -1,0 +1,74 @@
+"""Register-harness model golden tests.
+
+Reference anchors: examples/single-copy-register.rs:89-138 (93 unique
+states at 2 clients / 1 server; linearizability counterexample at 2
+servers with 20 unique states).
+"""
+
+from stateright_tpu.actor import Deliver, Id, Network
+from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+from stateright_tpu.models.single_copy_register import (
+    NULL_VALUE,
+    SingleCopyModelCfg,
+)
+
+
+def test_can_model_single_copy_register_one_server():
+    checker = (
+        SingleCopyModelCfg(
+            client_count=2,
+            server_count=1,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(2), Id(0), Put(2, "B")),
+            Deliver(Id(0), Id(2), PutOk(2)),
+            Deliver(Id(2), Id(0), Get(4)),
+        ],
+    )
+    assert checker.unique_state_count() == 93
+
+
+def test_single_copy_register_two_servers_not_linearizable():
+    checker = (
+        SingleCopyModelCfg(
+            client_count=2,
+            server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_discovery(
+        "linearizable",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(3), Id(0), Get(6)),
+            Deliver(Id(0), Id(3), GetOk(6, NULL_VALUE)),
+        ],
+    )
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(2), Id(0), Put(2, "A")),
+            Deliver(Id(3), Id(0), Get(6)),
+        ],
+    )
+    # The reference sees 20 unique states here, but this run early-exits once
+    # all properties have discoveries, so the count depends on successor
+    # enumeration order (the reference's is ahash iteration order; ours is
+    # sorted-envelope order).  22 is this implementation's deterministic count.
+    assert checker.unique_state_count() == 22
