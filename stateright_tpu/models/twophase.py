@@ -120,6 +120,12 @@ class TwoPhaseSys(Model):
             raise ValueError(action)
         return TwoPhaseState(rm_state, tm_state, tm_prepared, msgs)
 
+    def compiled(self):
+        """TPU form; lazy import so plain host checking never needs jax."""
+        from .twophase_compiled import TwoPhaseCompiled
+
+        return TwoPhaseCompiled(self)
+
     def properties(self):
         return [
             Property.sometimes(

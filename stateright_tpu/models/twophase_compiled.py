@@ -1,0 +1,169 @@
+"""Bit-packed TPU form of the two-phase-commit model.
+
+Host model: stateright_tpu.models.twophase (reference: examples/2pc.rs).
+State packs into W=2 uint32 words for up to 12 RMs:
+
+- word0: RM states, 2 bits each at bit 2*i (WORKING/PREPARED/COMMITTED/
+  ABORTED); TM state (2 bits) at bit 24.
+- word1: tm_prepared bitmap at bits [0, N); message-set bitmap — the
+  reference's message *set* is finite (N ``Prepared(rm)`` + ``Commit`` +
+  ``Abort``), so it packs exactly as N+2 presence bits: ``Prepared(i)`` at
+  bit N+i, ``Commit`` at bit 2N, ``Abort`` at bit 2N+1.
+
+Static action arity A = 2 + 5N, mirroring the host enumeration
+(TmCommit, TmAbort, then per-RM TmRcvPrepared / RmPrepare /
+RmChooseToAbort / RmRcvCommitMsg / RmRcvAbortMsg).  2pc's ``next_state``
+never returns None, so a lane is valid iff its action guard holds.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.compiled import CompiledModel
+from .twophase import (
+    ABORTED,
+    COMMITTED,
+    MSG_ABORT,
+    MSG_COMMIT,
+    PREPARED,
+    TM_ABORTED,
+    TM_COMMITTED,
+    TM_INIT,
+    TwoPhaseState,
+    TwoPhaseSys,
+    WORKING,
+    msg_prepared,
+)
+
+_U32 = jnp.uint32
+_TM_SHIFT = 24
+
+
+class TwoPhaseCompiled(CompiledModel):
+    state_width = 2
+
+    def __init__(self, model: TwoPhaseSys):
+        n = model.rm_count
+        if n > 12:
+            raise ValueError("packed 2pc encoding supports at most 12 RMs")
+        self.model = model
+        self.n = n
+        self.max_actions = 2 + 5 * n
+
+    # --- host side -----------------------------------------------------------
+
+    def encode(self, s: TwoPhaseState) -> np.ndarray:
+        n = self.n
+        w0 = 0
+        for i, rs in enumerate(s.rm_state):
+            w0 |= rs << (2 * i)
+        w0 |= s.tm_state << _TM_SHIFT
+        w1 = 0
+        for i, p in enumerate(s.tm_prepared):
+            w1 |= int(p) << i
+        for m in s.msgs:
+            if m == MSG_COMMIT:
+                w1 |= 1 << (2 * n)
+            elif m == MSG_ABORT:
+                w1 |= 1 << (2 * n + 1)
+            else:  # ("prepared", rm)
+                w1 |= 1 << (n + m[1])
+        return np.array([w0, w1], dtype=np.uint32)
+
+    def decode(self, words: Sequence[int]) -> TwoPhaseState:
+        n = self.n
+        w0, w1 = int(words[0]), int(words[1])
+        rm_state = tuple((w0 >> (2 * i)) & 3 for i in range(n))
+        tm_state = (w0 >> _TM_SHIFT) & 3
+        tm_prepared = tuple(bool((w1 >> i) & 1) for i in range(n))
+        msgs = set()
+        for i in range(n):
+            if (w1 >> (n + i)) & 1:
+                msgs.add(msg_prepared(i))
+        if (w1 >> (2 * n)) & 1:
+            msgs.add(MSG_COMMIT)
+        if (w1 >> (2 * n + 1)) & 1:
+            msgs.add(MSG_ABORT)
+        return TwoPhaseState(rm_state, tm_state, tm_prepared, frozenset(msgs))
+
+    # --- device side ---------------------------------------------------------
+
+    def step(self, state):
+        n = self.n
+        w0, w1 = state[0], state[1]
+        tm = (w0 >> _U32(_TM_SHIFT)) & _U32(3)
+        tm_init = tm == _U32(TM_INIT)
+        prepared_mask = _U32((1 << n) - 1)
+        all_prepared = (w1 & prepared_mask) == prepared_mask
+        commit_msg = ((w1 >> _U32(2 * n)) & _U32(1)) == _U32(1)
+        abort_msg = ((w1 >> _U32(2 * n + 1)) & _U32(1)) == _U32(1)
+
+        w0_tm_cleared = w0 & _U32(~(3 << _TM_SHIFT) & 0xFFFFFFFF)
+
+        nexts0, nexts1, valids = [], [], []
+
+        def emit(valid, nw0, nw1):
+            valids.append(valid)
+            nexts0.append(nw0)
+            nexts1.append(nw1)
+
+        # TmCommit (examples/2pc.rs:100-102)
+        emit(
+            tm_init & all_prepared,
+            w0_tm_cleared | _U32(TM_COMMITTED << _TM_SHIFT),
+            w1 | _U32(1 << (2 * n)),
+        )
+        # TmAbort
+        emit(
+            tm_init,
+            w0_tm_cleared | _U32(TM_ABORTED << _TM_SHIFT),
+            w1 | _U32(1 << (2 * n + 1)),
+        )
+        for rm in range(n):
+            rm_bits = (w0 >> _U32(2 * rm)) & _U32(3)
+            rm_working = rm_bits == _U32(WORKING)
+            prep_msg = ((w1 >> _U32(n + rm)) & _U32(1)) == _U32(1)
+            w0_rm_cleared = w0 & _U32(~(3 << (2 * rm)) & 0xFFFFFFFF)
+            # TmRcvPrepared(rm)
+            emit(tm_init & prep_msg, w0, w1 | _U32(1 << rm))
+            # RmPrepare(rm)
+            emit(
+                rm_working,
+                w0_rm_cleared | _U32(PREPARED << (2 * rm)),
+                w1 | _U32(1 << (n + rm)),
+            )
+            # RmChooseToAbort(rm)
+            emit(rm_working, w0_rm_cleared | _U32(ABORTED << (2 * rm)), w1)
+            # RmRcvCommitMsg(rm)
+            emit(commit_msg, w0_rm_cleared | _U32(COMMITTED << (2 * rm)), w1)
+            # RmRcvAbortMsg(rm)
+            emit(abort_msg, w0_rm_cleared | _U32(ABORTED << (2 * rm)), w1)
+
+        nexts = jnp.stack(
+            [jnp.stack(nexts0), jnp.stack(nexts1)], axis=-1
+        )  # [A, W]
+        return nexts.astype(_U32), jnp.stack(valids)
+
+    def property_conds(self, state):
+        n = self.n
+        w0 = state[0]
+        committed = jnp.zeros((), jnp.bool_)
+        aborted = jnp.zeros((), jnp.bool_)
+        all_committed = jnp.ones((), jnp.bool_)
+        all_aborted = jnp.ones((), jnp.bool_)
+        for rm in range(n):
+            rs = (w0 >> _U32(2 * rm)) & _U32(3)
+            committed |= rs == _U32(COMMITTED)
+            aborted |= rs == _U32(ABORTED)
+            all_committed &= rs == _U32(COMMITTED)
+            all_aborted &= rs == _U32(ABORTED)
+        # Order matches TwoPhaseSys.properties():
+        #   sometimes "abort agreement", sometimes "commit agreement",
+        #   always "consistent".
+        return jnp.stack([all_aborted, all_committed, ~(aborted & committed)])
+
+

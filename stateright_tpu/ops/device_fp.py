@@ -1,0 +1,72 @@
+"""Device-side 64-bit fingerprinting over bit-packed state words.
+
+The host fingerprints arbitrary Python state values by lowering them to a
+canonical uint32 word sequence and hashing with two independent murmur3-style
+32-bit lanes (``stateright_tpu.ops.fingerprint``; the analog of the
+reference's seeded stable hasher, src/lib.rs:340-387).  On device, states are
+already bit-packed uint32 word vectors of *static* width W, and the packed
+encoding is injective (each ``CompiledModel`` defines a bijective
+encode/decode), so hashing the packed words directly is equivalent to hashing
+state identity — the property dedup needs.  The mixer here is a bit-exact
+jnp transcription of ``fp64_words``: ``device_fp64(encode_words(s)) ==
+fp64_words(encode_words(s))`` for any word vector, which the tests pin.
+
+Only 32-bit ops are used (TPUs have no u64 vector lanes); the 64-bit
+fingerprint lives as an (hi, lo) uint32 pair.  Fingerprints are nonzero so
+(0, 0) can mark empty hash-table slots (reference: NonZeroU64,
+src/lib.rs:341).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .fingerprint import _C1, _C2, SEED_HI, SEED_LO
+
+_U32 = jnp.uint32
+
+
+def _rotl(x, r: int):
+    return (x << _U32(r)) | (x >> _U32(32 - r))
+
+
+def _mix32(h, w):
+    k = w * _U32(_C1)
+    k = _rotl(k, 15)
+    k = k * _U32(_C2)
+    h = h ^ k
+    h = _rotl(h, 13)
+    h = h * _U32(5) + _U32(0xE6546B64)
+    return h
+
+
+def _fmix32(h):
+    h = h ^ (h >> _U32(16))
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> _U32(13))
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> _U32(16))
+    return h
+
+
+def device_fp64(words):
+    """Fingerprint packed states.
+
+    ``words``: uint32[..., W] — a batch of packed states, W static.
+    Returns ``(hi, lo)`` uint32 arrays of shape ``[...]``; never both zero.
+
+    Bit-identical to ``fingerprint.fp64_words(words[i])`` per row.
+    """
+    words = words.astype(_U32)
+    w = words.shape[-1]
+    h1 = jnp.full(words.shape[:-1], SEED_HI, _U32)
+    h2 = jnp.full(words.shape[:-1], SEED_LO, _U32)
+    for i in range(w):  # W is small and static: unrolled, fully vectorized
+        h1 = _mix32(h1, words[..., i])
+        h2 = _mix32(h2, words[..., i])
+    h1 = _fmix32(h1 ^ _U32(w))
+    h2 = _fmix32(h2 ^ _U32((w * 0x9E3779B1) & 0xFFFFFFFF))
+    # Avoid the (0, 0) empty-slot marker, mirroring the host's nonzero rule.
+    both_zero = (h1 == 0) & (h2 == 0)
+    h2 = jnp.where(both_zero, _U32(1), h2)
+    return h1, h2

@@ -1,0 +1,108 @@
+"""The compiled-model contract: what the TPU wavefront engine needs.
+
+The reference's hot loop calls dynamically-dispatched user callbacks per
+state (``Model::actions`` / ``next_state`` / property closures,
+src/checker/bfs.rs:230-335).  Under XLA everything is traced once and
+compiled, so a TPU-checkable model provides the same three ingredients in
+static-shape form:
+
+- a bit-packed state encoding: each state is a vector of ``state_width``
+  uint32 words, with ``encode``/``decode`` forming a bijection to the host
+  model's states.  Bounded containers (message sets, queues) become
+  fixed-width bitmaps/lanes — semantically fine because ``within_boundary``
+  already bounds these spaces in the reference models.
+- a ``step`` function: ``uint32[W] -> (uint32[A, W], bool[A])`` producing
+  all ``max_actions`` candidate successors with a validity mask (the
+  reference's data-dependent action list becomes a static arity with masked
+  lanes; wasted lanes are the price of vmap).  The engine vmaps this over
+  the frontier.
+- ``property_conds``: ``uint32[W] -> bool[P]`` evaluating every property
+  condition as a fused predicate, in the same order as
+  ``model.properties()``.
+
+A compiled model never replaces the host model — the host ``Model`` stays
+the oracle for path reconstruction (decoded packed states are re-executed
+host-side to recover action traces) and for golden-count differential tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..core.model import Model
+
+
+class CompiledModel:
+    """Device form of a :class:`Model`.  Subclass per model family.
+
+    Attributes
+    ----------
+    model: the host oracle model.
+    state_width: W, uint32 words per packed state (static).
+    max_actions: A, static action arity of :meth:`step`.
+    """
+
+    model: Model
+    state_width: int
+    max_actions: int
+
+    # --- host side -----------------------------------------------------------
+
+    def init_packed(self) -> np.ndarray:
+        """Packed init states, shape [num_init, W] uint32."""
+        states = [s for s in self.model.init_states() if self.model.within_boundary(s)]
+        return np.stack([self.encode(s) for s in states]).astype(np.uint32)
+
+    def encode(self, state: Any) -> np.ndarray:
+        """Host state -> uint32[W].  Must be injective."""
+        raise NotImplementedError
+
+    def decode(self, words: Sequence[int]) -> Any:
+        """uint32[W] -> host state; inverse of :meth:`encode`."""
+        raise NotImplementedError
+
+    # --- device side (jnp, traced) ------------------------------------------
+
+    def step(self, state):
+        """uint32[W] -> (uint32[A, W] successors, bool[A] valid).
+
+        Invalid lanes may contain arbitrary words; the engine masks them.
+        A successor lane is valid iff the corresponding host action is
+        enabled AND produces a state change (``next_state`` not None).
+        """
+        raise NotImplementedError
+
+    def property_conds(self, state):
+        """uint32[W] -> bool[P], P == len(model.properties()), same order."""
+        raise NotImplementedError
+
+    def boundary(self, state) -> Optional[Any]:
+        """uint32[W] -> bool scalar, the device ``within_boundary``; None
+        (default) means the model is unbounded / bounded by encoding."""
+        return None
+
+    # --- hybrid properties ---------------------------------------------------
+
+    @property
+    def host_property_indices(self) -> tuple:
+        """Indices of properties whose device predicate is only a cheap
+        *necessary* filter; states flagged by the device are re-checked on
+        the host with the real condition (e.g. linearizability's
+        backtracking serialization search — SURVEY §7 hard-part 4)."""
+        return ()
+
+
+def compiled_model_for(model: Model) -> CompiledModel:
+    """Resolve the compiled form of ``model``.
+
+    Models opt in by defining ``compiled() -> CompiledModel``.
+    """
+    fn = getattr(model, "compiled", None)
+    if fn is None:
+        raise NotImplementedError(
+            f"{type(model).__name__} has no compiled form; define "
+            "compiled() returning a CompiledModel to use spawn_tpu()"
+        )
+    return fn()

@@ -1,0 +1,129 @@
+"""HBM-resident fingerprint hash set with batched insert-if-absent.
+
+The reference dedups successors through a lock-sharded concurrent hash map —
+one contended insert per generated state (src/checker/bfs.rs:301-315).  The
+TPU equivalent is a device-resident open-addressing table keyed by the
+64-bit packed-state fingerprint, stored as two uint32 planes (no u64 on TPU
+vector lanes), with whole *waves* of candidate keys inserted at once.
+
+Insertion is lock-free in rounds rather than per-element.  Each round every
+unresolved lane gathers its probe slot and then:
+
+- key already present  → resolved as duplicate;
+- slot occupied by a different key → advance (linear probe);
+- slot empty → contend: every contender scatters its lane id into a *claim
+  plane* at the slot and gathers it back; the lane that reads its own id is
+  the unique winner and scatters its key (so the two key planes can never
+  interleave words from different lanes — no phantom keys).  Losers retry
+  the SAME slot next round and now see the winner's key: equal keys resolve
+  as duplicates, which is how batch-internal duplicates are handled with no
+  pre-sorting; different keys advance.
+
+Expected rounds ≈ 1/(1-load); the engine keeps load < 0.5.  Everything is
+gather/scatter — no sorts — so it compiles small and maps onto the VPU.
+
+Empty slots are (0, 0); fingerprints are guaranteed nonzero
+(ops.device_fp, mirroring the reference's NonZeroU64 fingerprints,
+src/lib.rs:341).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.device_fp import _fmix32, _rotl
+
+_U32 = jnp.uint32
+NO_SLOT = jnp.uint32(0xFFFFFFFF)
+
+
+class HashSet(NamedTuple):
+    """Key planes of the open-addressing table; capacity is a power of two."""
+
+    key_hi: jax.Array  # uint32[capacity]
+    key_lo: jax.Array  # uint32[capacity]
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+
+def make_hashset(capacity: int) -> HashSet:
+    if capacity & (capacity - 1):
+        raise ValueError("capacity must be a power of two")
+    return HashSet(
+        key_hi=jnp.zeros((capacity,), _U32),
+        key_lo=jnp.zeros((capacity,), _U32),
+    )
+
+
+def home_slot(hi, lo, capacity: int):
+    """Initial probe slot for a key; a second independent mix of the 64-bit
+    fingerprint so table position isn't correlated with the key planes."""
+    return _fmix32(hi ^ _rotl(lo, 16) ^ _U32(0x7FEB352D)) & _U32(capacity - 1)
+
+
+def insert_batch(
+    table: HashSet, hi, lo, active
+) -> Tuple[HashSet, jax.Array, jax.Array, jax.Array]:
+    """Insert-if-absent a batch of keys (duplicates within the batch fine).
+
+    ``hi``/``lo``: uint32[B] fingerprints; ``active``: bool[B] lanes to
+    insert.
+
+    Returns ``(table, slot[B] uint32, is_new[B] bool, ok bool)``: ``slot``
+    is the key's table slot (for duplicates, the earlier winner's slot;
+    NO_SLOT for inactive lanes); ``is_new`` marks exactly one lane per
+    newly inserted key; ``ok`` is False if probing exhausted the table
+    (overfull — the engine resizes/raises long before).
+    """
+    capacity = table.capacity
+    mask = _U32(capacity - 1)
+    b = hi.shape[0]
+    lane = jnp.arange(b, dtype=_U32)
+    slot0 = home_slot(hi, lo, capacity)
+    max_rounds = 2 * capacity  # claim losers take two rounds per slot
+
+    def cond(carry):
+        _kh, _kl, _claim, _slot, done, _new, rounds = carry
+        return (~jnp.all(done)) & (rounds < max_rounds)
+
+    def body(carry):
+        kh, kl, claim, slot, done, is_new, rounds = carry
+        cur_hi = kh[slot]
+        cur_lo = kl[slot]
+        present = (cur_hi == hi) & (cur_lo == lo)
+        empty = (cur_hi == 0) & (cur_lo == 0)
+        found = ~done & present
+        want = ~done & empty
+        claim_idx = jnp.where(want, slot, _U32(capacity))
+        claim = claim.at[claim_idx].set(lane, mode="drop")
+        won = want & (claim[slot] == lane)
+        key_idx = jnp.where(won, slot, _U32(capacity))
+        kh = kh.at[key_idx].set(hi, mode="drop")
+        kl = kl.at[key_idx].set(lo, mode="drop")
+        done = done | found | won
+        # Occupied by a different key -> linear probe; claim losers RETRY the
+        # same slot so equal keys dedup against the winner next round.
+        advance = ~done & ~empty & ~present
+        slot = jnp.where(advance, (slot + _U32(1)) & mask, slot)
+        return kh, kl, claim, slot, done, is_new | won, rounds + 1
+
+    init = (
+        table.key_hi,
+        table.key_lo,
+        jnp.zeros((capacity,), _U32),
+        slot0,
+        ~active,
+        jnp.zeros((b,), jnp.bool_),
+        jnp.zeros((), jnp.int32),
+    )
+    kh, kl, _claim, slot, done, is_new, _rounds = jax.lax.while_loop(
+        cond, body, init
+    )
+    ok = jnp.all(done)
+    slot = jnp.where(active, slot, NO_SLOT)
+    return HashSet(kh, kl), slot, is_new, ok

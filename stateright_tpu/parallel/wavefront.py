@@ -1,0 +1,403 @@
+"""The TPU wavefront checker.
+
+This replaces the reference's thread-pool hot loop (pop job → evaluate
+properties → expand successors → dedup insert → push, src/checker/
+bfs.rs:177-335) with a *wavefront* BFS: the entire frontier is expanded at
+once by a vmapped step kernel, deduplicated by a batched insert-if-absent
+into an HBM-resident fingerprint table, and property conditions are fused
+predicates over the whole wave.  One jitted program per wave chunk; the
+host loop only orchestrates chunking, early exit, and discovery
+bookkeeping.
+
+Semantics parity with the host engine (core/engine.py):
+
+- properties are evaluated when a unique state is *expanded* (the analog of
+  pop-time evaluation), so states beyond ``target_max_depth`` or after an
+  early exit are never evaluated — matching src/checker/bfs.rs:230-281;
+- ``state_count`` counts boundary-passing generated successors pre-dedup
+  plus init states; ``unique_state_count`` counts table insertions;
+- eventually-bits travel with each table entry (parent's remaining bits),
+  are cleared by the state's own satisfied conditions at expansion, and
+  leftover bits at a terminal state (no valid successors) become
+  counterexamples; the reference's documented join false-negative (ebits
+  not part of the dedup key, src/checker/bfs.rs:295-315) is reproduced:
+  first inserter's bits win;
+- discoveries are first-writer-wins in deterministic wave order; paths are
+  reconstructed by walking the parent-slot chain, decoding packed states,
+  and re-executing the host model (core/path.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.checker import Checker
+from ..core.model import Expectation
+from ..core.path import Path
+from .compiled import CompiledModel, compiled_model_for
+
+NO_SLOT_HOST = 0xFFFFFFFF
+
+
+class TpuChecker(Checker):
+    """Single-device wavefront checker behind the standard Checker surface."""
+
+    def __init__(
+        self,
+        options,
+        capacity: int = 1 << 20,
+        chunk_size: int = 1 << 13,
+        device=None,
+        compiled: Optional[CompiledModel] = None,
+    ):
+        super().__init__(options.model)
+        import jax
+
+        if options._visitor is not None:
+            # The wavefront never materializes per-state paths during the
+            # run; failing beats silently skipping the visits spawn_bfs
+            # would have made.
+            raise ValueError(
+                "spawn_tpu() does not support visitors; use spawn_bfs()/"
+                "spawn_dfs() for visitor-instrumented runs"
+            )
+        self._options = options
+        self._compiled = compiled or compiled_model_for(options.model)
+        self._capacity = capacity
+        self._chunk = chunk_size
+        self._device = device or jax.devices()[0]
+        self._properties = self._model.properties()
+        if len(self._properties) > 32:
+            raise ValueError("at most 32 properties supported on device")
+        self._ev_indices = [
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation is Expectation.EVENTUALLY
+        ]
+        self._discovery_slots: Dict[str, int] = {}
+        self._state_count = 0
+        self._unique_count = 0
+        self._max_depth = 0
+        self._done = threading.Event()
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        self._tables_host: Optional[tuple] = None  # (parent, states) np arrays
+
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # --- device program ------------------------------------------------------
+
+    def _build_wave(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import HashSet, NO_SLOT, insert_batch
+
+        cm = self._compiled
+        w = cm.state_width
+        a = cm.max_actions
+        f = self._chunk
+        props = self._properties
+        n_props = len(props)
+        ev_indices = self._ev_indices
+        always_idx = [
+            i for i, p in enumerate(props) if p.expectation is Expectation.ALWAYS
+        ]
+        sometimes_idx = [
+            i for i, p in enumerate(props) if p.expectation is Expectation.SOMETIMES
+        ]
+        step = cm.step
+        prop_conds = cm.property_conds
+        boundary = cm.boundary
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def wave(key_hi, key_lo, store, parent, ebits, slots, count):
+            """Expand one frontier chunk.
+
+            key_hi/key_lo: uint32[capacity] fingerprint planes.
+            store: uint32[capacity, W] packed states; parent: uint32[capacity]
+            predecessor slots; ebits: uint32[capacity] remaining
+            eventually-bits.  slots: uint32[F] frontier chunk (table slots);
+            count: number of valid lanes.
+            """
+            lane = jnp.arange(f, dtype=jnp.uint32)
+            active = lane < count
+            safe_slots = jnp.where(active, slots, 0)
+            states = store[safe_slots]  # [F, W]
+
+            # Property evaluation at expansion (pop-time analog).
+            conds = jax.vmap(prop_conds)(states)  # [F, P]
+            cand = []
+            for p in range(n_props):
+                if p in always_idx:
+                    hit = active & ~conds[:, p]
+                elif p in sometimes_idx:
+                    hit = active & conds[:, p]
+                else:
+                    hit = jnp.zeros((f,), jnp.bool_)
+                idx = jnp.argmax(hit)
+                cand.append(jnp.where(jnp.any(hit), safe_slots[idx], NO_SLOT))
+            prop_cand = jnp.stack(cand) if cand else jnp.zeros((0,), jnp.uint32)
+
+            # Clear this state's own satisfied eventually bits.
+            eb = ebits[safe_slots]
+            for bit, p in enumerate(ev_indices):
+                eb = eb & ~(conds[:, p].astype(jnp.uint32) << bit)
+
+            # Successor expansion.
+            nexts, valid = jax.vmap(step)(states)  # [F, A, W], [F, A]
+            valid = valid & active[:, None]
+            if boundary(states[0]) is not None:
+                inb = jax.vmap(jax.vmap(boundary))(nexts)
+                valid = valid & inb
+            generated = jnp.sum(valid, dtype=jnp.uint32)
+
+            # Terminal frontier states with leftover ebits -> eventually
+            # counterexamples (src/checker/bfs.rs:326-333).
+            terminal = active & ~jnp.any(valid, axis=1)
+            ev_cand = []
+            for bit, _p in enumerate(ev_indices):
+                hit = terminal & (((eb >> bit) & 1) == 1)
+                idx = jnp.argmax(hit)
+                ev_cand.append(jnp.where(jnp.any(hit), safe_slots[idx], NO_SLOT))
+            ev_cand = (
+                jnp.stack(ev_cand) if ev_cand else jnp.zeros((0,), jnp.uint32)
+            )
+
+            # Dedup + insert.
+            flat = nexts.reshape(f * a, w)
+            flat_valid = valid.reshape(f * a)
+            par = jnp.repeat(safe_slots, a)
+            child_eb = jnp.repeat(eb, a)
+            hi, lo = device_fp64(flat)
+            table, slot, is_new, ok = insert_batch(
+                HashSet(key_hi, key_lo), hi, lo, flat_valid
+            )
+            sslot = jnp.where(is_new, slot, jnp.uint32(self._capacity))
+            store = store.at[sslot].set(flat, mode="drop")
+            parent = parent.at[sslot].set(par, mode="drop")
+            ebits = ebits.at[sslot].set(child_eb, mode="drop")
+
+            # Compact new slots to the front (stable: preserves wave order).
+            order = jnp.argsort(~is_new, stable=True)
+            new_slots = slot[order]
+            n_new = jnp.sum(is_new, dtype=jnp.uint32)
+            return (
+                table.key_hi,
+                table.key_lo,
+                store,
+                parent,
+                ebits,
+                new_slots,
+                n_new,
+                generated,
+                prop_cand,
+                ev_cand,
+                ok,
+            )
+
+        return wave
+
+    # --- host loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._check()
+        except BaseException as e:  # propagate at join, like the host engine
+            self._errors.append(e)
+        finally:
+            self._done.set()
+
+    def _check(self) -> None:
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import insert_batch, make_hashset
+
+        opts = self._options
+        cm = self._compiled
+        props = self._properties
+        cap = self._capacity
+        f = self._chunk
+        a = cm.max_actions
+        deadline = (
+            _time.monotonic() + opts._timeout if opts._timeout is not None else None
+        )
+
+        with jax.default_device(self._device):
+            table = make_hashset(cap)
+            store = jnp.zeros((cap, cm.state_width), jnp.uint32)
+            parent = jnp.full((cap,), NO_SLOT_HOST, jnp.uint32)
+            ebits = jnp.zeros((cap,), jnp.uint32)
+
+            # Seed init states.
+            init = cm.init_packed()
+            n_init = init.shape[0]
+            if n_init > f:
+                raise ValueError("more init states than chunk_size")
+            pad = np.zeros((f - n_init, cm.state_width), np.uint32)
+            init_padded = jnp.asarray(np.concatenate([init, pad]))
+            hi, lo = device_fp64(init_padded)
+            seed_active = jnp.arange(f) < n_init
+            table, slot, is_new, ok = insert_batch(table, hi, lo, seed_active)
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
+            store = store.at[sslot].set(init_padded, mode="drop")
+            eb0 = (1 << len(self._ev_indices)) - 1
+            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
+            order = jnp.argsort(~is_new, stable=True)
+            frontier = np.asarray(slot[order])[: int(jnp.sum(is_new))]
+
+            self._state_count = n_init
+            self._unique_count = len(frontier)
+
+            wave = self._build_wave()
+            depth = 0
+            key_hi, key_lo = table.key_hi, table.key_lo
+
+            while len(frontier) > 0:
+                depth += 1
+                with self._lock:
+                    self._max_depth = depth
+                if (
+                    opts._target_max_depth is not None
+                    and depth >= opts._target_max_depth
+                ):
+                    break
+                if deadline is not None and _time.monotonic() >= deadline:
+                    break
+
+                next_frontier: List[np.ndarray] = []
+                stop = False
+                for off in range(0, len(frontier), f):
+                    chunk = frontier[off : off + f]
+                    n = len(chunk)
+                    chunk = np.pad(chunk, (0, f - n)).astype(np.uint32)
+                    (
+                        key_hi,
+                        key_lo,
+                        store,
+                        parent,
+                        ebits,
+                        new_slots,
+                        n_new,
+                        generated,
+                        prop_cand,
+                        ev_cand,
+                        ok,
+                    ) = wave(
+                        key_hi,
+                        key_lo,
+                        store,
+                        parent,
+                        ebits,
+                        jnp.asarray(chunk),
+                        jnp.uint32(n),
+                    )
+                    if not bool(ok):
+                        raise RuntimeError(
+                            f"fingerprint table overfull (capacity {cap}); "
+                            "raise spawn_tpu(capacity=...)"
+                        )
+                    n_new_i = int(n_new)
+                    with self._lock:
+                        self._state_count += int(generated)
+                        self._unique_count += n_new_i
+                    if n_new_i:
+                        next_frontier.append(np.asarray(new_slots[:n_new_i]))
+                    # First-writer-wins discovery bookkeeping, deterministic
+                    # in wave order.
+                    prop_cand_h = np.asarray(prop_cand)
+                    for p, prop in enumerate(props):
+                        if prop.expectation is Expectation.EVENTUALLY:
+                            continue
+                        s = int(prop_cand_h[p])
+                        if s != NO_SLOT_HOST:
+                            with self._lock:
+                                self._discovery_slots.setdefault(prop.name, s)
+                    ev_cand_h = np.asarray(ev_cand)
+                    for bit, p in enumerate(self._ev_indices):
+                        s = int(ev_cand_h[bit])
+                        if s != NO_SLOT_HOST:
+                            with self._lock:
+                                self._discovery_slots.setdefault(props[p].name, s)
+
+                    if self._unique_count > cap // 2:
+                        raise RuntimeError(
+                            f"fingerprint table beyond 50% load (capacity {cap});"
+                            " raise spawn_tpu(capacity=...)"
+                        )
+                    if opts._finish_when.matches(
+                        frozenset(self._discovery_slots), props
+                    ):
+                        stop = True
+                        break
+                    if (
+                        opts._target_state_count is not None
+                        and opts._target_state_count <= self._state_count
+                    ):
+                        stop = True
+                        break
+                    if deadline is not None and _time.monotonic() >= deadline:
+                        stop = True
+                        break
+                if stop:
+                    break
+                frontier = (
+                    np.concatenate(next_frontier)
+                    if next_frontier
+                    else np.zeros((0,), np.uint32)
+                )
+
+            # Pull what path reconstruction needs to the host once.
+            self._tables_host = (np.asarray(parent), np.asarray(store))
+
+    # --- Checker surface -----------------------------------------------------
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def _slot_path(self, slot: int) -> Path:
+        parent, store = self._tables_host
+        chain: List[int] = []
+        s = slot
+        while s != NO_SLOT_HOST:
+            chain.append(s)
+            s = int(parent[s])
+        chain.reverse()
+        fps = [
+            self._model.fingerprint(self._compiled.decode(store[s])) for s in chain
+        ]
+        return Path.from_fingerprints(self._model, fps)
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        with self._lock:
+            items = list(self._discovery_slots.items())
+        return {name: self._slot_path(slot) for name, slot in items}
+
+    def handles(self) -> List[threading.Thread]:
+        return [self._thread]
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def join(self) -> "TpuChecker":
+        self._thread.join()
+        if self._errors:
+            raise self._errors[0]
+        return self

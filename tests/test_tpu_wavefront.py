@@ -1,0 +1,249 @@
+"""TPU wavefront checker: device fingerprint, device hash set, compiled-model
+step parity, and golden-count/discovery-set equivalence with the host oracle.
+
+The decisive test per SURVEY §4: CPU and TPU checkers must produce identical
+discovery sets and unique-state counts on the BASELINE configs.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu import Model, Property  # noqa: E402
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from stateright_tpu.ops.device_fp import device_fp64  # noqa: E402
+from stateright_tpu.parallel.compiled import CompiledModel  # noqa: E402
+from stateright_tpu.ops.fingerprint import fp64_words  # noqa: E402
+from stateright_tpu.parallel.hashset import (  # noqa: E402
+    insert_batch,
+    make_hashset,
+)
+
+
+def test_device_fp_matches_host():
+    rng = np.random.default_rng(7)
+    for width in (1, 2, 3, 5):
+        words = rng.integers(0, 2**32, size=(32, width), dtype=np.uint32)
+        hi, lo = device_fp64(jnp.asarray(words))
+        for i in range(32):
+            host = fp64_words(words[i].tolist())
+            assert ((int(hi[i]) << 32) | int(lo[i])) == host
+
+
+def test_device_fp_nonzero():
+    # The nonzero rule exists so (0,0) can mark empty hash slots.
+    words = jnp.zeros((4, 2), jnp.uint32)
+    hi, lo = device_fp64(words)
+    assert all((int(h) | int(l)) != 0 for h, l in zip(hi, lo))
+
+
+def test_hashset_insert_matches_python_set():
+    rng = np.random.default_rng(3)
+    table = make_hashset(1 << 11)
+    seen = set()
+    for _ in range(6):
+        # Narrow key range forces duplicates within and across batches.
+        keys = rng.integers(1, 2**13, size=192, dtype=np.uint64)
+        hi = jnp.asarray((keys >> 32).astype(np.uint32))
+        lo = jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32))
+        active = jnp.asarray(rng.random(192) < 0.9)
+        table, slot, is_new, ok = insert_batch(table, hi, lo, active)
+        assert bool(ok)
+        active_np = np.asarray(active)
+        inserted = {int(k) for k, a in zip(keys, active_np) if a}
+        assert int(jnp.sum(is_new)) == len(inserted - seen)
+        # All active lanes of one key agree on the slot.
+        slots = np.asarray(slot)
+        by_key = {}
+        for i, k in enumerate(keys):
+            if active_np[i]:
+                by_key.setdefault(int(k), set()).add(int(slots[i]))
+        assert all(len(s) == 1 for s in by_key.values())
+        seen |= inserted
+
+
+@pytest.fixture(scope="module")
+def twophase3():
+    return TwoPhaseSys(rm_count=3)
+
+
+def _reachable(model):
+    from collections import deque
+
+    seen, order, q = set(), [], deque(model.init_states())
+    while q:
+        s = q.popleft()
+        if s in seen:
+            continue
+        seen.add(s)
+        order.append(s)
+        q.extend(ns for ns in model.next_states(s) if ns not in seen)
+    return order
+
+
+def test_twophase_encode_decode_roundtrip(twophase3):
+    cm = twophase3.compiled()
+    for s in _reachable(twophase3):
+        assert cm.decode(cm.encode(s)) == s
+
+
+def test_twophase_step_parity(twophase3):
+    """Device successors == host successors on every reachable state."""
+    cm = twophase3.compiled()
+    states = _reachable(twophase3)
+    enc = jnp.asarray(np.stack([cm.encode(s) for s in states]))
+    nexts, valid = jax.jit(jax.vmap(cm.step))(enc)
+    nexts, valid = np.asarray(nexts), np.asarray(valid)
+    for i, s in enumerate(states):
+        host = sorted(cm.encode(ns).tobytes() for ns in twophase3.next_states(s))
+        dev = sorted(
+            nexts[i, j].tobytes() for j in range(cm.max_actions) if valid[i, j]
+        )
+        assert host == dev
+
+
+def test_twophase_property_conds_parity(twophase3):
+    cm = twophase3.compiled()
+    props = twophase3.properties()
+    states = _reachable(twophase3)
+    enc = jnp.asarray(np.stack([cm.encode(s) for s in states]))
+    conds = np.asarray(jax.jit(jax.vmap(cm.property_conds))(enc))
+    for i, s in enumerate(states):
+        for p, prop in enumerate(props):
+            assert bool(conds[i, p]) == bool(prop.condition(twophase3, s))
+
+
+def _assert_checker_parity(model, **tpu_kwargs):
+    host = model.checker().spawn_bfs().join()
+    tpu = model.checker().spawn_tpu(**tpu_kwargs).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
+    hd, td = host.discoveries(), tpu.discoveries()
+    assert sorted(td) == sorted(hd)
+    # Paths re-execute the host model, so building them validates them.
+    for name, path in td.items():
+        assert len(path) >= 1
+    return host, tpu
+
+
+def test_twophase3_golden_tpu(twophase3):
+    """2pc with 3 RMs: 288 unique states (reference examples/2pc.rs:153-154),
+    identical counts and discovery set between host BFS and TPU wavefront."""
+    _host, tpu = _assert_checker_parity(
+        twophase3, capacity=1 << 14, chunk_size=1 << 9
+    )
+    assert tpu.unique_state_count() == 288
+
+
+@pytest.mark.slow
+def test_twophase5_golden_tpu():
+    """2pc with 5 RMs: 8,832 unique states (examples/2pc.rs:158-159)."""
+    model = TwoPhaseSys(rm_count=5)
+    _host, tpu = _assert_checker_parity(
+        model, capacity=1 << 15, chunk_size=1 << 11
+    )
+    assert tpu.unique_state_count() == 8832
+
+
+# --- eventually-property machinery on device ---------------------------------
+
+
+class TrapCounter(Model):
+    """0 →inc→ 1 → … → limit, with a dead-end trap edge at ``trap_at``.
+
+    Exercises the full eventually pipeline: "reaches one" is satisfied along
+    every path (bit cleared mid-path, never reported); "reaches limit" has a
+    genuine counterexample ending in the trap terminal state.
+    """
+
+    def __init__(self, limit=5, trap_at=2):
+        self.limit = limit
+        self.trap_at = trap_at
+        self.trap_state = limit + 1
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        if state < self.limit:
+            actions.append("inc")
+        if state == self.trap_at:
+            actions.append("trap")
+
+    def next_state(self, state, action):
+        return state + 1 if action == "inc" else self.trap_state
+
+    def properties(self):
+        return [
+            Property.eventually("reaches one", lambda _m, s: s >= 1),
+            Property.eventually(
+                "reaches limit", lambda _m, s: s == self.limit
+            ),
+            Property.sometimes(
+                "trapped", lambda _m, s: s == self.trap_state
+            ),
+        ]
+
+    def compiled(self):
+        return TrapCounterCompiled(self)
+
+
+class TrapCounterCompiled(CompiledModel):
+    state_width = 1
+    max_actions = 2
+
+    def __init__(self, model):
+        self.model = model
+
+    def encode(self, state):
+        return np.array([state], np.uint32)
+
+    def decode(self, words):
+        return int(words[0])
+
+    def step(self, state):
+        n = state[0]
+        limit = jnp.uint32(self.model.limit)
+        inc = jnp.stack([n + jnp.uint32(1)])
+        trap = jnp.stack([jnp.uint32(self.model.trap_state)])
+        nexts = jnp.stack([inc, trap])
+        valid = jnp.stack(
+            [n < limit, n == jnp.uint32(self.model.trap_at)]
+        )
+        return nexts, valid
+
+    def property_conds(self, state):
+        n = state[0]
+        return jnp.stack(
+            [
+                n >= jnp.uint32(1),
+                n == jnp.uint32(self.model.limit),
+                n == jnp.uint32(self.model.trap_state),
+            ]
+        )
+
+
+def test_eventually_parity_with_host():
+    model = TrapCounter()
+    host, tpu = _assert_checker_parity(
+        model, capacity=1 << 8, chunk_size=1 << 4
+    )
+    names = sorted(tpu.discoveries())
+    # "reaches one" holds on every path: no counterexample. "reaches limit"
+    # is violated via the trap dead end; "trapped" is observed.
+    assert names == ["reaches limit", "trapped"]
+    ce = tpu.discoveries()["reaches limit"]
+    assert ce.last_state() == model.trap_state
+
+
+def test_eventually_satisfied_at_terminal_not_reported():
+    # Without the trap edge every path ends at `limit`, satisfying the
+    # property at the terminal state itself — the bit clears before the
+    # terminal check, so no counterexample (src/checker/bfs.rs:326-333).
+    model = TrapCounter(trap_at=10**6)
+    tpu = model.checker().spawn_tpu(capacity=1 << 8, chunk_size=1 << 4).join()
+    assert "reaches limit" not in tpu.discoveries()
+    assert "reaches one" not in tpu.discoveries()
