@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Driver benchmark: TPU wavefront checking throughput vs host BFS.
+
+Workload: exhaustive check of two-phase commit with 7 resource managers
+(296,448 unique states, golden count scaled from examples/2pc.rs:151-170) —
+the largest 2pc config whose host-oracle denominator is still measurable in
+a bounded time slice.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+where value is unique-states/sec of the TPU wavefront checker (warm,
+compile cached) and vs_baseline is the ratio to the host thread-pool BFS
+(the reference-style engine, measured on this machine per BASELINE.md).
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parent
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_REPO / ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+sys.path.insert(0, str(_REPO))
+
+RM_COUNT = 7
+GOLDEN_UNIQUE = 296_448
+HOST_TIME_SLICE = 30.0  # seconds of host BFS to establish the denominator
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    model = TwoPhaseSys(rm_count=RM_COUNT)
+    kwargs = dict(capacity=1 << 20, max_frontier=1 << 16)
+
+    import jax
+
+    log(f"device: {jax.devices()[0]}")
+
+    log("warming TPU program (compile)...")
+    t0 = time.time()
+    model.checker().spawn_tpu(**kwargs).join()
+    log(f"  warm run: {time.time() - t0:.1f}s")
+
+    t0 = time.time()
+    checker = model.checker().spawn_tpu(**kwargs).join()
+    tpu_dt = time.time() - t0
+    unique = checker.unique_state_count()
+    if unique != GOLDEN_UNIQUE:
+        log(f"WARNING: unique={unique} != golden {GOLDEN_UNIQUE}")
+    tpu_rate = unique / tpu_dt
+    log(
+        f"tpu: {unique} unique in {tpu_dt:.2f}s = {tpu_rate:.0f} uniq/s "
+        f"(states={checker.state_count()}, depth={checker.max_depth()})"
+    )
+
+    log(f"host BFS denominator ({HOST_TIME_SLICE:.0f}s slice)...")
+    t0 = time.time()
+    host = model.checker().timeout(HOST_TIME_SLICE).spawn_bfs().join()
+    host_dt = time.time() - t0
+    host_rate = host.unique_state_count() / host_dt
+    log(
+        f"host: {host.unique_state_count()} unique in {host_dt:.2f}s = "
+        f"{host_rate:.0f} uniq/s"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"2pc{RM_COUNT}_unique_states_per_sec",
+                "value": round(tpu_rate, 1),
+                "unit": "unique states/sec",
+                "vs_baseline": round(tpu_rate / host_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -106,6 +106,19 @@ class CheckerBuilder:
 
         return TpuChecker(self, **kwargs)
 
+    def spawn_tpu_sharded(self, **kwargs) -> "Checker":
+        """Spawn the multi-chip wavefront checker: frontier and visited set
+        sharded over a ``jax.sharding.Mesh`` by fingerprint ownership, with
+        an all_to_all successor exchange per wave and psum termination —
+        the ICI-collective replacement for the reference's job market
+        (src/job_market.rs; SURVEY §2.7)."""
+        self._require(
+            "stateright_tpu.parallel.sharded", "sharded TPU wavefront checker"
+        )
+        from ..parallel.sharded import ShardedTpuChecker
+
+        return ShardedTpuChecker(self, **kwargs)
+
     def serve(self, address) -> "Checker":
         self._require("stateright_tpu.explorer.server", "explorer server")
         from ..explorer.server import serve

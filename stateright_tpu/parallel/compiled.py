@@ -83,6 +83,20 @@ class CompiledModel:
         (default) means the model is unbounded / bounded by encoding."""
         return None
 
+    def cache_key(self) -> tuple:
+        """Key under which compiled device programs are shared across
+        checker instances.  Must uniquely determine device behavior: two
+        compiled models with equal keys must trace identical programs.
+        The default covers models whose ``repr`` captures their full
+        configuration (e.g. frozen dataclasses); others get per-instance
+        keys (correct, just no sharing)."""
+        return (
+            type(self).__qualname__,
+            self.state_width,
+            self.max_actions,
+            repr(self.model),
+        )
+
     # --- hybrid properties ---------------------------------------------------
 
     @property

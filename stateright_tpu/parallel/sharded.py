@@ -1,0 +1,501 @@
+"""Multi-chip wavefront checking: frontier + visited set sharded over a mesh.
+
+The reference scales with OS threads sharing one DashMap and a job market
+(src/job_market.rs, SURVEY §2.7).  The TPU-native analog shards *both* the
+frontier and the fingerprint table across chips by fingerprint ownership:
+
+- every fingerprint has one owner shard (a second hash of the fp modulo the
+  mesh size), so a local insert on the owner IS the global dedup — no
+  cross-chip locking, the moral equivalent of DashMap's hash-sharded locks;
+- each wave, every chip expands its local frontier, buckets the successor
+  candidates by owner, and exchanges them with a single ``all_to_all`` over
+  ICI — the collective replacement for the job market's split_and_push;
+- termination and counts are ``psum`` reductions: the frontier is globally
+  empty exactly when every shard's insert produced nothing new.
+
+Parent links cross shards, so table entries store a *global id*
+(shard << slot_bits | slot); the host walks these across the stacked
+per-shard tables for path reconstruction.
+
+Hash-random ownership keeps shards statistically balanced (the job-market
+rebalancing analog); skew shows up only as idle lanes in a chunked wave.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.checker import Checker
+from ..core.model import Expectation
+from ..core.path import Path
+from .compiled import CompiledModel, compiled_model_for
+
+NO_GID = 0xFFFFFFFF
+
+
+def _owner_mix(hi, lo):
+    import jax.numpy as jnp
+
+    from ..ops.device_fp import _fmix32, _rotl
+
+    # Independent of both the key planes and the slot hash.
+    return _fmix32(lo ^ _rotl(hi, 7) ^ jnp.uint32(0xA511E9B3))
+
+
+class ShardedTpuChecker(Checker):
+    """Wavefront checker running one program per mesh device via shard_map."""
+
+    def __init__(
+        self,
+        options,
+        mesh=None,
+        capacity: int = 1 << 20,
+        chunk_size: int = 1 << 11,
+        dedup_factor: int = 4,
+        compiled: Optional[CompiledModel] = None,
+    ):
+        super().__init__(options.model)
+        import jax
+
+        if options._visitor is not None:
+            raise ValueError("spawn_tpu_sharded() does not support visitors")
+        self._options = options
+        self._compiled = compiled or compiled_model_for(options.model)
+        if mesh is None:
+            mesh = jax.sharding.Mesh(np.array(jax.devices()), ("shards",))
+        self._mesh = mesh
+        self._n = mesh.devices.size
+        # Per-shard capacity: the largest power of two fitting the budget
+        # (open addressing needs a power of two; the mesh size need not be).
+        self._cap_s = 1 << max(capacity // self._n, 1 << 10).bit_length() - 1
+        self._slot_bits = self._cap_s.bit_length() - 1
+        # Global ids are shard << slot_bits | slot in one uint32; strict
+        # < 32 keeps the all-ones NO_GID sentinel unreachable and the shift
+        # from wrapping (shard bits must cover shard n-1, so ceil(log2 n)).
+        if self._slot_bits + max(self._n - 1, 1).bit_length() >= 32:
+            raise ValueError("capacity too large for 32-bit global ids")
+        self._chunk = chunk_size
+        self._dedup_factor = dedup_factor
+        self._properties = self._model.properties()
+        self._ev_indices = [
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation is Expectation.EVENTUALLY
+        ]
+        self._discovery_gids: Dict[str, int] = {}
+        self._state_count = 0
+        self._unique_count = 0
+        self._max_depth = 0
+        self._done = threading.Event()
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        self._tables_host: Optional[tuple] = None
+
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # --- device program ------------------------------------------------------
+
+    def _build_wave(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import HashSet, insert_batch
+        from .wave_common import wave_eval
+
+        cm = self._compiled
+        w = cm.state_width
+        a = cm.max_actions
+        f = self._chunk
+        n = self._n
+        cap_s = self._cap_s
+        slot_bits = self._slot_bits
+        props = self._properties
+        n_props = len(props)
+        ev_indices = self._ev_indices
+        dedup_factor = self._dedup_factor
+        b = f * a  # per-shard candidate lanes; also the exchange bucket size
+
+        def wave_shard(key_hi, key_lo, store, parent, ebits, slots, count):
+            """One wave on one shard.  Shapes: per-shard views."""
+            me = jax.lax.axis_index("shards").astype(jnp.uint32)
+            lane = jnp.arange(f, dtype=jnp.uint32)
+            active = lane < count[0]
+            safe_slots = jnp.where(active, slots, 0)
+            states = store[safe_slots]
+
+            # Shared expansion-time evaluation; ids are global this time.
+            my_gids = (me << jnp.uint32(slot_bits)) | safe_slots
+            disc0 = jnp.full((n_props,), NO_GID, jnp.uint32) | (me & 0)
+            cand, eb, nexts, valid, gen_local = wave_eval(
+                cm, props, ev_indices, states, active, my_gids,
+                ebits[safe_slots], disc0,
+            )
+            generated = jax.lax.psum(gen_local, "shards")
+
+            # Bucket candidates by owner shard and exchange over ICI.
+            flat = nexts.reshape(b, w)
+            flat_valid = valid.reshape(b)
+            par_gid = jnp.repeat(my_gids, a)
+            child_eb = jnp.repeat(eb, a)
+            hi, lo = device_fp64(flat)
+            owner = _owner_mix(hi, lo) % jnp.uint32(n)
+            key = jnp.where(flat_valid, owner, jnp.uint32(n))
+            order = jnp.argsort(key, stable=True)
+            key_s = key[order]
+            counts = jnp.zeros((n + 1,), jnp.uint32).at[key].add(1)
+            offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.uint32), jnp.cumsum(counts)[:-1]]
+            )
+            pos = jnp.arange(b, dtype=jnp.uint32) - offsets[key_s]
+            dst = jnp.where(key_s < n, key_s, jnp.uint32(n))  # drop invalid
+
+            send_words = jnp.zeros((n, b, w), jnp.uint32)
+            send_words = send_words.at[dst, pos].set(flat[order], mode="drop")
+            send_gid = jnp.full((n, b), NO_GID, jnp.uint32)
+            send_gid = send_gid.at[dst, pos].set(par_gid[order], mode="drop")
+            send_eb = jnp.zeros((n, b), jnp.uint32)
+            send_eb = send_eb.at[dst, pos].set(child_eb[order], mode="drop")
+            send_valid = jnp.zeros((n, b), jnp.bool_)
+            send_valid = send_valid.at[dst, pos].set(
+                flat_valid[order], mode="drop"
+            )
+
+            recv_words = jax.lax.all_to_all(
+                send_words, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+            recv_gid = jax.lax.all_to_all(
+                send_gid, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+            recv_eb = jax.lax.all_to_all(
+                send_eb, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+            recv_valid = jax.lax.all_to_all(
+                send_valid, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+
+            # Local insert — the owner's insert IS the global dedup.
+            rw = recv_words.reshape(n * b, w)
+            rv = recv_valid.reshape(n * b)
+            rg = recv_gid.reshape(n * b)
+            reb = recv_eb.reshape(n * b)
+            rhi, rlo = device_fp64(rw)
+            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+                HashSet(key_hi, key_lo), rhi, rlo, rv,
+                dedup_factor=dedup_factor,
+            )
+            ok = probe_ok & ~dd_overflow
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
+            store = store.at[sslot].set(rw, mode="drop")
+            parent = parent.at[sslot].set(rg, mode="drop")
+            ebits = ebits.at[sslot].set(reb, mode="drop")
+
+            pos2 = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
+            fidx2 = jnp.where(is_new, pos2, jnp.uint32(f * a))
+            new_slots = jnp.zeros((f * a,), jnp.uint32).at[fidx2].set(
+                slot, mode="drop"
+            )
+            n_new_local = jnp.sum(is_new, dtype=jnp.uint32)
+            n_new_global = jax.lax.psum(n_new_local, "shards")
+            ok_global = jax.lax.psum(ok.astype(jnp.uint32), "shards") == n
+            return (
+                table.key_hi,
+                table.key_lo,
+                store,
+                parent,
+                ebits,
+                new_slots[: f * a],
+                n_new_local[None],
+                n_new_global[None],
+                generated[None],
+                cand,
+                ok_global[None],
+            )
+
+        shard = P("shards")
+        specs_table = (shard, shard, shard, shard, shard)
+        wave = jax.jit(
+            jax.shard_map(
+                wave_shard,
+                mesh=self._mesh,
+                in_specs=specs_table + (shard, shard),
+                out_specs=(
+                    specs_table + (shard, shard, shard, shard, shard, shard)
+                ),
+            ),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
+        return wave
+
+    # --- host loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._check()
+        except BaseException as e:
+            self._errors.append(e)
+        finally:
+            self._done.set()
+
+    def _check(self) -> None:
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import insert_batch
+
+        opts = self._options
+        cm = self._compiled
+        props = self._properties
+        n = self._n
+        cap_s = self._cap_s
+        f = self._chunk
+        deadline = (
+            _time.monotonic() + opts._timeout if opts._timeout is not None else None
+        )
+
+        # Global (host-side numpy) views of the stacked per-shard tables are
+        # only pulled at the end; during the run everything stays sharded.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(self._mesh, P("shards"))
+
+        def sharded_zeros(shape, dtype, fill=0):
+            arr = jnp.full(shape, fill, dtype)
+            return jax.device_put(arr, shard)
+
+        key_hi = sharded_zeros((n * cap_s,), jnp.uint32)
+        key_lo = sharded_zeros((n * cap_s,), jnp.uint32)
+        store = sharded_zeros((n * cap_s, cm.state_width), jnp.uint32)
+        parent = sharded_zeros((n * cap_s,), jnp.uint32, NO_GID)
+        ebits = sharded_zeros((n * cap_s,), jnp.uint32)
+
+        # Seed init states host-side: compute owners with the same mix and
+        # place each init state in its owner's slice of a seeding program.
+        init = cm.init_packed()
+        n_init = init.shape[0]
+        ih, il = (np.asarray(x) for x in device_fp64(jnp.asarray(init)))
+        owner = np.asarray(
+            _owner_mix(jnp.asarray(ih), jnp.asarray(il))
+        ) % np.uint32(n)
+        eb0 = (1 << len(self._ev_indices)) - 1
+
+        # Per-shard seed batches, padded to a common width.
+        seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
+        seed_states = np.zeros((n, seed_w, cm.state_width), np.uint32)
+        seed_valid = np.zeros((n, seed_w), bool)
+        for d in range(n):
+            idx = np.flatnonzero(owner == d)
+            seed_states[d, : len(idx)] = init[idx]
+            seed_valid[d, : len(idx)] = True
+
+        from .hashset import HashSet
+
+        def seed_shard(key_hi, key_lo, store, ebits, states, valid):
+            sts = states[0]
+            val = valid[0]
+            hi, lo = device_fp64(sts)
+            table, slot, is_new, _probe_ok, _dd_overflow = insert_batch(
+                HashSet(key_hi, key_lo), hi, lo, val
+            )
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
+            store = store.at[sslot].set(sts, mode="drop")
+            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
+            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
+            fidx = jnp.where(is_new, pos, jnp.uint32(is_new.shape[0]))
+            compacted = jnp.zeros_like(slot).at[fidx].set(slot, mode="drop")
+            return (
+                table.key_hi,
+                table.key_lo,
+                store,
+                ebits,
+                compacted,
+                jnp.sum(is_new, dtype=jnp.uint32)[None],
+            )
+
+        sp = P("shards")
+        seed = jax.jit(
+            jax.shard_map(
+                seed_shard,
+                mesh=self._mesh,
+                in_specs=(sp, sp, sp, sp, sp, sp),
+                out_specs=(sp, sp, sp, sp, sp, sp),
+            ),
+            donate_argnums=(0, 1, 2, 3),
+        )
+        key_hi, key_lo, store, ebits, seed_slots, seed_counts = seed(
+            key_hi,
+            key_lo,
+            store,
+            ebits,
+            jax.device_put(jnp.asarray(seed_states), shard),
+            jax.device_put(jnp.asarray(seed_valid), shard),
+        )
+        seed_slots = np.asarray(seed_slots).reshape(n, seed_w)
+        seed_counts = np.asarray(seed_counts).reshape(n)
+        frontiers = [seed_slots[d, : seed_counts[d]] for d in range(n)]
+
+        self._state_count = n_init
+        self._unique_count = int(seed_counts.sum())
+
+        wave = self._build_wave()
+        depth = 0
+
+        while any(len(fr) for fr in frontiers):
+            depth += 1
+            with self._lock:
+                self._max_depth = depth
+            if (
+                opts._target_max_depth is not None
+                and depth >= opts._target_max_depth
+            ):
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+
+            next_frontiers: List[List[np.ndarray]] = [[] for _ in range(n)]
+            stop = False
+            n_chunks = max(
+                (len(fr) + f - 1) // f for fr in frontiers
+            ) or 1
+            for ci in range(n_chunks):
+                slots_np = np.zeros((n, f), np.uint32)
+                counts_np = np.zeros((n, 1), np.uint32)
+                for d in range(n):
+                    chunk = frontiers[d][ci * f : (ci + 1) * f]
+                    slots_np[d, : len(chunk)] = chunk
+                    counts_np[d, 0] = len(chunk)
+                (
+                    key_hi,
+                    key_lo,
+                    store,
+                    parent,
+                    ebits,
+                    new_slots,
+                    n_new_local,
+                    n_new_global,
+                    generated,
+                    cand,
+                    ok,
+                ) = wave(
+                    key_hi,
+                    key_lo,
+                    store,
+                    parent,
+                    ebits,
+                    jax.device_put(jnp.asarray(slots_np.reshape(-1)), shard),
+                    jax.device_put(jnp.asarray(counts_np.reshape(-1)), shard),
+                )
+                ok_h = np.asarray(ok).reshape(n)
+                if not ok_h.all():
+                    raise RuntimeError(
+                        f"sharded fingerprint table overfull (per-shard "
+                        f"capacity {cap_s}); raise capacity"
+                    )
+                n_new_local_h = np.asarray(n_new_local).reshape(n)
+                new_slots_h = np.asarray(new_slots).reshape(n, -1)
+                if (n_new_local_h > new_slots_h.shape[1]).any():
+                    raise RuntimeError(
+                        "per-shard wave produced more new states than the "
+                        "frontier buffer holds; raise chunk_size"
+                    )
+                for d in range(n):
+                    if n_new_local_h[d]:
+                        next_frontiers[d].append(
+                            new_slots_h[d, : n_new_local_h[d]]
+                        )
+                with self._lock:
+                    self._state_count += int(np.asarray(generated)[0])
+                    self._unique_count += int(n_new_local_h.sum())
+                cand_h = np.asarray(cand).reshape(n, -1)
+                for d in range(n):
+                    for p, prop in enumerate(props):
+                        g = int(cand_h[d, p])
+                        if g != NO_GID:
+                            with self._lock:
+                                self._discovery_gids.setdefault(prop.name, g)
+                if self._unique_count > (n * cap_s) // 2:
+                    raise RuntimeError(
+                        "sharded fingerprint table beyond 50% load; raise "
+                        "capacity"
+                    )
+                if opts._finish_when.matches(
+                    frozenset(self._discovery_gids), props
+                ):
+                    stop = True
+                    break
+                if (
+                    opts._target_state_count is not None
+                    and opts._target_state_count <= self._state_count
+                ):
+                    stop = True
+                    break
+                if deadline is not None and _time.monotonic() >= deadline:
+                    stop = True
+                    break
+            if stop:
+                break
+            frontiers = [
+                np.concatenate(nf) if nf else np.zeros((0,), np.uint32)
+                for nf in next_frontiers
+            ]
+
+        self._tables_host = (
+            np.asarray(parent).reshape(n, cap_s),
+            np.asarray(store).reshape(n, cap_s, cm.state_width),
+        )
+
+    # --- Checker surface -----------------------------------------------------
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def _gid_path(self, gid: int) -> Path:
+        parent, store = self._tables_host
+        chain: List[int] = []
+        g = gid
+        while g != NO_GID:
+            chain.append(g)
+            g = int(parent[g >> self._slot_bits, g & (self._cap_s - 1)])
+        chain.reverse()
+        fps = [
+            self._model.fingerprint(
+                self._compiled.decode(
+                    store[g >> self._slot_bits, g & (self._cap_s - 1)]
+                )
+            )
+            for g in chain
+        ]
+        return Path.from_fingerprints(self._model, fps)
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        with self._lock:
+            items = list(self._discovery_gids.items())
+        return {name: self._gid_path(g) for name, g in items}
+
+    def handles(self) -> List[threading.Thread]:
+        return [self._thread]
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def join(self) -> "ShardedTpuChecker":
+        self._thread.join()
+        if self._errors:
+            raise self._errors[0]
+        return self

@@ -1,0 +1,79 @@
+"""Wave-evaluation logic shared by the single-device and sharded engines.
+
+Both engines evaluate the frontier the same way — property conditions at
+expansion time (the pop-time analog of src/checker/bfs.rs:230-281),
+eventually-bit clearing, successor expansion, and terminal
+eventually-counterexample detection — differing only in how a state is
+identified (a table slot on one device, a shard<<bits|slot global id across
+a mesh).  Keeping it in one place keeps the two engines' discovery
+semantics from diverging.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from ..core.model import Expectation
+
+NO_ID = 0xFFFFFFFF
+
+
+class WaveEval(NamedTuple):
+    disc_cand: object  # uint32[P] candidate state-id per property (NO_ID none)
+    eb: object  # uint32[F] eventually-bits after this state's own clears
+    nexts: object  # uint32[F, A, W] successor candidates
+    valid: object  # bool[F, A]
+    generated: object  # uint32 scalar: local boundary-passing successors
+
+
+def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
+    """The shared wave step (minus dedup/insert, which differs per engine).
+
+    Returns :class:`WaveEval` with ``disc`` already folded (first-writer-
+    wins against the incoming ``disc`` vector).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_props = len(props)
+    always_idx = {
+        i for i, p in enumerate(props) if p.expectation is Expectation.ALWAYS
+    }
+    sometimes_idx = {
+        i for i, p in enumerate(props) if p.expectation is Expectation.SOMETIMES
+    }
+
+    conds = jax.vmap(cm.property_conds)(states)  # [F, P]
+    for p in range(n_props):
+        if p in always_idx:
+            hit = active & ~conds[:, p]
+        elif p in sometimes_idx:
+            hit = active & conds[:, p]
+        else:
+            continue
+        idx = jnp.argmax(hit)
+        cand = jnp.where(jnp.any(hit), ids[idx], jnp.uint32(NO_ID))
+        disc = disc.at[p].set(jnp.where(disc[p] == jnp.uint32(NO_ID), cand, disc[p]))
+
+    # Clear this state's own satisfied eventually bits.
+    eb = eb_in
+    for bit, p in enumerate(ev_indices):
+        eb = eb & ~(conds[:, p].astype(jnp.uint32) << bit)
+
+    # Successor expansion.
+    nexts, valid = jax.vmap(cm.step)(states)  # [F, A, W], [F, A]
+    valid = valid & active[:, None]
+    if cm.boundary(states[0]) is not None:
+        valid = valid & jax.vmap(jax.vmap(cm.boundary))(nexts)
+    generated = jnp.sum(valid, dtype=jnp.uint32)
+
+    # Terminal frontier states with leftover ebits -> eventually
+    # counterexamples (src/checker/bfs.rs:326-333).
+    terminal = active & ~jnp.any(valid, axis=1)
+    for bit, p in enumerate(ev_indices):
+        hit = terminal & (((eb >> bit) & 1) == 1)
+        idx = jnp.argmax(hit)
+        cand = jnp.where(jnp.any(hit), ids[idx], jnp.uint32(NO_ID))
+        disc = disc.at[p].set(jnp.where(disc[p] == jnp.uint32(NO_ID), cand, disc[p]))
+
+    return WaveEval(disc, eb, nexts, valid, generated)

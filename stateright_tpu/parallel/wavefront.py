@@ -5,9 +5,14 @@ properties → expand successors → dedup insert → push, src/checker/
 bfs.rs:177-335) with a *wavefront* BFS: the entire frontier is expanded at
 once by a vmapped step kernel, deduplicated by a batched insert-if-absent
 into an HBM-resident fingerprint table, and property conditions are fused
-predicates over the whole wave.  One jitted program per wave chunk; the
-host loop only orchestrates chunking, early exit, and discovery
-bookkeeping.
+predicates over the whole wave.
+
+The whole wave loop runs on device inside one ``lax.while_loop`` program —
+frontier, visited table, counters, and discovery slots all live in HBM, and
+the host reads back a handful of scalars every ``waves_per_call`` waves.
+This matters doubly on hardware reached through a network tunnel: the
+chunked-dispatch version spent ~95% of wall-clock on per-wave host↔device
+round trips.
 
 Semantics parity with the host engine (core/engine.py):
 
@@ -31,16 +36,22 @@ from __future__ import annotations
 
 import threading
 from functools import partial
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.checker import Checker
+from ..core.has_discoveries import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
 from .compiled import CompiledModel, compiled_model_for
 
 NO_SLOT_HOST = 0xFFFFFFFF
+
+# Compiled device programs shared across checker instances (keyed by
+# CompiledModel.cache_key() + engine shape knobs): re-tracing and re-jitting
+# per spawn_tpu() call would otherwise dominate wall-clock.
+_PROGRAM_CACHE: dict = {}
 
 
 class TpuChecker(Checker):
@@ -50,7 +61,9 @@ class TpuChecker(Checker):
         self,
         options,
         capacity: int = 1 << 20,
-        chunk_size: int = 1 << 13,
+        max_frontier: int = 1 << 15,
+        dedup_factor: int = 4,
+        waves_per_call: Optional[int] = None,
         device=None,
         compiled: Optional[CompiledModel] = None,
     ):
@@ -68,7 +81,17 @@ class TpuChecker(Checker):
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
         self._capacity = capacity
-        self._chunk = chunk_size
+        self._max_frontier = max_frontier
+        self._dedup_factor = dedup_factor
+        if waves_per_call is None:
+            # Fidelity knobs that need host checks between waves.
+            fine_grained = (
+                options._timeout is not None
+                or options._target_state_count is not None
+                or options._finish_when is not HasDiscoveries.ALL
+            )
+            waves_per_call = 1 if fine_grained else 256
+        self._waves_per_call = waves_per_call
         self._device = device or jax.devices()[0]
         self._properties = self._model.properties()
         if len(self._properties) > 32:
@@ -86,89 +109,74 @@ class TpuChecker(Checker):
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
         self._tables_host: Optional[tuple] = None  # (parent, states) np arrays
+        self._tables_dev: Optional[tuple] = None  # same, still on device
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # --- device program ------------------------------------------------------
 
-    def _build_wave(self):
+    def _build_run(self):
+        """Build the fused multi-wave program.
+
+        Carry: (key_hi, key_lo, store, parent, ebits, frontier, fcount,
+        sc_lo, sc_hi, unique_count, depth, disc[P], waves_left, flags).
+        ``sc_lo``/``sc_hi`` form the 64-bit generated-state counter (no u64
+        on device).  flags: bit 0 = table overfull / probe failure; bit 1 =
+        frontier overflow (> max_frontier new states in one wave); bit 2 =
+        insert dedup-buffer overflow (batch had > B/dedup_factor distinct
+        keys).
+        """
         import jax
         import jax.numpy as jnp
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, NO_SLOT, insert_batch
+        from .hashset import HashSet, insert_batch
+        from .wave_common import wave_eval
 
         cm = self._compiled
         w = cm.state_width
         a = cm.max_actions
-        f = self._chunk
+        f = self._max_frontier
+        cap = self._capacity
+        dedup_factor = self._dedup_factor
         props = self._properties
         n_props = len(props)
         ev_indices = self._ev_indices
-        always_idx = [
-            i for i, p in enumerate(props) if p.expectation is Expectation.ALWAYS
-        ]
-        sometimes_idx = [
-            i for i, p in enumerate(props) if p.expectation is Expectation.SOMETIMES
-        ]
-        step = cm.step
-        prop_conds = cm.property_conds
-        boundary = cm.boundary
+        stop_when_all = self._options._finish_when is HasDiscoveries.ALL
+        target_depth = self._options._target_max_depth or 0
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-        def wave(key_hi, key_lo, store, parent, ebits, slots, count):
-            """Expand one frontier chunk.
+        def wave_body(carry):
+            (
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                frontier,
+                fcount,
+                sc_lo,
+                sc_hi,
+                unique_count,
+                depth,
+                disc,
+                waves_left,
+                flags,
+            ) = carry
+            depth = depth + 1
 
-            key_hi/key_lo: uint32[capacity] fingerprint planes.
-            store: uint32[capacity, W] packed states; parent: uint32[capacity]
-            predecessor slots; ebits: uint32[capacity] remaining
-            eventually-bits.  slots: uint32[F] frontier chunk (table slots);
-            count: number of valid lanes.
-            """
             lane = jnp.arange(f, dtype=jnp.uint32)
-            active = lane < count
-            safe_slots = jnp.where(active, slots, 0)
+            active = lane < fcount
+            safe_slots = jnp.where(active, frontier, 0)
             states = store[safe_slots]  # [F, W]
 
-            # Property evaluation at expansion (pop-time analog).
-            conds = jax.vmap(prop_conds)(states)  # [F, P]
-            cand = []
-            for p in range(n_props):
-                if p in always_idx:
-                    hit = active & ~conds[:, p]
-                elif p in sometimes_idx:
-                    hit = active & conds[:, p]
-                else:
-                    hit = jnp.zeros((f,), jnp.bool_)
-                idx = jnp.argmax(hit)
-                cand.append(jnp.where(jnp.any(hit), safe_slots[idx], NO_SLOT))
-            prop_cand = jnp.stack(cand) if cand else jnp.zeros((0,), jnp.uint32)
-
-            # Clear this state's own satisfied eventually bits.
-            eb = ebits[safe_slots]
-            for bit, p in enumerate(ev_indices):
-                eb = eb & ~(conds[:, p].astype(jnp.uint32) << bit)
-
-            # Successor expansion.
-            nexts, valid = jax.vmap(step)(states)  # [F, A, W], [F, A]
-            valid = valid & active[:, None]
-            if boundary(states[0]) is not None:
-                inb = jax.vmap(jax.vmap(boundary))(nexts)
-                valid = valid & inb
-            generated = jnp.sum(valid, dtype=jnp.uint32)
-
-            # Terminal frontier states with leftover ebits -> eventually
-            # counterexamples (src/checker/bfs.rs:326-333).
-            terminal = active & ~jnp.any(valid, axis=1)
-            ev_cand = []
-            for bit, _p in enumerate(ev_indices):
-                hit = terminal & (((eb >> bit) & 1) == 1)
-                idx = jnp.argmax(hit)
-                ev_cand.append(jnp.where(jnp.any(hit), safe_slots[idx], NO_SLOT))
-            ev_cand = (
-                jnp.stack(ev_cand) if ev_cand else jnp.zeros((0,), jnp.uint32)
+            disc, eb, nexts, valid, generated = wave_eval(
+                cm, props, ev_indices, states, active, safe_slots,
+                ebits[safe_slots], disc,
             )
+            new_lo = sc_lo + generated
+            sc_hi = sc_hi + (new_lo < sc_lo).astype(jnp.uint32)
+            sc_lo = new_lo
 
             # Dedup + insert.
             flat = nexts.reshape(f * a, w)
@@ -176,33 +184,124 @@ class TpuChecker(Checker):
             par = jnp.repeat(safe_slots, a)
             child_eb = jnp.repeat(eb, a)
             hi, lo = device_fp64(flat)
-            table, slot, is_new, ok = insert_batch(
-                HashSet(key_hi, key_lo), hi, lo, flat_valid
+            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+                HashSet(key_hi, key_lo), hi, lo, flat_valid,
+                dedup_factor=dedup_factor,
             )
-            sslot = jnp.where(is_new, slot, jnp.uint32(self._capacity))
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
             store = store.at[sslot].set(flat, mode="drop")
             parent = parent.at[sslot].set(par, mode="drop")
             ebits = ebits.at[sslot].set(child_eb, mode="drop")
-
-            # Compact new slots to the front (stable: preserves wave order).
-            order = jnp.argsort(~is_new, stable=True)
-            new_slots = slot[order]
             n_new = jnp.sum(is_new, dtype=jnp.uint32)
+            unique_count = unique_count + n_new
+
+            # Compact new slots into the next frontier (cumsum positions
+            # preserve wave order; far cheaper than a sort at B lanes).
+            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
+            fidx = jnp.where(is_new, pos, jnp.uint32(f))
+            frontier = (frontier ^ frontier).at[fidx].set(slot, mode="drop")
+            fcount = jnp.minimum(n_new, jnp.uint32(f))
+
+            flags = flags | jnp.where(probe_ok, 0, 1).astype(jnp.uint32)
+            flags = flags | jnp.where(
+                unique_count * 2 > jnp.uint32(cap), 1, 0
+            ).astype(jnp.uint32)
+            flags = flags | jnp.where(
+                n_new > jnp.uint32(f), 2, 0
+            ).astype(jnp.uint32)
+            flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
+
             return (
                 table.key_hi,
                 table.key_lo,
                 store,
                 parent,
                 ebits,
-                new_slots,
-                n_new,
-                generated,
-                prop_cand,
-                ev_cand,
-                ok,
+                frontier,
+                fcount,
+                sc_lo,
+                sc_hi,
+                unique_count,
+                depth,
+                disc,
+                waves_left - 1,
+                flags,
             )
 
-        return wave
+        def wave_cond(carry):
+            fcount = carry[6]
+            depth = carry[10]
+            disc = carry[11]
+            waves_left = carry[12]
+            flags = carry[13]
+            go = (fcount > 0) & (waves_left > 0) & (flags == 0)
+            if target_depth:
+                # The next wave would expand states at depth+1; the
+                # reference skips jobs with depth >= target at pop time, so
+                # states at the target depth are counted but not expanded.
+                go = go & (depth < target_depth - 1)
+            if stop_when_all and n_props:
+                go = go & jnp.any(disc == jnp.uint32(0xFFFFFFFF))
+            return go
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def run(key_hi, key_lo, store, parent, ebits, frontier, fcount,
+                sc_lo, sc_hi, unique_count, depth, disc, waves):
+            carry = (
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                frontier,
+                fcount,
+                sc_lo,
+                sc_hi,
+                unique_count,
+                depth,
+                disc,
+                waves,
+                jnp.uint32(0),
+            )
+            return jax.lax.while_loop(wave_cond, wave_body, carry)
+
+        eb0 = (1 << len(ev_indices)) - 1
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def seed(key_hi, key_lo, store, ebits, init_padded, n_init):
+            hi, lo = device_fp64(init_padded)
+            seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
+            table, slot, is_new, _probe_ok, _dd_overflow = insert_batch(
+                HashSet(key_hi, key_lo), hi, lo, seed_active
+            )
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
+            store = store.at[sslot].set(init_padded, mode="drop")
+            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
+            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
+            fidx = jnp.where(is_new, pos, jnp.uint32(f))
+            frontier = jnp.zeros((f,), jnp.uint32).at[fidx].set(
+                slot, mode="drop"
+            )
+            fcount = jnp.sum(is_new, dtype=jnp.uint32)
+            return table.key_hi, table.key_lo, store, ebits, frontier, fcount
+
+        return seed, run
+
+    def _programs(self):
+        key = (
+            self._compiled.cache_key(),
+            self._capacity,
+            self._max_frontier,
+            self._dedup_factor,
+            tuple(p.expectation for p in self._properties),
+            self._options._finish_when is HasDiscoveries.ALL,
+            self._options._target_max_depth or 0,
+        )
+        progs = _PROGRAM_CACHE.get(key)
+        if progs is None:
+            progs = self._build_run()
+            _PROGRAM_CACHE[key] = progs
+        return progs
 
     # --- host loop -----------------------------------------------------------
 
@@ -220,15 +319,13 @@ class TpuChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        from ..ops.device_fp import device_fp64
-        from .hashset import insert_batch, make_hashset
+        from .hashset import make_hashset
 
         opts = self._options
         cm = self._compiled
         props = self._properties
         cap = self._capacity
-        f = self._chunk
-        a = cm.max_actions
+        f = self._max_frontier
         deadline = (
             _time.monotonic() + opts._timeout if opts._timeout is not None else None
         )
@@ -243,122 +340,111 @@ class TpuChecker(Checker):
             init = cm.init_packed()
             n_init = init.shape[0]
             if n_init > f:
-                raise ValueError("more init states than chunk_size")
+                raise ValueError("more init states than max_frontier")
             pad = np.zeros((f - n_init, cm.state_width), np.uint32)
             init_padded = jnp.asarray(np.concatenate([init, pad]))
-            hi, lo = device_fp64(init_padded)
-            seed_active = jnp.arange(f) < n_init
-            table, slot, is_new, ok = insert_batch(table, hi, lo, seed_active)
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
-            store = store.at[sslot].set(init_padded, mode="drop")
-            eb0 = (1 << len(self._ev_indices)) - 1
-            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            order = jnp.argsort(~is_new, stable=True)
-            frontier = np.asarray(slot[order])[: int(jnp.sum(is_new))]
+            seed, run = self._programs()
+            key_hi, key_lo, store, ebits, frontier, fcount = seed(
+                table.key_hi,
+                table.key_lo,
+                store,
+                ebits,
+                init_padded,
+                jnp.uint32(n_init),
+            )
 
             self._state_count = n_init
-            self._unique_count = len(frontier)
+            self._unique_count = int(fcount)
+            sc_lo = jnp.uint32(n_init)
+            sc_hi = jnp.uint32(0)
+            unique_count = fcount
+            depth = jnp.uint32(0)
+            disc = jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32)
 
-            wave = self._build_wave()
-            depth = 0
-            key_hi, key_lo = table.key_hi, table.key_lo
-
-            while len(frontier) > 0:
-                depth += 1
+            while True:
+                (
+                    key_hi,
+                    key_lo,
+                    store,
+                    parent,
+                    ebits,
+                    frontier,
+                    fcount,
+                    sc_lo,
+                    sc_hi,
+                    unique_count,
+                    depth,
+                    disc,
+                    _waves_left,
+                    flags,
+                ) = run(
+                    key_hi,
+                    key_lo,
+                    store,
+                    parent,
+                    ebits,
+                    frontier,
+                    fcount,
+                    sc_lo,
+                    sc_hi,
+                    unique_count,
+                    depth,
+                    disc,
+                    jnp.int32(self._waves_per_call),
+                )
+                # One small sync per waves_per_call waves.
+                fcount_h = int(fcount)
+                depth_h = int(depth)
+                flags_h = int(flags)
+                disc_h = np.asarray(disc)
                 with self._lock:
-                    self._max_depth = depth
+                    self._state_count = (int(sc_hi) << 32) | int(sc_lo)
+                    self._unique_count = int(unique_count)
+                    self._max_depth = depth_h + (1 if fcount_h else 0)
+                    for p, prop in enumerate(props):
+                        if int(disc_h[p]) != NO_SLOT_HOST:
+                            self._discovery_slots.setdefault(
+                                prop.name, int(disc_h[p])
+                            )
+                if flags_h & 1:
+                    raise RuntimeError(
+                        f"fingerprint table overfull (capacity {cap}); raise "
+                        "spawn_tpu(capacity=...)"
+                    )
+                if flags_h & 2:
+                    raise RuntimeError(
+                        f"frontier exceeded max_frontier ({f}); raise "
+                        "spawn_tpu(max_frontier=...)"
+                    )
+                if flags_h & 4:
+                    raise RuntimeError(
+                        "a wave generated more distinct states than the "
+                        "insert dedup buffer holds (batch/dedup_factor); "
+                        f"lower spawn_tpu(dedup_factor=...) (now "
+                        f"{self._dedup_factor})"
+                    )
+                if fcount_h == 0:
+                    break
                 if (
                     opts._target_max_depth is not None
-                    and depth >= opts._target_max_depth
+                    and depth_h + 1 >= opts._target_max_depth
+                ):
+                    break
+                if opts._finish_when.matches(
+                    frozenset(self._discovery_slots), props
+                ):
+                    break
+                if (
+                    opts._target_state_count is not None
+                    and opts._target_state_count <= self._state_count
                 ):
                     break
                 if deadline is not None and _time.monotonic() >= deadline:
                     break
 
-                next_frontier: List[np.ndarray] = []
-                stop = False
-                for off in range(0, len(frontier), f):
-                    chunk = frontier[off : off + f]
-                    n = len(chunk)
-                    chunk = np.pad(chunk, (0, f - n)).astype(np.uint32)
-                    (
-                        key_hi,
-                        key_lo,
-                        store,
-                        parent,
-                        ebits,
-                        new_slots,
-                        n_new,
-                        generated,
-                        prop_cand,
-                        ev_cand,
-                        ok,
-                    ) = wave(
-                        key_hi,
-                        key_lo,
-                        store,
-                        parent,
-                        ebits,
-                        jnp.asarray(chunk),
-                        jnp.uint32(n),
-                    )
-                    if not bool(ok):
-                        raise RuntimeError(
-                            f"fingerprint table overfull (capacity {cap}); "
-                            "raise spawn_tpu(capacity=...)"
-                        )
-                    n_new_i = int(n_new)
-                    with self._lock:
-                        self._state_count += int(generated)
-                        self._unique_count += n_new_i
-                    if n_new_i:
-                        next_frontier.append(np.asarray(new_slots[:n_new_i]))
-                    # First-writer-wins discovery bookkeeping, deterministic
-                    # in wave order.
-                    prop_cand_h = np.asarray(prop_cand)
-                    for p, prop in enumerate(props):
-                        if prop.expectation is Expectation.EVENTUALLY:
-                            continue
-                        s = int(prop_cand_h[p])
-                        if s != NO_SLOT_HOST:
-                            with self._lock:
-                                self._discovery_slots.setdefault(prop.name, s)
-                    ev_cand_h = np.asarray(ev_cand)
-                    for bit, p in enumerate(self._ev_indices):
-                        s = int(ev_cand_h[bit])
-                        if s != NO_SLOT_HOST:
-                            with self._lock:
-                                self._discovery_slots.setdefault(props[p].name, s)
-
-                    if self._unique_count > cap // 2:
-                        raise RuntimeError(
-                            f"fingerprint table beyond 50% load (capacity {cap});"
-                            " raise spawn_tpu(capacity=...)"
-                        )
-                    if opts._finish_when.matches(
-                        frozenset(self._discovery_slots), props
-                    ):
-                        stop = True
-                        break
-                    if (
-                        opts._target_state_count is not None
-                        and opts._target_state_count <= self._state_count
-                    ):
-                        stop = True
-                        break
-                    if deadline is not None and _time.monotonic() >= deadline:
-                        stop = True
-                        break
-                if stop:
-                    break
-                frontier = (
-                    np.concatenate(next_frontier)
-                    if next_frontier
-                    else np.zeros((0,), np.uint32)
-                )
-
-            # Pull what path reconstruction needs to the host once.
-            self._tables_host = (np.asarray(parent), np.asarray(store))
+            # Keep the device arrays; path reconstruction pulls them to the
+            # host lazily (the readback is expensive on tunneled devices).
+            self._tables_dev = (parent, store)
 
     # --- Checker surface -----------------------------------------------------
 
@@ -372,6 +458,9 @@ class TpuChecker(Checker):
         return self._max_depth
 
     def _slot_path(self, slot: int) -> Path:
+        if self._tables_host is None:
+            parent_dev, store_dev = self._tables_dev
+            self._tables_host = (np.asarray(parent_dev), np.asarray(store_dev))
         parent, store = self._tables_host
         chain: List[int] = []
         s = slot

@@ -1,0 +1,48 @@
+"""Multi-chip sharded wavefront: golden-count and discovery-set parity with
+the host oracle on the 8-device virtual CPU mesh (conftest sets
+xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from tests.test_tpu_wavefront import TrapCounter  # noqa: E402
+
+
+def _mesh(n):
+    # The virtual CPU mesh (conftest forces 8 host devices); the default
+    # backend may be a single real TPU behind a tunnel.
+    devices = jax.devices("cpu")
+    assert len(devices) >= n, f"need {n} CPU devices, have {len(devices)}"
+    return jax.sharding.Mesh(np.array(devices[:n]), ("shards",))
+
+
+def test_twophase3_sharded_parity_8_devices():
+    model = TwoPhaseSys(rm_count=3)
+    host = model.checker().spawn_bfs().join()
+    sh = (
+        model.checker()
+        .spawn_tpu_sharded(mesh=_mesh(8), capacity=1 << 14, chunk_size=1 << 8)
+        .join()
+    )
+    assert sh.unique_state_count() == host.unique_state_count() == 288
+    assert sh.state_count() == host.state_count()
+    assert sh.max_depth() == host.max_depth()
+    assert sorted(sh.discoveries()) == sorted(host.discoveries())
+    for _name, path in sh.discoveries().items():
+        assert len(path) >= 1  # building a Path re-executes the host model
+
+
+def test_eventually_sharded_parity():
+    model = TrapCounter()
+    host = model.checker().spawn_bfs().join()
+    sh = (
+        model.checker()
+        .spawn_tpu_sharded(mesh=_mesh(4), capacity=1 << 13, chunk_size=1 << 4)
+        .join()
+    )
+    assert sh.unique_state_count() == host.unique_state_count()
+    assert sorted(sh.discoveries()) == sorted(host.discoveries())
+    assert sh.discoveries()["reaches limit"].last_state() == model.trap_state

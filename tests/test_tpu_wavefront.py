@@ -49,18 +49,21 @@ def test_hashset_insert_matches_python_set():
         hi = jnp.asarray((keys >> 32).astype(np.uint32))
         lo = jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32))
         active = jnp.asarray(rng.random(192) < 0.9)
-        table, slot, is_new, ok = insert_batch(table, hi, lo, active)
-        assert bool(ok)
+        table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+            table, hi, lo, active
+        )
+        assert bool(probe_ok) and not bool(dd_overflow)
         active_np = np.asarray(active)
         inserted = {int(k) for k, a in zip(keys, active_np) if a}
         assert int(jnp.sum(is_new)) == len(inserted - seen)
-        # All active lanes of one key agree on the slot.
+        # Each newly inserted key has exactly one winning lane, and the
+        # winners occupy distinct slots.
         slots = np.asarray(slot)
-        by_key = {}
-        for i, k in enumerate(keys):
-            if active_np[i]:
-                by_key.setdefault(int(k), set()).add(int(slots[i]))
-        assert all(len(s) == 1 for s in by_key.values())
+        new_np = np.asarray(is_new)
+        winner_keys = [int(k) for i, k in enumerate(keys) if new_np[i]]
+        assert len(winner_keys) == len(set(winner_keys))
+        winner_slots = [int(slots[i]) for i in np.flatnonzero(new_np)]
+        assert len(winner_slots) == len(set(winner_slots))
         seen |= inserted
 
 
@@ -117,6 +120,9 @@ def test_twophase_property_conds_parity(twophase3):
 
 def _assert_checker_parity(model, **tpu_kwargs):
     host = model.checker().spawn_bfs().join()
+    # Default to the (virtual) CPU backend: fast and always present.  The
+    # real-TPU path is exercised by bench.py and the tpu-marked smoke test.
+    tpu_kwargs.setdefault("device", jax.devices("cpu")[0])
     tpu = model.checker().spawn_tpu(**tpu_kwargs).join()
     assert tpu.unique_state_count() == host.unique_state_count()
     assert tpu.state_count() == host.state_count()
@@ -133,7 +139,7 @@ def test_twophase3_golden_tpu(twophase3):
     """2pc with 3 RMs: 288 unique states (reference examples/2pc.rs:153-154),
     identical counts and discovery set between host BFS and TPU wavefront."""
     _host, tpu = _assert_checker_parity(
-        twophase3, capacity=1 << 14, chunk_size=1 << 9
+        twophase3, capacity=1 << 14, max_frontier=1 << 9
     )
     assert tpu.unique_state_count() == 288
 
@@ -143,7 +149,7 @@ def test_twophase5_golden_tpu():
     """2pc with 5 RMs: 8,832 unique states (examples/2pc.rs:158-159)."""
     model = TwoPhaseSys(rm_count=5)
     _host, tpu = _assert_checker_parity(
-        model, capacity=1 << 15, chunk_size=1 << 11
+        model, capacity=1 << 15, max_frontier=1 << 11
     )
     assert tpu.unique_state_count() == 8832
 
@@ -229,7 +235,7 @@ class TrapCounterCompiled(CompiledModel):
 def test_eventually_parity_with_host():
     model = TrapCounter()
     host, tpu = _assert_checker_parity(
-        model, capacity=1 << 8, chunk_size=1 << 4
+        model, capacity=1 << 10, max_frontier=1 << 4
     )
     names = sorted(tpu.discoveries())
     # "reaches one" holds on every path: no counterexample. "reaches limit"
@@ -244,6 +250,24 @@ def test_eventually_satisfied_at_terminal_not_reported():
     # property at the terminal state itself — the bit clears before the
     # terminal check, so no counterexample (src/checker/bfs.rs:326-333).
     model = TrapCounter(trap_at=10**6)
-    tpu = model.checker().spawn_tpu(capacity=1 << 8, chunk_size=1 << 4).join()
+    tpu = (
+        model.checker()
+        .spawn_tpu(
+            capacity=1 << 10,
+            max_frontier=1 << 4,
+            device=jax.devices("cpu")[0],
+        )
+        .join()
+    )
     assert "reaches limit" not in tpu.discoveries()
     assert "reaches one" not in tpu.discoveries()
+
+
+@pytest.mark.tpu
+def test_twophase3_golden_on_default_device():
+    """Smoke test on the default backend (the real TPU when present)."""
+    if jax.devices()[0].platform == "cpu":
+        pytest.skip("no accelerator present")
+    model = TwoPhaseSys(rm_count=3)
+    tpu = model.checker().spawn_tpu(capacity=1 << 14, max_frontier=1 << 9).join()
+    assert tpu.unique_state_count() == 288
