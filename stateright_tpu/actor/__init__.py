@@ -27,6 +27,7 @@ from .model import (
     Recover,
     SelectRandom,
 )
+from .spawn import ActorRuntime, json_deserialize, json_serialize, spawn
 
 __all__ = [
     "Id", "Actor", "Out", "SendCmd", "SetTimerCmd", "CancelTimerCmd",
@@ -34,4 +35,5 @@ __all__ = [
     "majority", "model_peers", "model_timeout", "Envelope", "Network",
     "ActorModel", "ActorModelState", "Deliver", "Drop", "Timeout", "Crash",
     "Recover", "SelectRandom",
+    "ActorRuntime", "spawn", "json_serialize", "json_deserialize",
 ]

@@ -1,0 +1,223 @@
+"""An ordered reliable link (ORL): transparent actor middleware adding
+sequence numbers, acks, resends, and duplicate suppression.
+
+Reference: src/actor/ordered_reliable_link.rs — based loosely on the
+"perfect link" of Cachin, Guerraoui & Rodrigues, with per source/destination
+pair ordering.  Sequencer state persists through ``Storage`` so actors can
+restart without re-delivering or re-numbering (the wrapper model-checks
+clean under a lossy duplicating network; see tests/test_actor_runtime.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from .base import (
+    Actor,
+    CancelTimerCmd,
+    ChooseRandomCmd,
+    Out,
+    SaveCmd,
+    SendCmd,
+    SetTimerCmd,
+    is_no_op,
+)
+from .ids import Id
+
+
+@dataclass(frozen=True)
+class Deliver:
+    """MsgWrapper::Deliver(seq, msg) (reference:41-44)."""
+
+    seq: int
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Ack:
+    seq: int
+
+
+NETWORK_TIMER = "ORL-Network"  # TimerWrapper::Network
+
+
+@dataclass(frozen=True)
+class UserTimer:
+    timer: Any
+
+
+@dataclass(frozen=True)
+class LinkState:
+    """StateWrapper (reference:49-61); maps as sorted tuples for stable
+    hashing/fingerprinting."""
+
+    next_send_seq: int
+    msgs_pending_ack: Tuple[Tuple[int, Tuple[Id, Any]], ...]
+    last_delivered_seqs: Tuple[Tuple[Id, int], ...]
+    wrapped_state: Any
+    wrapped_storage: Any
+
+
+@dataclass(frozen=True)
+class LinkStorage:
+    """StorageWrapper (reference:69-80)."""
+
+    next_send_seq: int
+    msgs_pending_ack: Tuple[Tuple[int, Tuple[Id, Any]], ...]
+    last_delivered_seqs: Tuple[Tuple[Id, int], ...]
+    wrapped_storage: Any
+
+
+class ActorWrapper(Actor):
+    """Wraps an actor to (1) maintain message order, (2) resend lost
+    messages, (3) avoid redelivery.  Reference:27-222."""
+
+    def __init__(self, wrapped_actor: Actor, resend_interval=(1.0, 2.0)):
+        self.wrapped_actor = wrapped_actor
+        self.resend_interval = tuple(resend_interval)
+
+    @staticmethod
+    def with_default_timeout(wrapped_actor: Actor) -> "ActorWrapper":
+        return ActorWrapper(wrapped_actor)
+
+    def name(self) -> str:
+        return self.wrapped_actor.name()
+
+    # --- handlers ------------------------------------------------------------
+
+    def on_start(self, id: Id, storage: Optional[LinkStorage], o: Out):
+        o.set_timer(NETWORK_TIMER, self.resend_interval)
+        wrapped_out = Out()
+        if storage is not None:
+            next_send_seq = storage.next_send_seq
+            pending = storage.msgs_pending_ack
+            last_seqs = storage.last_delivered_seqs
+            wrapped_storage = storage.wrapped_storage
+        else:
+            next_send_seq, pending, last_seqs, wrapped_storage = 1, (), (), None
+        wrapped_state = self.wrapped_actor.on_start(id, wrapped_storage, wrapped_out)
+        state = LinkState(
+            next_send_seq, pending, last_seqs, wrapped_state, wrapped_storage
+        )
+        return self._process_output(state, wrapped_out, o, force_state=True)[1]
+
+    def on_msg(self, id: Id, state: LinkState, src: Id, msg: Any, o: Out):
+        if isinstance(msg, Deliver):
+            # Always ack to stop resends; drop if already delivered
+            # (reference:142-151).
+            o.send(src, Ack(msg.seq))
+            last = dict(state.last_delivered_seqs).get(src, 0)
+            if msg.seq <= last:
+                return None
+
+            wrapped_out = Out()
+            next_wrapped = self.wrapped_actor.on_msg(
+                id, state.wrapped_state, src, msg.msg, wrapped_out
+            )
+            if is_no_op(next_wrapped, wrapped_out):
+                return None
+
+            last_seqs = dict(state.last_delivered_seqs)
+            last_seqs[src] = msg.seq
+            state = LinkState(
+                state.next_send_seq,
+                state.msgs_pending_ack,
+                tuple(sorted(last_seqs.items())),
+                next_wrapped if next_wrapped is not None else state.wrapped_state,
+                state.wrapped_storage,
+            )
+            _saved, state = self._process_output(state, wrapped_out, o)
+        elif isinstance(msg, Ack):
+            pending = tuple(
+                (seq, dm) for seq, dm in state.msgs_pending_ack if seq != msg.seq
+            )
+            state = LinkState(
+                state.next_send_seq,
+                pending,
+                state.last_delivered_seqs,
+                state.wrapped_state,
+                state.wrapped_storage,
+            )
+        else:
+            return None
+        # Non-volatile fields changed: persist (reference:182-189).
+        o.save(
+            LinkStorage(
+                state.next_send_seq,
+                state.msgs_pending_ack,
+                state.last_delivered_seqs,
+                state.wrapped_storage,
+            )
+        )
+        return state
+
+    def on_timeout(self, id: Id, state: LinkState, timer: Any, o: Out):
+        if timer == NETWORK_TIMER:
+            # Re-arm and resend everything pending (reference:199-205).
+            o.set_timer(NETWORK_TIMER, self.resend_interval)
+            for seq, (dst, msg) in state.msgs_pending_ack:
+                o.send(dst, Deliver(seq, msg))
+            return None
+        if isinstance(timer, UserTimer):
+            wrapped_out = Out()
+            next_wrapped = self.wrapped_actor.on_timeout(
+                id, state.wrapped_state, timer.timer, wrapped_out
+            )
+            if is_no_op(next_wrapped, wrapped_out):
+                return None
+            if next_wrapped is not None:
+                state = LinkState(
+                    state.next_send_seq,
+                    state.msgs_pending_ack,
+                    state.last_delivered_seqs,
+                    next_wrapped,
+                    state.wrapped_storage,
+                )
+            _saved, state = self._process_output(state, wrapped_out, o)
+            return state
+        return None
+
+    # --- plumbing (reference: process_output, :224-269) ----------------------
+
+    def _process_output(
+        self, state: LinkState, wrapped_out: Out, o: Out, force_state=False
+    ):
+        next_send_seq = state.next_send_seq
+        pending = dict(state.msgs_pending_ack)
+        wrapped_storage = state.wrapped_storage
+        should_save = False
+        for c in wrapped_out:
+            if isinstance(c, CancelTimerCmd):
+                o.cancel_timer(UserTimer(c.timer))
+            elif isinstance(c, SetTimerCmd):
+                o.set_timer(UserTimer(c.timer), c.duration)
+            elif isinstance(c, SendCmd):
+                o.send(c.dst, Deliver(next_send_seq, c.msg))
+                pending[next_send_seq] = (c.dst, c.msg)
+                next_send_seq += 1
+                should_save = True
+            elif isinstance(c, ChooseRandomCmd):
+                raise NotImplementedError(
+                    "ChooseRandom is not supported by the ORL wrapper"
+                )
+            elif isinstance(c, SaveCmd):
+                should_save = True
+                wrapped_storage = c.storage
+        state = LinkState(
+            next_send_seq,
+            tuple(sorted(pending.items())),
+            state.last_delivered_seqs,
+            state.wrapped_state,
+            wrapped_storage,
+        )
+        if should_save:
+            o.save(
+                LinkStorage(
+                    state.next_send_seq,
+                    state.msgs_pending_ack,
+                    state.last_delivered_seqs,
+                    state.wrapped_storage,
+                )
+            )
+        return should_save, state

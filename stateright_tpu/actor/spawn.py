@@ -1,0 +1,226 @@
+"""The real-network actor runtime: run model-checked actors over UDP.
+
+Reference: src/actor/spawn.rs.  The *same* ``Actor`` implementations used
+for model checking execute on a real network: one thread per actor, a UDP
+socket bound to the actor's ``Id``-encoded address, persistent storage
+loaded from ``{addr}.storage`` before ``on_start`` (src/actor/spawn.rs:
+96-100), and an event loop that waits for the earliest pending interrupt
+(timer or scheduled random choice) or an incoming datagram, dispatching
+``on_msg`` / ``on_timeout`` / ``on_random`` and then applying the emitted
+commands (src/actor/spawn.rs:106-164,177-256).
+
+Message and storage serializers are caller-supplied functions, as in the
+reference (whose examples use serde_json); ``json_serialize`` /
+``json_deserialize`` below are ready-made JSON codecs for plain-data
+messages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random as _random
+import socket
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from .base import (
+    Actor,
+    CancelTimerCmd,
+    ChooseRandomCmd,
+    Out,
+    SaveCmd,
+    SendCmd,
+    SetTimerCmd,
+)
+from .ids import Id
+
+_PRACTICALLY_NEVER = 1e18  # src/actor/spawn.rs practically_never()
+MAX_DATAGRAM = 65_535
+
+
+def json_serialize(msg: Any) -> bytes:
+    return json.dumps(msg).encode()
+
+
+def json_deserialize(data: bytes) -> Any:
+    return json.loads(data)
+
+
+def _addr_str(id: Id) -> str:
+    ip, port = id.to_socket_addr()
+    return f"{ip[0]}.{ip[1]}.{ip[2]}.{ip[3]}:{port}"
+
+
+class ActorRuntime:
+    """Handle for a set of spawned actor threads."""
+
+    def __init__(self):
+        self._threads: List[threading.Thread] = []
+        self._sockets: List[socket.socket] = []
+        self._stop = threading.Event()
+        self.errors: List[BaseException] = []
+
+    def stop(self) -> None:
+        """Stop all actor threads (closing their sockets)."""
+        self._stop.set()
+        for s in self._sockets:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def join(self) -> None:
+        """Block until the runtime stops (the reference blocks forever,
+        src/actor/spawn.rs:84-168)."""
+        for t in self._threads:
+            t.join()
+        if self.errors:
+            raise self.errors[0]
+
+
+def spawn(
+    msg_serialize: Callable[[Any], bytes],
+    msg_deserialize: Callable[[bytes], Any],
+    storage_serialize: Callable[[Any], bytes],
+    storage_deserialize: Callable[[bytes], Any],
+    actors: List[Tuple[Id, Actor]],
+    storage_dir: str = ".",
+) -> ActorRuntime:
+    """Run ``actors`` on real UDP sockets; returns a runtime handle.
+
+    Reference: ``spawn``, src/actor/spawn.rs:70-168 (which blocks; call
+    ``.join()`` on the returned handle for that behavior).
+    """
+    runtime = ActorRuntime()
+    for id, actor in actors:
+        id = Id(id)
+        t = threading.Thread(
+            target=_actor_main,
+            args=(
+                runtime,
+                id,
+                actor,
+                msg_serialize,
+                msg_deserialize,
+                storage_serialize,
+                storage_deserialize,
+                storage_dir,
+            ),
+            name=f"actor-{_addr_str(id)}",
+            daemon=True,
+        )
+        runtime._threads.append(t)
+    for t in runtime._threads:
+        t.start()
+    return runtime
+
+
+def _actor_main(
+    runtime: ActorRuntime,
+    id: Id,
+    actor: Actor,
+    msg_serialize,
+    msg_deserialize,
+    storage_serialize,
+    storage_deserialize,
+    storage_dir: str,
+) -> None:
+    try:
+        ip, port = id.to_socket_addr()
+        addr = (f"{ip[0]}.{ip[1]}.{ip[2]}.{ip[3]}", port)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(addr)
+        runtime._sockets.append(sock)
+
+        storage_path = os.path.join(storage_dir, f"{_addr_str(id)}.storage")
+        storage: Optional[Any] = None
+        try:
+            with open(storage_path, "rb") as f:
+                storage = storage_deserialize(f.read())
+        except (OSError, ValueError):
+            storage = None
+
+        # interrupt key -> (kind, payload, fire_at)
+        next_interrupts: dict = {}
+
+        def on_command(cmd) -> None:
+            # Reference: on_command, src/actor/spawn.rs:177-256.
+            if isinstance(cmd, SendCmd):
+                dst_ip, dst_port = Id(cmd.dst).to_socket_addr()
+                dst = (
+                    f"{dst_ip[0]}.{dst_ip[1]}.{dst_ip[2]}.{dst_ip[3]}",
+                    dst_port,
+                )
+                try:
+                    sock.sendto(msg_serialize(cmd.msg), dst)
+                except (OSError, ValueError):
+                    pass  # unable to send/serialize: ignore, like the reference
+            elif isinstance(cmd, SetTimerCmd):
+                lo, hi = cmd.duration
+                duration = _random.uniform(lo, hi) if lo < hi else lo
+                next_interrupts[("timeout", cmd.timer)] = (
+                    time.monotonic() + duration
+                )
+            elif isinstance(cmd, CancelTimerCmd):
+                key = ("timeout", cmd.timer)
+                if key in next_interrupts:
+                    next_interrupts[key] = _PRACTICALLY_NEVER
+            elif isinstance(cmd, ChooseRandomCmd):
+                if not cmd.choices:
+                    return
+                chosen = _random.choice(list(cmd.choices))
+                duration = _random.uniform(0.0, 10.0)
+                next_interrupts[("random", chosen)] = (
+                    time.monotonic() + duration
+                )
+            elif isinstance(cmd, SaveCmd):
+                with open(storage_path, "wb") as f:
+                    f.write(storage_serialize(cmd.storage))
+
+        out = Out()
+        state = actor.on_start(id, storage, out)
+        for c in out:
+            on_command(c)
+
+        while not runtime._stop.is_set():
+            out = Out()
+            if next_interrupts:
+                min_key = min(next_interrupts, key=next_interrupts.get)
+                min_at = next_interrupts[min_key]
+            else:
+                min_key, min_at = None, _PRACTICALLY_NEVER
+            max_wait = min_at - time.monotonic()
+            if max_wait > 0:
+                sock.settimeout(min(max_wait, 1.0))
+                try:
+                    data, src_addr = sock.recvfrom(MAX_DATAGRAM)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return  # socket closed: runtime stopping
+                try:
+                    msg = msg_deserialize(data)
+                except (ValueError, KeyError):
+                    continue  # unparseable: ignore, like the reference
+                src = Id.from_socket_addr(
+                    tuple(int(b) for b in src_addr[0].split(".")),
+                    src_addr[1],
+                )
+                next_state = actor.on_msg(id, state, src, msg, out)
+            else:
+                del next_interrupts[min_key]
+                kind, payload = min_key
+                if kind == "timeout":
+                    next_state = actor.on_timeout(id, state, payload, out)
+                else:
+                    next_state = actor.on_random(id, state, payload, out)
+            if next_state is not None:
+                state = next_state
+            for c in out:
+                on_command(c)
+    except BaseException as e:
+        runtime.errors.append(e)

@@ -1,0 +1,183 @@
+"""Write-once-register actor harness: the register harness variant whose
+protocol adds ``PutFail`` and whose history records against the
+write-once-register spec.
+
+Reference: src/actor/write_once_register.rs.  Like the plain register
+harness (actor/register.py), servers must precede clients in the model's
+actor list so a server id can be derived as ``(client_index + k) %
+server_count``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..semantics.register import ReadOk, ReadOp, WriteOp, WRITE_OK, READ
+from ..semantics.write_once_register import WRITE_FAIL
+from .base import Actor, Out
+from .ids import Id
+
+
+@dataclass(frozen=True)
+class Internal:
+    """Wraps a protocol-internal message (WORegisterMsg::Internal)."""
+
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Put:
+    request_id: int
+    value: Any
+
+
+@dataclass(frozen=True)
+class Get:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class PutOk:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class PutFail:
+    """An unsuccessful Put (the write-once register refused to overwrite)."""
+
+    request_id: int
+
+
+@dataclass(frozen=True)
+class GetOk:
+    request_id: int
+    value: Any
+
+
+def record_invocations(_cfg, history, env) -> Optional[Any]:
+    """Pass to ``ActorModel.record_msg_out``; records ``Read`` upon ``Get``
+    and ``Write`` upon ``Put`` (reference:39-61)."""
+    if isinstance(env.msg, Get):
+        h = history.clone()
+        try:
+            h.on_invoke(env.src, READ)
+        except ValueError:
+            pass
+        return h
+    if isinstance(env.msg, Put):
+        h = history.clone()
+        try:
+            h.on_invoke(env.src, WriteOp(env.msg.value))
+        except ValueError:
+            pass
+        return h
+    return None
+
+
+def record_returns(_cfg, history, env) -> Optional[Any]:
+    """Pass to ``ActorModel.record_msg_in``; records ``ReadOk`` / ``WriteOk``
+    / ``WriteFail`` upon the corresponding response (reference:63-97)."""
+    if isinstance(env.msg, GetOk):
+        h = history.clone()
+        try:
+            h.on_return(env.dst, ReadOk(env.msg.value))
+        except ValueError:
+            pass
+        return h
+    if isinstance(env.msg, PutOk):
+        h = history.clone()
+        try:
+            h.on_return(env.dst, WRITE_OK)
+        except ValueError:
+            pass
+        return h
+    if isinstance(env.msg, PutFail):
+        h = history.clone()
+        try:
+            h.on_return(env.dst, WRITE_FAIL)
+        except ValueError:
+            pass
+        return h
+    return None
+
+
+@dataclass(frozen=True)
+class ClientState:
+    awaiting: Optional[int]
+    op_count: int
+
+
+class WORegisterClient(Actor):
+    """Scripted client: ``put_count`` Puts then a Get; a ``PutFail`` also
+    advances the script (reference:230-276)."""
+
+    def __init__(self, put_count: int, server_count: int):
+        self.put_count = put_count
+        self.server_count = server_count
+
+    def name(self) -> str:
+        return "Client"
+
+    def on_start(self, id: Id, storage, o: Out):
+        index = int(id)
+        if index < self.server_count:
+            raise RuntimeError(
+                "WORegisterClient actors must be added to the model after servers."
+            )
+        if self.put_count == 0:
+            return ClientState(awaiting=None, op_count=0)
+        unique_request_id = 1 * index
+        value = chr(ord("A") + (index - self.server_count))
+        o.send(Id(index % self.server_count), Put(unique_request_id, value))
+        return ClientState(awaiting=unique_request_id, op_count=1)
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if not isinstance(state, ClientState) or state.awaiting is None:
+            return None
+        index = int(id)
+        if (
+            isinstance(msg, (PutOk, PutFail))
+            and msg.request_id == state.awaiting
+        ):
+            unique_request_id = (state.op_count + 1) * index
+            if state.op_count < self.put_count:
+                value = chr(ord("Z") - (index - self.server_count))
+                o.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Put(unique_request_id, value),
+                )
+            else:
+                o.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Get(unique_request_id),
+                )
+            return ClientState(
+                awaiting=unique_request_id, op_count=state.op_count + 1
+            )
+        if isinstance(msg, GetOk) and msg.request_id == state.awaiting:
+            return ClientState(awaiting=None, op_count=state.op_count + 1)
+        return None
+
+
+class WORegisterServer(Actor):
+    """Wraps a server actor under test; delegates every event
+    (reference:279-291)."""
+
+    def __init__(self, server_actor: Actor):
+        self.server_actor = server_actor
+
+    def name(self) -> str:
+        return self.server_actor.name()
+
+    def on_start(self, id: Id, storage, o: Out):
+        return self.server_actor.on_start(id, storage, o)
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        return self.server_actor.on_msg(id, state, src, msg, o)
+
+    def on_timeout(self, id: Id, state, timer, o: Out):
+        return self.server_actor.on_timeout(id, state, timer, o)
+
+    def on_random(self, id: Id, state, random, o: Out):
+        return self.server_actor.on_random(id, state, random, o)

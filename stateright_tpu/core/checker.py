@@ -119,11 +119,15 @@ class CheckerBuilder:
 
         return ShardedTpuChecker(self, **kwargs)
 
-    def serve(self, address) -> "Checker":
+    def serve(self, address, **kwargs) -> "Checker":
+        """Serve the interactive Explorer on ``address`` backed by an
+        on-demand checker (reference: src/checker.rs:144-151).  Blocks by
+        default like the reference; pass ``block=False`` to serve in the
+        background and get the checker back immediately."""
         self._require("stateright_tpu.explorer.server", "explorer server")
         from ..explorer.server import serve
 
-        return serve(self, address)
+        return serve(self, address, **kwargs)
 
 
 class Checker:

@@ -1,0 +1,282 @@
+"""On-demand checking: compute nothing until asked.
+
+Reference: src/checker/on_demand.rs.  A BFS-flavored engine whose workers
+block on a control channel; ``check_fingerprint(fp)`` expands only the
+pending job matching the fingerprint the Explorer user clicked, and
+``run_to_completion()`` switches to normal exhaustive checking.  This is
+the engine behind ``CheckerBuilder.serve``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .checker import Checker
+from .job_market import JobMarket
+from .model import Expectation
+from .path import Path
+
+BLOCK_SIZE = 1500
+
+
+class _CheckFingerprint:
+    __slots__ = ("fp",)
+
+    def __init__(self, fp: int):
+        self.fp = fp
+
+
+_RUN_TO_COMPLETION = object()
+_SHUTDOWN = object()
+
+
+class OnDemandChecker(Checker):
+    def __init__(self, options):
+        super().__init__(options.model)
+        model = self._model
+        self._options = options
+        self._properties = model.properties()
+        self._visitor = options._visitor
+        self._target_state_count = options._target_state_count
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._max_depth = 0
+        self._count_lock = threading.Lock()
+        # fp -> Optional[parent fp] predecessor tree (src/checker/on_demand.rs:60-67)
+        self._generated: Dict[int, Optional[int]] = {
+            model.fingerprint(s): None for s in init_states
+        }
+        self._gen_lock = threading.Lock()
+        self._discoveries: Dict[str, int] = {}
+        self._errors: List[BaseException] = []
+
+        ebits = frozenset(
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation is Expectation.EVENTUALLY
+        )
+        pending = deque(
+            (s, model.fingerprint(s), ebits, 1) for s in init_states
+        )
+
+        close_at = (
+            time.monotonic() + options._timeout
+            if options._timeout is not None
+            else None
+        )
+        thread_count = options._thread_count
+        self._market: JobMarket = JobMarket(thread_count, close_at)
+        self._market.push(pending)
+
+        # Control-flow fan-out: one queue per worker, fed by a forwarder
+        # (src/checker/on_demand.rs:221-227).
+        self._control: "queue.Queue" = queue.Queue()
+        self._worker_controls: List["queue.Queue"] = [
+            queue.Queue() for _ in range(thread_count)
+        ]
+        self._handles: List[threading.Thread] = []
+        for t in range(thread_count):
+            th = threading.Thread(
+                target=self._worker,
+                args=(self._worker_controls[t],),
+                name=f"checker-{t}",
+                daemon=True,
+            )
+            self._handles.append(th)
+        # The forwarder is not joined: it parks on the control queue for the
+        # checker's lifetime (the analog of the reference's forwarder thread
+        # exiting only when the sender is dropped).
+        self._forwarder = threading.Thread(
+            target=self._forward_control, name="control-forwarder", daemon=True
+        )
+        self._forwarder.start()
+        for th in self._handles:
+            th.start()
+
+    def _forward_control(self) -> None:
+        while True:
+            msg = self._control.get()
+            for q in self._worker_controls:
+                q.put(msg)
+            if msg is _SHUTDOWN:
+                return
+
+    # --- worker loop (src/checker/on_demand.rs:108-215) ----------------------
+
+    def _worker(self, control: "queue.Queue") -> None:
+        try:
+            pending: deque = deque()
+            targetted: deque = deque()
+            wait_for_fingerprints = True
+            while True:
+                if not pending:
+                    pending = self._market.pop()
+                    if not pending:
+                        return
+
+                if wait_for_fingerprints:
+                    # Step 0: wait for someone to ask for work.
+                    while True:
+                        msg = control.get()
+                        if msg is _SHUTDOWN:
+                            return
+                        if msg is _RUN_TO_COMPLETION:
+                            wait_for_fingerprints = False
+                            break
+                        # _CheckFingerprint
+                        if not pending:
+                            break
+                        index = next(
+                            (
+                                i
+                                for i, job in enumerate(pending)
+                                if job[1] == msg.fp
+                            ),
+                            None,
+                        )
+                        if index is not None:
+                            job = pending[index]
+                            del pending[index]
+                            targetted.append(job)
+                            break
+                else:
+                    targetted.extend(pending)
+                    pending.clear()
+
+                # Step 1: do work.
+                self._check_block(targetted, BLOCK_SIZE)
+                pending.extend(targetted)
+                targetted.clear()
+                if len(self._discoveries) == len(self._properties):
+                    return
+                if (
+                    self._target_state_count is not None
+                    and self._target_state_count <= self._state_count
+                ):
+                    return
+
+                # Step 2: share work.
+                if len(pending) > 1 and len(self._worker_controls) > 1:
+                    self._market.split_and_push(pending)
+        except BaseException as e:
+            self._errors.append(e)
+        finally:
+            self._market.worker_done()
+
+    def _check_block(self, pending: deque, max_count: int) -> None:
+        model = self._model
+        properties = self._properties
+        local = deque()
+        for _ in range(min(max_count, len(pending))):
+            local.append(pending.popleft())
+        while local:
+            state, state_fp, ebits, depth = local.pop()
+
+            with self._count_lock:
+                if depth > self._max_depth:
+                    self._max_depth = depth
+
+            if self._visitor is not None:
+                self._visitor.visit(model, self._reconstruct(state_fp))
+
+            is_awaiting_discoveries = False
+            for i, prop in enumerate(properties):
+                if prop.name in self._discoveries:
+                    continue
+                if prop.expectation is Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        self._discoveries.setdefault(prop.name, state_fp)
+                    else:
+                        is_awaiting_discoveries = True
+                elif prop.expectation is Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        self._discoveries.setdefault(prop.name, state_fp)
+                    else:
+                        is_awaiting_discoveries = True
+                else:
+                    is_awaiting_discoveries = True
+                    if prop.condition(model, state):
+                        ebits = ebits - {i}
+            if not is_awaiting_discoveries:
+                return
+
+            is_terminal = True
+            actions: List[Any] = []
+            model.actions(state, actions)
+            for action in actions:
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                with self._count_lock:
+                    self._state_count += 1
+                next_fp = model.fingerprint(next_state)
+                with self._gen_lock:
+                    if next_fp in self._generated:
+                        is_terminal = False
+                        continue
+                    self._generated[next_fp] = state_fp
+                is_terminal = False
+                pending.appendleft((next_state, next_fp, ebits, depth + 1))
+            if is_terminal:
+                for i, prop in enumerate(properties):
+                    if i in ebits:
+                        self._discoveries.setdefault(prop.name, state_fp)
+
+    def _reconstruct(self, fp: int) -> Path:
+        fps: deque = deque()
+        next_fp: Optional[int] = fp
+        while next_fp is not None and next_fp in self._generated:
+            fps.appendleft(next_fp)
+            next_fp = self._generated[next_fp]
+        return Path.from_fingerprints(self._model, list(fps))
+
+    # --- Checker surface (src/checker/on_demand.rs:397-446) ------------------
+
+    def check_fingerprint(self, fingerprint: int) -> None:
+        self._control.put(_CheckFingerprint(fingerprint))
+
+    def run_to_completion(self) -> None:
+        self._control.put(_RUN_TO_COMPLETION)
+
+    def shutdown(self) -> None:
+        """Stop waiting workers (the Python analog of dropping the control
+        channel senders)."""
+        self._market.close()
+        self._control.put(_SHUTDOWN)
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discoveries.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        return self._handles
+
+    def is_done(self) -> bool:
+        return self._market.is_closed or len(self._discoveries) == len(
+            self._properties
+        )
+
+    def join(self) -> "OnDemandChecker":
+        for h in self._handles:
+            h.join()
+        if self._errors:
+            raise self._errors[0]
+        return self

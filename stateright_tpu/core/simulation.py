@@ -1,0 +1,253 @@
+"""Stochastic (Monte-Carlo) checking by repeated random trace walks.
+
+Reference: src/checker/simulation.rs.  Each thread repeatedly walks a trace
+from a chosen init state to a terminal state / cycle / boundary, choosing
+among enabled actions through a pluggable :class:`Chooser`; properties are
+evaluated at every visited state exactly as in the graph engines, and
+leftover eventually-bits at the end of a trace become counterexamples
+(a cycle or boundary exit ends the trace, src/checker/simulation.rs:455-465
+and 393-396).  There is no global dedup: ``unique_state_count`` equals
+``state_count`` (src/checker/simulation.rs:413-417).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .checker import Checker
+from .model import Expectation
+from .path import Path
+
+
+class Chooser:
+    """Chooses transitions during a simulation run.
+
+    Reference: the ``Chooser`` trait, src/checker/simulation.rs:19-39.
+    """
+
+    def new_state(self, seed: int) -> Any:
+        raise NotImplementedError
+
+    def choose_initial_state(self, chooser_state, initial_states: List[Any]) -> int:
+        raise NotImplementedError
+
+    def choose_action(self, chooser_state, current_state, actions: List[Any]) -> int:
+        raise NotImplementedError
+
+
+class UniformChooser(Chooser):
+    """Uniformly random choices from a seeded RNG.
+
+    Reference: src/checker/simulation.rs:40-79.
+    """
+
+    def new_state(self, seed: int) -> random.Random:
+        return random.Random(seed)
+
+    def choose_initial_state(self, rng, initial_states):
+        return rng.randrange(len(initial_states))
+
+    def choose_action(self, rng, _current_state, actions):
+        return rng.randrange(len(actions))
+
+
+class SimulationChecker(Checker):
+    def __init__(self, options, seed: int, chooser: Chooser):
+        super().__init__(options.model)
+        self._options = options
+        self._chooser = chooser
+        self._symmetry = options._symmetry
+        self._properties = self._model.properties()
+        self._state_count = 0
+        self._max_depth = 0
+        self._count_lock = threading.Lock()
+        # name -> full fingerprint path of the discovery trace.
+        self._discoveries: Dict[str, List[int]] = {}
+        self._shutdown = threading.Event()
+        self._errors: List[BaseException] = []
+
+        deadline = (
+            time.monotonic() + options._timeout
+            if options._timeout is not None
+            else None
+        )
+        self._deadline = deadline
+
+        self._handles: List[threading.Thread] = []
+        for t in range(options._thread_count):
+            th = threading.Thread(
+                target=self._worker, args=(seed + t,), name=f"checker-{t}",
+                daemon=True,
+            )
+            self._handles.append(th)
+        for th in self._handles:
+            th.start()
+
+    # --- worker (src/checker/simulation.rs:138-200) --------------------------
+
+    def _worker(self, thread_seed: int) -> None:
+        try:
+            rng = random.Random(thread_seed)
+            trace_seed = thread_seed
+            while not self._shutdown.is_set():
+                if (
+                    self._deadline is not None
+                    and time.monotonic() >= self._deadline
+                ):
+                    return
+                self._check_trace_from_initial(trace_seed)
+                if self._options._finish_when.matches(
+                    frozenset(self._discoveries), self._properties
+                ):
+                    return
+                if (
+                    self._options._target_state_count is not None
+                    and self._options._target_state_count <= self._state_count
+                ):
+                    return
+                trace_seed = rng.getrandbits(64)
+        except BaseException as e:
+            self._errors.append(e)
+            self._shutdown.set()
+
+    # --- one trace (src/checker/simulation.rs:213-397) -----------------------
+
+    def _check_trace_from_initial(self, seed: int) -> None:
+        model = self._model
+        properties = self._properties
+        chooser = self._chooser
+        chooser_state = chooser.new_state(seed)
+        visitor = self._options._visitor
+        target_max_depth = self._options._target_max_depth
+        symmetry = self._symmetry
+
+        initial_states = list(model.init_states())
+        index = chooser.choose_initial_state(chooser_state, initial_states)
+        state = initial_states[index]
+
+        fingerprint_path: List[int] = []
+        generated = set()
+        ebits = {
+            i
+            for i, p in enumerate(properties)
+            if p.expectation is Expectation.EVENTUALLY
+        }
+
+        ended_by_depth = False
+        while True:
+            if len(fingerprint_path) > self._max_depth:
+                with self._count_lock:
+                    if len(fingerprint_path) > self._max_depth:
+                        self._max_depth = len(fingerprint_path)
+            if (
+                target_max_depth is not None
+                and len(fingerprint_path) >= target_max_depth
+            ):
+                # Not necessarily terminal: skip the eventually check
+                # (src/checker/simulation.rs:263-272).
+                ended_by_depth = True
+                break
+
+            if not model.within_boundary(state):
+                break
+
+            fingerprint_path.append(model.fingerprint(state))
+            rep_fp = (
+                model.fingerprint(symmetry(state))
+                if symmetry is not None
+                else fingerprint_path[-1]
+            )
+            if rep_fp in generated:
+                break  # found a loop
+            generated.add(rep_fp)
+
+            with self._count_lock:
+                self._state_count += 1
+
+            if visitor is not None:
+                visitor.visit(
+                    model, Path.from_fingerprints(model, fingerprint_path)
+                )
+
+            is_awaiting_discoveries = False
+            for i, prop in enumerate(properties):
+                if prop.name in self._discoveries:
+                    continue
+                if prop.expectation is Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        self._discoveries.setdefault(
+                            prop.name, list(fingerprint_path)
+                        )
+                    else:
+                        is_awaiting_discoveries = True
+                elif prop.expectation is Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        self._discoveries.setdefault(
+                            prop.name, list(fingerprint_path)
+                        )
+                    else:
+                        is_awaiting_discoveries = True
+                else:  # EVENTUALLY: discovered only at trace end.
+                    is_awaiting_discoveries = True
+                    if prop.condition(model, state):
+                        ebits.discard(i)
+            if not is_awaiting_discoveries:
+                break
+
+            actions: List[Any] = []
+            model.actions(state, actions)
+            advanced = False
+            while actions:
+                index = chooser.choose_action(chooser_state, state, actions)
+                action = actions[index]
+                # swap_remove (src/checker/simulation.rs:373)
+                actions[index] = actions[-1]
+                actions.pop()
+                next_state = model.next_state(state, action)
+                if next_state is not None:
+                    state = next_state
+                    advanced = True
+                    break
+            if not advanced:
+                break  # terminal: no actions produced a next state
+
+        # Leftover eventually-bits at the end of the trace are
+        # counterexamples (src/checker/simulation.rs:390-396).
+        if not ended_by_depth:
+            for i, prop in enumerate(properties):
+                if i in ebits:
+                    self._discoveries[prop.name] = list(fingerprint_path)
+
+    # --- Checker surface -----------------------------------------------------
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        # No global visited set is kept (src/checker/simulation.rs:413-417).
+        return self._state_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self._model, fps)
+            for name, fps in list(self._discoveries.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        return self._handles
+
+    def is_done(self) -> bool:
+        return all(not h.is_alive() for h in self._handles)
+
+    def join(self) -> "SimulationChecker":
+        for h in self._handles:
+            h.join()
+        if self._errors:
+            raise self._errors[0]
+        return self

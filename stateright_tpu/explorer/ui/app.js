@@ -1,0 +1,156 @@
+// Explorer single-page app: status polling, lazy next-step fetches keyed by
+// the fingerprint path in the URL hash, and keyboard navigation.  Mirrors the
+// behavior of the reference UI (ui/app.js): status poll every 5 s, routing
+// via "#/steps/fp1/fp2", j/k/enter/u keys, per-state property verdicts, and a
+// run-to-completion button.
+
+"use strict";
+
+let selected = 0;
+let steps = [];
+
+function fpPath() {
+  const h = window.location.hash;
+  const m = h.match(/^#\/steps\/?(.*)$/);
+  return m && m[1] ? m[1].replace(/\/+$/, "") : "";
+}
+
+function setHash(path) {
+  window.location.hash = path ? "#/steps/" + path : "#/steps";
+}
+
+async function refreshStatus() {
+  try {
+    const res = await fetch("/.status");
+    const s = await res.json();
+    document.getElementById("st-model").textContent = s.model;
+    document.getElementById("st-states").textContent = s.state_count;
+    document.getElementById("st-unique").textContent = s.unique_state_count;
+    document.getElementById("st-depth").textContent = s.max_depth;
+    const prog = document.getElementById("st-progress");
+    prog.textContent = s.done ? "done" : "checking";
+    prog.title = "Recent path: " + (s.recent_path || "(none)");
+    const props = document.getElementById("properties");
+    props.innerHTML = "";
+    for (const [expectation, name, discovery] of s.properties) {
+      const li = document.createElement("li");
+      const label = expectation + " “" + name + "”";
+      if (discovery) {
+        const a = document.createElement("a");
+        a.href = "#/steps/" + discovery;
+        a.textContent = label + " (discovery)";
+        li.appendChild(a);
+      } else {
+        li.textContent = label;
+      }
+      props.appendChild(li);
+    }
+  } catch (e) {
+    /* server briefly unavailable; retry on next poll */
+  }
+}
+
+function renderPathCrumbs() {
+  const ol = document.getElementById("path");
+  ol.innerHTML = "";
+  const fps = fpPath() ? fpPath().split("/") : [];
+  const root = document.createElement("li");
+  const rootLink = document.createElement("a");
+  rootLink.href = "#/steps";
+  rootLink.textContent = "(init)";
+  root.appendChild(rootLink);
+  ol.appendChild(root);
+  let acc = [];
+  for (const fp of fps) {
+    acc.push(fp);
+    const li = document.createElement("li");
+    const a = document.createElement("a");
+    a.href = "#/steps/" + acc.join("/");
+    a.textContent = fp;
+    a.className = "font-code";
+    li.appendChild(a);
+    ol.appendChild(li);
+  }
+}
+
+function renderSteps() {
+  const ul = document.getElementById("next-steps");
+  ul.innerHTML = "";
+  steps.forEach((st, i) => {
+    const li = document.createElement("li");
+    li.className = i === selected ? "step selected" : "step";
+    const head = document.createElement("div");
+    head.className = "step-head";
+    head.textContent =
+      (st.action ? st.action : "(init state)") +
+      (st.fingerprint ? "  → " + st.fingerprint : "  (ignored)");
+    li.appendChild(head);
+    if (st.outcome) {
+      const out = document.createElement("pre");
+      out.textContent = st.outcome;
+      li.appendChild(out);
+    } else if (st.state) {
+      const pre = document.createElement("pre");
+      pre.textContent = st.state;
+      li.appendChild(pre);
+    }
+    li.onclick = () => follow(i);
+    ul.appendChild(li);
+  });
+  const svgView = document.getElementById("svg-view");
+  const cur = steps[selected];
+  svgView.innerHTML = cur && cur.svg ? cur.svg : "";
+}
+
+async function refreshSteps() {
+  const path = fpPath();
+  const res = await fetch("/.states/" + path);
+  if (!res.ok) {
+    document.getElementById("next-steps").innerHTML =
+      "<li class='error'>" + (await res.text()) + "</li>";
+    return;
+  }
+  steps = await res.json();
+  selected = Math.min(selected, Math.max(steps.length - 1, 0));
+  renderPathCrumbs();
+  renderSteps();
+}
+
+function follow(i) {
+  const st = steps[i];
+  if (!st || !st.fingerprint) return;
+  selected = 0;
+  const path = fpPath();
+  setHash(path ? path + "/" + st.fingerprint : st.fingerprint);
+}
+
+function goUp() {
+  const fps = fpPath() ? fpPath().split("/") : [];
+  fps.pop();
+  selected = 0;
+  setHash(fps.join("/"));
+}
+
+document.addEventListener("keydown", (e) => {
+  if (e.key === "j") {
+    selected = Math.min(selected + 1, steps.length - 1);
+    renderSteps();
+  } else if (e.key === "k") {
+    selected = Math.max(selected - 1, 0);
+    renderSteps();
+  } else if (e.key === "Enter") {
+    follow(selected);
+  } else if (e.key === "u") {
+    goUp();
+  }
+});
+
+document.getElementById("run-to-completion").onclick = async () => {
+  await fetch("/.runtocompletion", { method: "POST" });
+  refreshStatus();
+};
+
+window.addEventListener("hashchange", refreshSteps);
+refreshStatus();
+refreshSteps();
+setInterval(refreshStatus, 5000);

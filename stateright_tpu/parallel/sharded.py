@@ -107,7 +107,7 @@ class ShardedTpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import HashSet, insert_batch
-        from .wave_common import wave_eval
+        from .wave_common import compact, wave_eval
 
         cm = self._compiled
         w = cm.state_width
@@ -190,32 +190,33 @@ class ShardedTpuChecker(Checker):
                 HashSet(key_hi, key_lo), rhi, rlo, rv,
                 dedup_factor=dedup_factor,
             )
-            ok = probe_ok & ~dd_overflow
             sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
             store = store.at[sslot].set(rw, mode="drop")
             parent = parent.at[sslot].set(rg, mode="drop")
             ebits = ebits.at[sslot].set(reb, mode="drop")
 
-            pos2 = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
-            fidx2 = jnp.where(is_new, pos2, jnp.uint32(f * a))
-            new_slots = jnp.zeros((f * a,), jnp.uint32).at[fidx2].set(
-                slot, mode="drop"
-            )
+            new_slots = compact(is_new, slot, f * a)
             n_new_local = jnp.sum(is_new, dtype=jnp.uint32)
             n_new_global = jax.lax.psum(n_new_local, "shards")
-            ok_global = jax.lax.psum(ok.astype(jnp.uint32), "shards") == n
+            probe_global = (
+                jax.lax.psum(probe_ok.astype(jnp.uint32), "shards") == n
+            )
+            dd_global = (
+                jax.lax.psum(dd_overflow.astype(jnp.uint32), "shards") > 0
+            )
             return (
                 table.key_hi,
                 table.key_lo,
                 store,
                 parent,
                 ebits,
-                new_slots[: f * a],
+                new_slots,
                 n_new_local[None],
                 n_new_global[None],
                 generated[None],
                 cand,
-                ok_global[None],
+                probe_global[None],
+                dd_global[None],
             )
 
         shard = P("shards")
@@ -226,7 +227,8 @@ class ShardedTpuChecker(Checker):
                 mesh=self._mesh,
                 in_specs=specs_table + (shard, shard),
                 out_specs=(
-                    specs_table + (shard, shard, shard, shard, shard, shard)
+                    specs_table
+                    + (shard, shard, shard, shard, shard, shard, shard)
                 ),
             ),
             donate_argnums=(0, 1, 2, 3, 4),
@@ -300,18 +302,19 @@ class ShardedTpuChecker(Checker):
         from .hashset import HashSet
 
         def seed_shard(key_hi, key_lo, store, ebits, states, valid):
+            from .wave_common import compact
+
             sts = states[0]
             val = valid[0]
             hi, lo = device_fp64(sts)
-            table, slot, is_new, _probe_ok, _dd_overflow = insert_batch(
+            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
                 HashSet(key_hi, key_lo), hi, lo, val
             )
             sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
             store = store.at[sslot].set(sts, mode="drop")
             ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
-            fidx = jnp.where(is_new, pos, jnp.uint32(is_new.shape[0]))
-            compacted = jnp.zeros_like(slot).at[fidx].set(slot, mode="drop")
+            compacted = compact(is_new, slot, is_new.shape[0])
+            ok = probe_ok & ~dd_overflow
             return (
                 table.key_hi,
                 table.key_lo,
@@ -319,6 +322,7 @@ class ShardedTpuChecker(Checker):
                 ebits,
                 compacted,
                 jnp.sum(is_new, dtype=jnp.uint32)[None],
+                ok[None],
             )
 
         sp = P("shards")
@@ -327,11 +331,11 @@ class ShardedTpuChecker(Checker):
                 seed_shard,
                 mesh=self._mesh,
                 in_specs=(sp, sp, sp, sp, sp, sp),
-                out_specs=(sp, sp, sp, sp, sp, sp),
+                out_specs=(sp, sp, sp, sp, sp, sp, sp),
             ),
             donate_argnums=(0, 1, 2, 3),
         )
-        key_hi, key_lo, store, ebits, seed_slots, seed_counts = seed(
+        key_hi, key_lo, store, ebits, seed_slots, seed_counts, seed_ok = seed(
             key_hi,
             key_lo,
             store,
@@ -339,6 +343,11 @@ class ShardedTpuChecker(Checker):
             jax.device_put(jnp.asarray(seed_states), shard),
             jax.device_put(jnp.asarray(seed_valid), shard),
         )
+        if not np.asarray(seed_ok).all():
+            raise RuntimeError(
+                "init-state seeding overflowed the insert buffers; raise "
+                "capacity or lower dedup_factor"
+            )
         seed_slots = np.asarray(seed_slots).reshape(n, seed_w)
         seed_counts = np.asarray(seed_counts).reshape(n)
         frontiers = [seed_slots[d, : seed_counts[d]] for d in range(n)]
@@ -384,7 +393,8 @@ class ShardedTpuChecker(Checker):
                     n_new_global,
                     generated,
                     cand,
-                    ok,
+                    probe_ok,
+                    dd_overflow,
                 ) = wave(
                     key_hi,
                     key_lo,
@@ -394,11 +404,17 @@ class ShardedTpuChecker(Checker):
                     jax.device_put(jnp.asarray(slots_np.reshape(-1)), shard),
                     jax.device_put(jnp.asarray(counts_np.reshape(-1)), shard),
                 )
-                ok_h = np.asarray(ok).reshape(n)
-                if not ok_h.all():
+                if not np.asarray(probe_ok).all():
                     raise RuntimeError(
                         f"sharded fingerprint table overfull (per-shard "
                         f"capacity {cap_s}); raise capacity"
+                    )
+                if np.asarray(dd_overflow).any():
+                    raise RuntimeError(
+                        "a shard received more distinct states in one wave "
+                        "than its insert dedup buffer holds; lower "
+                        f"dedup_factor (now {self._dedup_factor}) or "
+                        "chunk_size"
                     )
                 n_new_local_h = np.asarray(n_new_local).reshape(n)
                 new_slots_h = np.asarray(new_slots).reshape(n, -1)
@@ -415,7 +431,7 @@ class ShardedTpuChecker(Checker):
                 with self._lock:
                     self._state_count += int(np.asarray(generated)[0])
                     self._unique_count += int(n_new_local_h.sum())
-                cand_h = np.asarray(cand).reshape(n, -1)
+                cand_h = np.asarray(cand).reshape(n, len(props))
                 for d in range(n):
                     for p, prop in enumerate(props):
                         g = int(cand_h[d, p])

@@ -26,6 +26,21 @@ class WaveEval(NamedTuple):
     generated: object  # uint32 scalar: local boundary-passing successors
 
 
+def compact(mask, values, size: int):
+    """Stream-compact ``values[mask]`` into a ``size``-wide buffer (excess
+    dropped; caller checks counts).  One shared definition of the
+    cumsum/where/scatter idiom both engines and the hash set rely on."""
+    import jax.numpy as jnp
+
+    pos = jnp.cumsum(mask.astype(jnp.uint32)) - 1
+    idx = jnp.where(mask, pos, jnp.uint32(size))
+    if values.ndim == 1:
+        buf = jnp.zeros((size,), values.dtype)
+    else:
+        buf = jnp.zeros((size,) + values.shape[1:], values.dtype)
+    return buf.at[idx].set(values, mode="drop")
+
+
 def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
     """The shared wave step (minus dedup/insert, which differs per engine).
 

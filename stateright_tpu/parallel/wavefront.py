@@ -50,8 +50,11 @@ NO_SLOT_HOST = 0xFFFFFFFF
 
 # Compiled device programs shared across checker instances (keyed by
 # CompiledModel.cache_key() + engine shape knobs): re-tracing and re-jitting
-# per spawn_tpu() call would otherwise dominate wall-clock.
+# per spawn_tpu() call would otherwise dominate wall-clock.  Bounded FIFO:
+# models with identity-repr cache keys would otherwise leak one program
+# pair per spawn_tpu() call in long-lived processes.
 _PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 32
 
 
 class TpuChecker(Checker):
@@ -132,7 +135,7 @@ class TpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import HashSet, insert_batch
-        from .wave_common import wave_eval
+        from .wave_common import compact, wave_eval
 
         cm = self._compiled
         w = cm.state_width
@@ -197,9 +200,7 @@ class TpuChecker(Checker):
 
             # Compact new slots into the next frontier (cumsum positions
             # preserve wave order; far cheaper than a sort at B lanes).
-            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
-            fidx = jnp.where(is_new, pos, jnp.uint32(f))
-            frontier = (frontier ^ frontier).at[fidx].set(slot, mode="drop")
+            frontier = compact(is_new, slot, f)
             fcount = jnp.minimum(n_new, jnp.uint32(f))
 
             flags = flags | jnp.where(probe_ok, 0, 1).astype(jnp.uint32)
@@ -271,19 +272,24 @@ class TpuChecker(Checker):
         def seed(key_hi, key_lo, store, ebits, init_padded, n_init):
             hi, lo = device_fp64(init_padded)
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
-            table, slot, is_new, _probe_ok, _dd_overflow = insert_batch(
+            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
                 HashSet(key_hi, key_lo), hi, lo, seed_active
             )
             sslot = jnp.where(is_new, slot, jnp.uint32(cap))
             store = store.at[sslot].set(init_padded, mode="drop")
             ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            pos = jnp.cumsum(is_new.astype(jnp.uint32)) - 1
-            fidx = jnp.where(is_new, pos, jnp.uint32(f))
-            frontier = jnp.zeros((f,), jnp.uint32).at[fidx].set(
-                slot, mode="drop"
-            )
+            frontier = compact(is_new, slot, f)
             fcount = jnp.sum(is_new, dtype=jnp.uint32)
-            return table.key_hi, table.key_lo, store, ebits, frontier, fcount
+            ok = probe_ok & ~dd_overflow
+            return (
+                table.key_hi,
+                table.key_lo,
+                store,
+                ebits,
+                frontier,
+                fcount,
+                ok,
+            )
 
         return seed, run
 
@@ -300,6 +306,8 @@ class TpuChecker(Checker):
         progs = _PROGRAM_CACHE.get(key)
         if progs is None:
             progs = self._build_run()
+            while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+                _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
             _PROGRAM_CACHE[key] = progs
         return progs
 
@@ -344,7 +352,7 @@ class TpuChecker(Checker):
             pad = np.zeros((f - n_init, cm.state_width), np.uint32)
             init_padded = jnp.asarray(np.concatenate([init, pad]))
             seed, run = self._programs()
-            key_hi, key_lo, store, ebits, frontier, fcount = seed(
+            key_hi, key_lo, store, ebits, frontier, fcount, seed_ok = seed(
                 table.key_hi,
                 table.key_lo,
                 store,
@@ -352,6 +360,11 @@ class TpuChecker(Checker):
                 init_padded,
                 jnp.uint32(n_init),
             )
+            if not bool(seed_ok):
+                raise RuntimeError(
+                    "init-state seeding overflowed the insert buffers; "
+                    "raise spawn_tpu(capacity=...) or lower dedup_factor"
+                )
 
             self._state_count = n_init
             self._unique_count = int(fcount)

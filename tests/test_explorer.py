@@ -1,0 +1,100 @@
+"""Explorer web service: status, state navigation, run-to-completion.
+
+Reference: src/checker/explorer.rs (endpoint behavior and JSON shapes,
+src/checker/explorer.rs:134-320).
+"""
+
+import json
+import time
+import urllib.request
+import urllib.error
+
+import pytest
+
+from stateright_tpu.models.fixtures import BinaryClock
+from tests.test_tpu_wavefront import TrapCounter
+
+
+@pytest.fixture()
+def served():
+    checker = BinaryClock().checker().serve(("127.0.0.1", 0), block=False)
+    host, port = checker.explorer_address
+    yield checker, f"http://{host}:{port}"
+    checker.shutdown()
+    checker.explorer_server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def test_status_endpoint(served):
+    _checker, base = served
+    status = _get(base + "/.status")
+    assert status["model"] == "BinaryClock"
+    assert status["unique_state_count"] == 2  # both init states
+    assert status["properties"] == [["Always", "in [0, 1]", None]]
+    assert status["done"] is False
+
+
+def test_states_endpoint_navigation(served):
+    checker, base = served
+    model = checker.model()
+    # Empty path -> the init states.
+    inits = _get(base + "/.states/")
+    assert len(inits) == 2
+    assert sorted(s["state"] for s in inits) == ["0", "1"]
+    fp0 = next(s["fingerprint"] for s in inits if s["state"] == "0")
+    assert fp0 == str(model.fingerprint(0))
+    # Following state 0's fingerprint lists its single GoHigh successor.
+    nexts = _get(base + f"/.states/{fp0}")
+    assert len(nexts) == 1
+    assert nexts[0]["action"] == "GoHigh"
+    assert nexts[0]["state"] == "1"
+    # Descend once more: 0 -> 1 -> 0.
+    fp1 = nexts[0]["fingerprint"]
+    deeper = _get(base + f"/.states/{fp0}/{fp1}")
+    assert deeper[0]["action"] == "GoLow"
+    assert deeper[0]["state"] == "0"
+
+
+def test_states_endpoint_rejects_bad_fingerprints(served):
+    _checker, base = served
+    for bad in ("/.states/notanumber", "/.states/12345"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + bad)
+        assert e.value.code == 404
+
+
+def test_ui_files_served(served):
+    _checker, base = served
+    for path, marker in (
+        ("/", b"Stateright-TPU Explorer"),
+        ("/app.js", b"refreshStatus"),
+        ("/app.css", b"main-flex"),
+    ):
+        with urllib.request.urlopen(base + path, timeout=5) as r:
+            assert marker in r.read()
+
+
+def test_run_to_completion_endpoint():
+    checker = TrapCounter().checker().serve(("127.0.0.1", 0), block=False)
+    try:
+        host, port = checker.explorer_address
+        base = f"http://{host}:{port}"
+        req = urllib.request.Request(base + "/.runtocompletion", method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        deadline = time.time() + 10
+        while not checker.is_done() and time.time() < deadline:
+            time.sleep(0.02)
+        status = _get(base + "/.status")
+        host_bfs = TrapCounter().checker().spawn_bfs().join()
+        assert status["unique_state_count"] == host_bfs.unique_state_count()
+        names = {p[1]: p[2] for p in status["properties"]}
+        assert names["trapped"] is not None  # sometimes example found
+        assert names["reaches limit"] is not None  # eventually counterexample
+    finally:
+        checker.shutdown()
+        checker.explorer_server.shutdown()

@@ -1,0 +1,167 @@
+"""Simulation and on-demand checkers.
+
+Reference: src/checker/simulation.rs (seeded random trace walks, cycle
+detection, no global dedup) and src/checker/on_demand.rs (control-channel
+driven BFS behind the Explorer).
+"""
+
+import pytest
+
+from stateright_tpu import HasDiscoveries, Model, Property
+from stateright_tpu.core.simulation import UniformChooser
+from stateright_tpu.models.fixtures import BinaryClock, DGraph, LinearEquation
+from tests.test_tpu_wavefront import TrapCounter
+
+Guess = LinearEquation.Guess
+
+
+# --- simulation --------------------------------------------------------------
+
+
+def test_simulation_finds_solution():
+    # Reference: can_complete_by_eliminating_properties
+    # (src/checker/simulation.rs:447-461).
+    checker = (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .spawn_simulation(0, UniformChooser())
+        .join()
+    )
+    checker.assert_properties()
+    # Any reachable solution validates; (2, 1) solves 2x + 10y = 14.
+    checker.assert_discovery(
+        "solvable", [Guess.INCREASE_X, Guess.INCREASE_X, Guess.INCREASE_Y]
+    )
+    # The recorded trace itself must genuinely end in a solution.
+    path = checker.discoveries()["solvable"]
+    x, y = path.last_state()
+    assert (2 * x + 10 * y) % 256 == 14
+
+
+def test_simulation_is_seed_reproducible():
+    def run(seed):
+        c = (
+            LinearEquation(a=3, b=7, c=111)
+            .checker()
+            .spawn_simulation(seed, UniformChooser())
+            .join()
+        )
+        return c.discoveries()["solvable"]
+
+    assert run(7) == run(7)
+
+
+def test_simulation_cycle_detection_terminates():
+    # BinaryClock is a pure 2-cycle: without per-trace loop detection a
+    # simulation would walk forever (src/checker/simulation.rs:286-292).
+    # The only property is an unviolated `always`, so the run can only end
+    # via the state-count target — each individual trace must self-terminate
+    # on the cycle for that to happen.
+    checker = (
+        BinaryClock()
+        .checker()
+        .target_state_count(100)
+        .spawn_simulation(0, UniformChooser())
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.state_count() >= 100
+
+
+def test_simulation_counts_are_not_deduped():
+    # 2x + 4y is always even: "solvable" is undiscoverable, so only the
+    # target bounds the run.
+    checker = (
+        LinearEquation(a=2, b=4, c=7)
+        .checker()
+        .target_state_count(2000)
+        .spawn_simulation(0, UniformChooser())
+        .join()
+    )
+    # unique == total by definition (src/checker/simulation.rs:413-417).
+    assert checker.unique_state_count() == checker.state_count()
+    assert checker.state_count() >= 2000
+
+
+def test_simulation_eventually_counterexample_at_trace_end():
+    checker = (
+        TrapCounter()
+        .checker()
+        .finish_when(HasDiscoveries.ANY_FAILURES)
+        .spawn_simulation(3, UniformChooser())
+        .join()
+    )
+    # Eventually "reaches limit" is violated via the trap dead end; the trap
+    # path is reachable with positive probability per trace, and traces
+    # repeat until the failure is found.
+    assert "reaches limit" in checker.discoveries()
+    ce = checker.discoveries()["reaches limit"]
+    assert ce.last_state() == TrapCounter().trap_state
+
+
+class _Cycle(Model):
+    """0 -> 1 -> 2 -> 0; 'reaches three' can never hold, and the cycle break
+    ends each trace, reporting the leftover eventually bit."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        actions.append("next")
+
+    def next_state(self, state, action):
+        return (state + 1) % 3
+
+    def properties(self):
+        return [Property.eventually("reaches three", lambda _m, s: s == 3)]
+
+
+def test_simulation_eventually_counterexample_on_cycle():
+    checker = (
+        _Cycle().checker().spawn_simulation(0, UniformChooser()).join()
+    )
+    assert "reaches three" in checker.discoveries()
+
+
+# --- on-demand ---------------------------------------------------------------
+
+
+def test_on_demand_computes_nothing_until_asked():
+    import time
+
+    checker = LinearEquation(a=2, b=10, c=14).checker().spawn_on_demand()
+    time.sleep(0.2)
+    # Only the init state is known; nothing was expanded.
+    assert checker.unique_state_count() == 1
+    assert checker.state_count() == 1
+    assert checker.discoveries() == {}
+    checker.shutdown()
+
+
+def test_on_demand_expands_only_requested_fingerprints():
+    import time
+
+    model = LinearEquation(a=2, b=10, c=14)
+    checker = model.checker().spawn_on_demand()
+    init_fp = model.fingerprint((0, 0))
+    checker.check_fingerprint(init_fp)
+    deadline = time.time() + 5
+    while checker.unique_state_count() < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    # (0,0) expanded into (1,0) and (0,1), nothing further.
+    assert checker.unique_state_count() == 3
+    checker.shutdown()
+
+
+def test_on_demand_run_to_completion_matches_bfs():
+    m = TrapCounter()
+    bfs = m.checker().spawn_bfs().join()
+    od = m.checker().spawn_on_demand()
+    od.run_to_completion()
+    deadline = __import__("time").time() + 10
+    while not od.is_done() and __import__("time").time() < deadline:
+        __import__("time").sleep(0.01)
+    assert od.unique_state_count() == bfs.unique_state_count()
+    assert od.state_count() == bfs.state_count()
+    assert sorted(od.discoveries()) == sorted(bfs.discoveries())
+    od.shutdown()
