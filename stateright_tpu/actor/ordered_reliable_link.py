@@ -100,7 +100,7 @@ class ActorWrapper(Actor):
         state = LinkState(
             next_send_seq, pending, last_seqs, wrapped_state, wrapped_storage
         )
-        return self._process_output(state, wrapped_out, o, force_state=True)[1]
+        return self._process_output(state, wrapped_out, o)
 
     def on_msg(self, id: Id, state: LinkState, src: Id, msg: Any, o: Out):
         if isinstance(msg, Deliver):
@@ -127,7 +127,7 @@ class ActorWrapper(Actor):
                 next_wrapped if next_wrapped is not None else state.wrapped_state,
                 state.wrapped_storage,
             )
-            _saved, state = self._process_output(state, wrapped_out, o)
+            state = self._process_output(state, wrapped_out, o)
         elif isinstance(msg, Ack):
             pending = tuple(
                 (seq, dm) for seq, dm in state.msgs_pending_ack if seq != msg.seq
@@ -174,15 +174,12 @@ class ActorWrapper(Actor):
                     next_wrapped,
                     state.wrapped_storage,
                 )
-            _saved, state = self._process_output(state, wrapped_out, o)
-            return state
+            return self._process_output(state, wrapped_out, o)
         return None
 
     # --- plumbing (reference: process_output, :224-269) ----------------------
 
-    def _process_output(
-        self, state: LinkState, wrapped_out: Out, o: Out, force_state=False
-    ):
+    def _process_output(self, state: LinkState, wrapped_out: Out, o: Out):
         next_send_seq = state.next_send_seq
         pending = dict(state.msgs_pending_ack)
         wrapped_storage = state.wrapped_storage
@@ -220,4 +217,4 @@ class ActorWrapper(Actor):
                     state.wrapped_storage,
                 )
             )
-        return should_save, state
+        return state

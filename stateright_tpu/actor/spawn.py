@@ -157,7 +157,7 @@ def _actor_main(
                 )
                 try:
                     sock.sendto(msg_serialize(cmd.msg), dst)
-                except (OSError, ValueError):
+                except (OSError, ValueError, TypeError):
                     pass  # unable to send/serialize: ignore, like the reference
             elif isinstance(cmd, SetTimerCmd):
                 lo, hi = cmd.duration

@@ -103,6 +103,9 @@ def _state_views(checker, fp_path: str) -> List[dict]:
             raise ValueError(f"Unable to parse fingerprints {fps_str}")
 
     results = []
+    # The property view is per-checker, not per-successor; discovery paths
+    # are reconstructed by re-execution, so compute it once per request.
+    properties = _properties_view(checker)
     if not fps:
         for state in model.init_states():
             fp = model.fingerprint(state)
@@ -117,7 +120,7 @@ def _state_views(checker, fp_path: str) -> List[dict]:
                     "outcome": None,
                     "state": repr(state),
                     "fingerprint": str(fp),
-                    "properties": _properties_view(checker),
+                    "properties": properties,
                     "svg": svg,
                 }
             )
@@ -139,7 +142,7 @@ def _state_views(checker, fp_path: str) -> List[dict]:
                     "action": model.format_action(action),
                     "outcome": None,
                     "state": None,
-                    "properties": _properties_view(checker),
+                    "properties": properties,
                     "svg": None,
                 }
             )
@@ -156,7 +159,7 @@ def _state_views(checker, fp_path: str) -> List[dict]:
                 "outcome": outcome,
                 "state": repr(state),
                 "fingerprint": str(fp),
-                "properties": _properties_view(checker),
+                "properties": properties,
                 "svg": svg,
             }
         )

@@ -1,0 +1,117 @@
+"""Modeling environment interaction: a client actor drives a counter.
+
+Reference: examples/interaction.rs — an input-modeling ``Client`` uses
+timers to sequence an increment then a query against a ``Counter``, with an
+``eventually "success"`` property under ``target_max_depth(30)`` (the state
+space is loosely bounded, examples/interaction.rs:37-47).
+
+The reference composes the two heterogeneous actor types with the
+``choice!`` machinery (src/actor.rs:413-571) because its ``ActorModel`` is
+generic over a single actor type.  This port's ``ActorModel`` holds a list
+of duck-typed actors, so heterogeneous systems need no wrapper — the
+capability exists structurally; this example is its demonstration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..actor import Actor, ActorModel, Id, Network, Out, model_timeout
+from ..core.model import Expectation
+
+
+@dataclass(frozen=True)
+class IncrementRequest:
+    n: int
+
+
+@dataclass(frozen=True)
+class ReportRequest:
+    pass
+
+
+@dataclass(frozen=True)
+class ReplyCount:
+    n: int
+
+
+CLIENT_INPUT = "ClientInput"
+CLIENT_QUERY = "ClientQuery"
+
+
+@dataclass(frozen=True)
+class CounterState:
+    addr: Id
+    counter: int
+
+
+class Counter(Actor):
+    def __init__(self, initial_state: CounterState):
+        self.initial_state = initial_state
+
+    def on_start(self, id: Id, storage, o: Out) -> CounterState:
+        return self.initial_state
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if isinstance(msg, IncrementRequest):
+            return replace(state, counter=state.counter + msg.n)
+        if isinstance(msg, ReportRequest):
+            o.send(src, ReplyCount(state.counter))
+        return None
+
+
+@dataclass(frozen=True)
+class InputState:
+    wait_cycles: int  # observability only, for the Explorer
+    success: bool
+
+
+class Client(Actor):
+    def __init__(self, threshold: int, counter_addr: Id):
+        self.threshold = threshold
+        self.counter_addr = counter_addr
+
+    def on_start(self, id: Id, storage, o: Out) -> InputState:
+        o.set_timer(CLIENT_INPUT, model_timeout())
+        return InputState(wait_cycles=0, success=False)
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if isinstance(msg, ReplyCount) and msg.n >= self.threshold:
+            return replace(state, success=True)
+        return None
+
+    def on_timeout(self, id: Id, state, timer, o: Out):
+        if timer == CLIENT_INPUT:
+            # Query after incrementing.
+            o.set_timer(CLIENT_QUERY, model_timeout())
+            o.send(self.counter_addr, IncrementRequest(3))
+            return replace(state, wait_cycles=state.wait_cycles + 1)
+        if timer == CLIENT_QUERY:
+            o.send(self.counter_addr, ReportRequest())
+            return replace(state, wait_cycles=state.wait_cycles + 1)
+        return None
+
+
+def build_model(threshold: int = 3, network=None) -> ActorModel:
+    """On the reference's default unordered nonduplicating network the
+    eventually property has a genuine counterexample: the query can overtake
+    the increment, and the resulting ``ReplyCount(0)`` delivery is a no-op,
+    which unordered networks suppress (src/actor/model.rs:360-366) — a
+    stuck terminal state.  An ordered network forbids the overtake."""
+
+    def success(_m, state):
+        return any(
+            isinstance(s, InputState) and s.success for s in state.actor_states
+        )
+
+    return (
+        ActorModel(cfg=None)
+        .actor(Client(threshold=threshold, counter_addr=Id(1)))
+        .actor(Counter(CounterState(addr=Id(1), counter=0)))
+        .init_network_(
+            network
+            if network is not None
+            else Network.new_unordered_nonduplicating()
+        )
+        .property(Expectation.EVENTUALLY, "success", success)
+    )

@@ -1,0 +1,70 @@
+"""Timer-semantics demo: pingers driven entirely by recurring timers.
+
+Reference: examples/timers.rs — three timers per actor (Even/Odd/NoOp),
+each re-armed on firing; the Even/Odd timers ping even/odd peers.  The
+model exists to exercise set/cancel/re-arm timer semantics under checking
+(durations are irrelevant: model_timeout() is the zero range).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..actor import Actor, ActorModel, Id, Network, Out, model_peers, model_timeout
+from ..core.model import Expectation
+
+PING, PONG = "Ping", "Pong"
+EVEN, ODD, NO_OP = "Even", "Odd", "NoOp"
+
+
+@dataclass(frozen=True)
+class PingerState:
+    sent: int
+    received: int
+
+
+class PingerActor(Actor):
+    def __init__(self, peer_ids):
+        self.peer_ids = list(peer_ids)
+
+    def name(self) -> str:
+        return "Pinger"
+
+    def on_start(self, id: Id, storage, o: Out) -> PingerState:
+        o.set_timer(EVEN, model_timeout())
+        o.set_timer(ODD, model_timeout())
+        o.set_timer(NO_OP, model_timeout())
+        return PingerState(sent=0, received=0)
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if msg == PING:
+            o.send(src, PONG)
+            return None
+        if msg == PONG:
+            return replace(state, received=state.received + 1)
+        return None
+
+    def on_timeout(self, id: Id, state, timer, o: Out):
+        if timer in (EVEN, ODD):
+            o.set_timer(timer, model_timeout())
+            parity = 0 if timer == EVEN else 1
+            sent = state.sent
+            for dst in self.peer_ids:
+                if int(dst) % 2 == parity:
+                    sent += 1
+                    o.send(dst, PING)
+            return replace(state, sent=sent) if sent != state.sent else None
+        if timer == NO_OP:
+            o.set_timer(timer, model_timeout())
+            return None
+        return None
+
+
+def build_model(server_count: int = 3, network=None) -> ActorModel:
+    model = ActorModel(cfg=None)
+    model.add_actors(
+        PingerActor(model_peers(i, server_count)) for i in range(server_count)
+    )
+    return model.init_network_(
+        network if network is not None else Network.new_unordered_nonduplicating()
+    ).property(Expectation.ALWAYS, "true", lambda _m, _s: True)

@@ -1,0 +1,13 @@
+"""Utility data structures (reference: src/util.rs and submodules).
+
+Python's built-in ``frozenset`` / ``dict`` / ``tuple`` already provide the
+hashable-collection semantics of the reference's ``HashableHashSet`` /
+``HashableHashMap`` (the canonical fingerprint encoding hashes sets and
+maps order-insensitively — ops/fingerprint.py:157-169); ``DenseNatMap``
+and ``VectorClock`` are ported explicitly.
+"""
+
+from .dense_nat_map import DenseNatMap
+from .vector_clock import VectorClock
+
+__all__ = ["DenseNatMap", "VectorClock"]

@@ -1,0 +1,92 @@
+"""Vector clocks: a partial causal order on distributed events.
+
+Reference: src/util/vector_clock.rs.  Trailing zeros are insignificant —
+equality, hashing, fingerprinting, and comparison all ignore them, so
+``VectorClock([1, 0])`` equals ``VectorClock([1])``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+
+class VectorClock:
+    __slots__ = ("_elems",)
+
+    def __init__(self, elems: Iterable[int] = ()):
+        self._elems: Tuple[int, ...] = tuple(elems)
+
+    def merge_max(self, other: "VectorClock") -> "VectorClock":
+        """Element-wise maximum (reference:18-30)."""
+        n = max(len(self._elems), len(other._elems))
+        return VectorClock(
+            max(self._get(i), other._get(i)) for i in range(n)
+        )
+
+    def incremented(self, index: int) -> "VectorClock":
+        """A copy with component ``index`` incremented (reference:32-39)."""
+        elems = list(self._elems)
+        if index >= len(elems):
+            elems.extend([0] * (index + 1 - len(elems)))
+        elems[index] += 1
+        return VectorClock(elems)
+
+    def _get(self, i: int) -> int:
+        return self._elems[i] if i < len(self._elems) else 0
+
+    def _significant(self) -> Tuple[int, ...]:
+        cutoff = 0
+        for i, e in enumerate(self._elems):
+            if e != 0:
+                cutoff = i + 1
+        return self._elems[:cutoff]
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, VectorClock)
+            and self._significant() == other._significant()
+        )
+
+    def __hash__(self) -> int:
+        # Trailing zeros ignored so equal clocks hash equal (reference:53-63).
+        return hash(self._significant())
+
+    def __canon_words__(self, out) -> None:
+        from ..ops.fingerprint import canon_words
+
+        canon_words(("VectorClock", self._significant()), out)
+
+    def partial_cmp(self, other: "VectorClock") -> Optional[int]:
+        """-1 / 0 / 1 for happens-before / equal / happens-after; None when
+        incomparable (concurrent).  Reference:84-106."""
+        expected = 0
+        n = max(len(self._elems), len(other._elems))
+        for i in range(n):
+            a, b = self._get(i), other._get(i)
+            ordering = (a > b) - (a < b)
+            if expected == 0:
+                expected = ordering
+            elif ordering != expected and ordering != 0:
+                return None
+        return expected
+
+    def __lt__(self, other) -> bool:
+        return self.partial_cmp(other) == -1
+
+    def __le__(self, other) -> bool:
+        c = self.partial_cmp(other)
+        return c is not None and c <= 0
+
+    def __gt__(self, other) -> bool:
+        return self.partial_cmp(other) == 1
+
+    def __ge__(self, other) -> bool:
+        c = self.partial_cmp(other)
+        return c is not None and c >= 0
+
+    def __repr__(self) -> str:
+        return f"VectorClock({list(self._elems)!r})"
+
+    def __str__(self) -> str:
+        # Reference Display (reference:42-51).
+        return "<" + "".join(f"{c}, " for c in self._elems) + "...>"

@@ -1,0 +1,138 @@
+"""Raft, LWW-register, timers, interaction models + VectorClock utility.
+
+Reference: examples/raft.rs, examples/lww-register.rs, examples/timers.rs,
+examples/interaction.rs, src/util/vector_clock.rs.
+"""
+
+import pytest
+
+from stateright_tpu.models.interaction import build_model as interaction_model
+from stateright_tpu.models.lww_register import build_model as lww_model
+from stateright_tpu.models.raft import LEADER, RaftModelCfg
+from stateright_tpu.models.timers import build_model as timers_model
+from stateright_tpu.utils.vector_clock import VectorClock
+
+
+def test_raft_elects_leader_and_stays_safe():
+    # Reference checks raft with target_max_depth BFS (examples/raft.rs:
+    # 520-535).  By depth 6 an election completes; both safety properties
+    # must stay unviolated.
+    checker = (
+        RaftModelCfg(server_count=3)
+        .into_model()
+        .checker()
+        .target_max_depth(6)
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_any_discovery("Election Liveness")
+    checker.assert_no_discovery("Election Safety")
+    checker.assert_no_discovery("State Machine Safety")
+    # Determinism pin for this port (not a reference-published value).
+    assert checker.unique_state_count() == 4933
+
+
+@pytest.mark.slow
+def test_raft_commits_a_log_entry():
+    checker = (
+        RaftModelCfg(server_count=3)
+        .into_model()
+        .checker()
+        .target_max_depth(8)
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_any_discovery("Log Liveness")
+    checker.assert_no_discovery("Election Safety")
+    checker.assert_no_discovery("State Machine Safety")
+
+
+def test_lww_register_eventually_consistent():
+    # Reference: lww-register check 2 with a depth bound
+    # (examples/lww-register.rs:190-196).
+    checker = (
+        lww_model(2)
+        .checker()
+        .target_max_depth(5)
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_no_discovery("eventually consistent")
+    assert checker.unique_state_count() > 50
+
+
+def test_timers_model_explores_without_violation():
+    checker = (
+        timers_model(3)
+        .checker()
+        .target_max_depth(5)
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_no_discovery("true")
+    assert checker.unique_state_count() > 10
+
+
+def test_interaction_counterexample_on_unordered_network():
+    # On the reference's default unordered network the query can overtake
+    # the increment and the ReplyCount(0) delivery is a suppressed no-op —
+    # a stuck terminal state violating eventually "success"
+    # (src/actor/model.rs:360-366 semantics, faithfully reproduced).
+    checker = (
+        interaction_model(threshold=3)
+        .checker()
+        .target_max_depth(12)
+        .spawn_bfs()
+        .join()
+    )
+    ce = checker.assert_any_discovery("success")
+    assert not any(
+        getattr(s, "success", False)
+        for s in ce.last_state().actor_states
+    )
+
+
+def test_interaction_eventually_succeeds_on_ordered_network():
+    from stateright_tpu.actor import Network
+
+    checker = (
+        interaction_model(threshold=3, network=Network.new_ordered())
+        .checker()
+        .target_max_depth(12)
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()  # no counterexample: overtake impossible
+
+
+# --- VectorClock (src/util/vector_clock.rs tests) ----------------------------
+
+
+def test_vector_clock_display():
+    assert str(VectorClock([1, 2, 3, 4])) == "<1, 2, 3, 4, ...>"
+
+
+def test_vector_clock_trailing_zeros_insignificant():
+    assert VectorClock([1, 2]) == VectorClock([1, 2, 0, 0])
+    assert hash(VectorClock([1, 2])) == hash(VectorClock([1, 2, 0]))
+    from stateright_tpu.ops.fingerprint import fingerprint
+
+    assert fingerprint(VectorClock([1, 2])) == fingerprint(VectorClock([1, 2, 0]))
+
+
+def test_vector_clock_merge_and_increment():
+    a = VectorClock([1, 0, 3])
+    b = VectorClock([0, 2])
+    assert a.merge_max(b) == VectorClock([1, 2, 3])
+    assert VectorClock().incremented(2) == VectorClock([0, 0, 1])
+    assert VectorClock([1]).incremented(0) == VectorClock([2])
+
+
+def test_vector_clock_partial_order():
+    assert VectorClock([1, 2]) < VectorClock([1, 3])
+    assert VectorClock([1, 3]) > VectorClock([1, 2])
+    assert VectorClock([1, 2]) <= VectorClock([1, 2, 0])
+    # Concurrent clocks are incomparable.
+    assert VectorClock([1, 0]).partial_cmp(VectorClock([0, 1])) is None
+    assert not VectorClock([1, 0]) < VectorClock([0, 1])
+    assert not VectorClock([1, 0]) > VectorClock([0, 1])
