@@ -93,11 +93,13 @@ class Client(Actor):
 
 
 def build_model(threshold: int = 3, network=None) -> ActorModel:
-    """On the reference's default unordered nonduplicating network the
-    eventually property has a genuine counterexample: the query can overtake
-    the increment, and the resulting ``ReplyCount(0)`` delivery is a no-op,
-    which unordered networks suppress (src/actor/model.rs:360-366) — a
-    stuck terminal state.  An ordered network forbids the overtake."""
+    """Defaults to the unordered *duplicating* network like the reference
+    (ActorModel's default, src/actor/model.rs:103): persistent envelopes
+    keep every state expandable, so the depth-bounded check finds no
+    eventually-counterexample.  On a NONduplicating network the query can
+    overtake the increment and the consumed ``ReplyCount(0)`` delivery is a
+    suppressed no-op (src/actor/model.rs:360-366) — a stuck terminal state
+    that genuinely violates eventually "success"."""
 
     def success(_m, state):
         return any(
@@ -111,7 +113,7 @@ def build_model(threshold: int = 3, network=None) -> ActorModel:
         .init_network_(
             network
             if network is not None
-            else Network.new_unordered_nonduplicating()
+            else Network.new_unordered_duplicating()
         )
         .property(Expectation.EVENTUALLY, "success", success)
     )

@@ -1,0 +1,234 @@
+"""Bit-packed TPU form of the ping-pong actor model.
+
+This is the actor-layer compilation proof: unlike 2pc (a direct model),
+ping_pong is an ``ActorModel`` whose state embeds the *network* — here the
+unordered **duplicating** fabric: a set of envelopes that persist across
+deliveries plus the last-delivered marker (src/actor/network.rs:52-57,
+224-228) — and whose actions are the model-generated Deliver/Drop families
+(src/actor/model.rs:269-333) with unordered no-op suppression
+(src/actor/model.rs:360-366).
+
+Packing (host model: models/ping_pong.py, maintains_history=False; the
+constant history/timers/crashed/storages fields need no bits):
+
+- bits 0-3:  actor 0 counter; bits 4-7: actor 1 counter (values can
+  transiently reach max_nat+1 before the boundary filter removes them).
+- bits 8..8+E: envelope presence bitmap, E = 2*(max_nat+2) possible
+  envelopes — ``Ping(v)`` (always 0→1) at id v, ``Pong(v)`` (always 1→0)
+  at id (max_nat+2)+v, for v in [0, max_nat+1].
+- next 5 bits: last-delivered marker (0 = none, else 1+envelope id).
+
+Static action arity A = 2E: Deliver(e) then Drop(e) per possible envelope;
+Drop lanes are valid only on a lossy network.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..parallel.compiled import CompiledModel
+from .ping_pong import Ping, Pong
+
+_U32 = jnp.uint32
+_C0_SHIFT, _C1_SHIFT, _ENV_SHIFT = 0, 4, 8
+
+
+class PingPongCompiled(CompiledModel):
+    state_width = 2
+
+    def __init__(self, model):
+        self.model = model
+        cfg = model.cfg
+        if cfg.maintains_history:
+            raise ValueError(
+                "packed ping_pong supports maintains_history=False (the "
+                "golden configurations)"
+            )
+        if cfg.max_nat > 13:
+            raise ValueError("packed ping_pong encoding supports max_nat <= 13")
+        self.max_nat = cfg.max_nat
+        self.lossy = model.lossy_network
+        self.e = 2 * (cfg.max_nat + 2)  # possible envelopes
+        self.last_shift = _ENV_SHIFT + self.e
+        self.max_actions = 2 * self.e
+
+    def cache_key(self):
+        return (
+            type(self).__qualname__,
+            self.max_nat,
+            self.lossy,
+        )
+
+    # --- envelope numbering --------------------------------------------------
+
+    def _env_id(self, env: Envelope) -> int:
+        if isinstance(env.msg, Ping):
+            assert (int(env.src), int(env.dst)) == (0, 1)
+            return env.msg.value
+        assert (int(env.src), int(env.dst)) == (1, 0)
+        return (self.max_nat + 2) + env.msg.value
+
+    def _env_of(self, env_id: int) -> Envelope:
+        half = self.max_nat + 2
+        if env_id < half:
+            return Envelope(Id(0), Id(1), Ping(env_id))
+        return Envelope(Id(1), Id(0), Pong(env_id - half))
+
+    # --- host side -----------------------------------------------------------
+
+    def encode(self, s: ActorModelState) -> np.ndarray:
+        bits = int(s.actor_states[0]) << _C0_SHIFT
+        bits |= int(s.actor_states[1]) << _C1_SHIFT
+        for env in s.network.envelopes:
+            bits |= 1 << (_ENV_SHIFT + self._env_id(env))
+        last = s.network.last_msg
+        bits |= (
+            (1 + self._env_id(last)) if last is not None else 0
+        ) << self.last_shift
+        return np.array([bits & 0xFFFFFFFF, bits >> 32], dtype=np.uint32)
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        bits = int(words[0]) | (int(words[1]) << 32)
+        c0 = (bits >> _C0_SHIFT) & 0xF
+        c1 = (bits >> _C1_SHIFT) & 0xF
+        envs = frozenset(
+            self._env_of(e)
+            for e in range(self.e)
+            if (bits >> (_ENV_SHIFT + e)) & 1
+        )
+        last_code = (bits >> self.last_shift) & 0x1F
+        network = Network.new_unordered_duplicating(envs)
+        if last_code:
+            network = Network(
+                kind=network.kind,
+                envelopes=network.envelopes,
+                last_msg=self._env_of(last_code - 1),
+            )
+        return ActorModelState(
+            actor_states=(c0, c1),
+            network=network,
+            timers_set=(frozenset(), frozenset()),
+            random_choices=((), ()),
+            crashed=(False, False),
+            history=(0, 0),
+            actor_storages=(None, None),
+        )
+
+    # --- device side ---------------------------------------------------------
+
+    def _unpack(self, state):
+        bits_lo = state[0]
+        bits_hi = state[1]
+        c0 = (bits_lo >> _U32(_C0_SHIFT)) & _U32(0xF)
+        c1 = (bits_lo >> _U32(_C1_SHIFT)) & _U32(0xF)
+        return bits_lo, bits_hi, c0, c1
+
+    def _bit(self, pos: int):
+        """(lo_mask, hi_mask) for absolute bit position ``pos``."""
+        if pos < 32:
+            return _U32(1 << pos), _U32(0)
+        return _U32(0), _U32(1 << (pos - 32))
+
+    def step(self, state):
+        half = self.max_nat + 2
+        lo, hi, c0, c1 = self._unpack(state)
+        nexts_lo, nexts_hi, valids = [], [], []
+
+        def emit(valid, nlo, nhi):
+            valids.append(valid)
+            nexts_lo.append(nlo)
+            nexts_hi.append(nhi)
+
+        last_clear_lo, last_clear_hi = _U32(0xFFFFFFFF), _U32(0xFFFFFFFF)
+        for b in range(5):
+            pos = self.last_shift + b
+            blo, bhi = self._bit(pos)
+            last_clear_lo &= ~blo
+            last_clear_hi &= ~bhi
+
+        for e in range(self.e):
+            plo, phi = self._bit(_ENV_SHIFT + e)
+            present = ((lo & plo) | (hi & phi)) != 0
+            is_ping = e < half
+            v = e if is_ping else e - half
+
+            # Deliver(e): guard = receiver counter == msg value (else the
+            # handler is a no-op, suppressed on unordered networks).
+            if is_ping:
+                guard = c1 == _U32(v)
+                # c1 += 1; send Pong(v); last = e
+                nlo = (lo & _U32(~(0xF << _C1_SHIFT) & 0xFFFFFFFF)) | (
+                    (c1 + _U32(1)) << _U32(_C1_SHIFT)
+                )
+                nhi = hi
+                slo, shi = self._bit(_ENV_SHIFT + half + v)
+            else:
+                guard = c0 == _U32(v)
+                # c0 += 1; send Ping(v+1); last = e
+                nlo = (lo & _U32(~0xF & 0xFFFFFFFF)) | (c0 + _U32(1))
+                nhi = hi
+                slo, shi = self._bit(_ENV_SHIFT + v + 1)
+            nlo = nlo | slo
+            nhi = nhi | shi
+            # last-delivered marker := 1 + e
+            llo, lhi = self._last_code_bits(1 + e)
+            nlo = (nlo & last_clear_lo) | llo
+            nhi = (nhi & last_clear_hi) | lhi
+            emit(present & guard, nlo, nhi)
+
+        for e in range(self.e):
+            plo, phi = self._bit(_ENV_SHIFT + e)
+            present = ((lo & plo) | (hi & phi)) != 0
+            # Drop(e): remove the envelope; marker unchanged.
+            if self.lossy:
+                emit(present, lo & ~plo, hi & ~phi)
+            else:
+                emit(jnp.zeros((), jnp.bool_), lo, hi)
+
+        nexts = jnp.stack(
+            [jnp.stack(nexts_lo), jnp.stack(nexts_hi)], axis=-1
+        ).astype(_U32)
+        return nexts, jnp.stack(valids)
+
+    def _last_code_bits(self, code: int):
+        lo = hi = 0
+        for b in range(5):
+            if (code >> b) & 1:
+                pos = self.last_shift + b
+                if pos < 32:
+                    lo |= 1 << pos
+                else:
+                    hi |= 1 << (pos - 32)
+        return _U32(lo), _U32(hi)
+
+    def boundary(self, state):
+        _lo, _hi, c0, c1 = self._unpack(state)
+        m = _U32(self.max_nat)
+        return (c0 <= m) & (c1 <= m)
+
+    def property_conds(self, state):
+        _lo, _hi, c0, c1 = self._unpack(state)
+        max_nat = _U32(self.max_nat)
+        delta_ok = jnp.where(c0 > c1, c0 - c1, c1 - c0) <= _U32(1)
+        at_max = (c0 == max_nat) | (c1 == max_nat)
+        over_max = (c0 == max_nat + _U32(1)) | (c1 == max_nat + _U32(1))
+        true_ = jnp.ones((), jnp.bool_)
+        # Order matches PingPongCfg.into_model() properties:
+        #   always "delta within 1", sometimes "can reach max",
+        #   eventually "must reach max", eventually "must exceed max",
+        #   always "#in <= #out", eventually "#out <= #in + 1"
+        # (history is constant (0, 0) when not maintained).
+        return jnp.stack(
+            [delta_ok, at_max, at_max, over_max, true_, true_]
+        )
+
+
+def compiled_ping_pong(model) -> PingPongCompiled:
+    """Compiled form for a ``PingPongCfg(...).into_model()`` model on a
+    (possibly lossy) unordered duplicating network."""
+    return PingPongCompiled(model)

@@ -16,7 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 from typing import FrozenSet, Optional, Tuple
 
-from ..actor import Actor, ActorModel, Id, Network, Out, model_timeout
+from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_timeout
 from ..core.model import Expectation
 from ..ops.fingerprint import canon_words
 
@@ -123,10 +123,6 @@ class NodeState:
         )
 
 
-def _majority(n: int) -> int:
-    return (n + 1) // 2
-
-
 class RaftActor(Actor):
     def __init__(self, peer_count: int):
         self.peer_count = peer_count
@@ -178,7 +174,7 @@ class RaftActor(Actor):
             ):
                 votes = s.votes_received | {msg.voter_id}
                 s = replace(s, votes_received=votes)
-                if len(votes) >= _majority(self.peer_count + 1):
+                if len(votes) >= majority(self.peer_count):
                     s = replace(
                         s,
                         current_role=LEADER,
@@ -345,7 +341,7 @@ class RaftActor(Actor):
         )
 
     def _commit_log_entries(self, s: NodeState) -> NodeState:
-        min_acks = _majority(self.peer_count + 1)
+        min_acks = majority(self.peer_count)
         ready_max = 0
         for i in range(s.commit_length + 1, len(s.log) + 1):
             if sum(1 for a in s.acked_length if a >= i) >= min_acks:

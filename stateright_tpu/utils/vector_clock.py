@@ -59,6 +59,10 @@ class VectorClock:
     def partial_cmp(self, other: "VectorClock") -> Optional[int]:
         """-1 / 0 / 1 for happens-before / equal / happens-after; None when
         incomparable (concurrent).  Reference:84-106."""
+        if not isinstance(other, VectorClock):
+            raise TypeError(
+                f"cannot compare VectorClock with {type(other).__name__}"
+            )
         expected = 0
         n = max(len(self._elems), len(other._elems))
         for i in range(n):
@@ -71,16 +75,24 @@ class VectorClock:
         return expected
 
     def __lt__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
         return self.partial_cmp(other) == -1
 
     def __le__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
         c = self.partial_cmp(other)
         return c is not None and c <= 0
 
     def __gt__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
         return self.partial_cmp(other) == 1
 
     def __ge__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
         c = self.partial_cmp(other)
         return c is not None and c >= 0
 

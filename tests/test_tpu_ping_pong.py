@@ -1,0 +1,65 @@
+"""Actor-model-on-TPU: the compiled ping_pong golden configurations.
+
+Proves the actor-layer compilation path — network-in-state (duplicating
+set + last-delivered marker), model-generated Deliver/Drop action families,
+unordered no-op suppression, boundary filtering, and all three property
+expectations — against the host oracle's golden counts
+(src/actor/model.rs:875,1055,1095).
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.ping_pong import PingPongCfg  # noqa: E402
+from stateright_tpu.models.ping_pong_compiled import (  # noqa: E402
+    compiled_ping_pong,
+)
+
+
+def _parity(max_nat, lossy, golden_unique):
+    model = (
+        PingPongCfg(maintains_history=False, max_nat=max_nat)
+        .into_model()
+        .lossy_network_(lossy)
+    )
+    host = model.checker().spawn_bfs().join()
+    tpu = (
+        model.checker()
+        .spawn_tpu(
+            capacity=1 << 13,
+            max_frontier=1 << 11,
+            device=jax.devices("cpu")[0],
+            compiled=compiled_ping_pong(model),
+        )
+        .join()
+    )
+    assert host.unique_state_count() == golden_unique
+    assert tpu.unique_state_count() == golden_unique
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    return host, tpu
+
+
+def test_ping_pong_lossy_duplicating_max1():
+    # 14 unique states (src/actor/model.rs:875); "must reach max" has a
+    # counterexample (drop everything), "must exceed max" is unreachable.
+    _host, tpu = _parity(1, True, 14)
+    d = tpu.discoveries()
+    assert "can reach max" in d
+    assert "must reach max" in d
+    assert "must exceed max" in d
+
+
+def test_ping_pong_lossy_duplicating_max5():
+    _parity(5, True, 4094)  # src/actor/model.rs:1055
+
+
+def test_ping_pong_lossless_max5():
+    # 11 unique states (src/actor/model.rs:1095); without loss the counter
+    # must climb, so only the impossible "must exceed max" is discovered.
+    _host, tpu = _parity(5, False, 11)
+    d = tpu.discoveries()
+    assert "must reach max" not in d
+    assert "must exceed max" in d
