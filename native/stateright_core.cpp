@@ -1,0 +1,168 @@
+// Native host core: the performance-critical pieces of the CPU checker.
+//
+// The reference implements its whole runtime natively (Rust); this library
+// is the C++ equivalent of its L0 hot paths (SURVEY §2.1): the stable
+// 64-bit fingerprint mixer (src/lib.rs:340-387) and the lock-striped
+// concurrent visited set with predecessor tracking — the DashMap analog of
+// src/checker/bfs.rs:29-31.  Exposed through a plain C ABI for ctypes
+// (pybind11 is not available in this environment).
+//
+// The mixer is bit-identical to ops/fingerprint.fp64_words (two
+// murmur3-style 32-bit lanes), which tests pin.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t C1 = 0xCC9E2D51u;
+constexpr uint32_t C2 = 0x1B873593u;
+constexpr uint32_t SEED_HI = 0x9E3779B9u;
+constexpr uint32_t SEED_LO = 0x85EBCA6Bu;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t mix32(uint32_t h, uint32_t w) {
+  uint32_t k = w * C1;
+  k = rotl32(k, 15);
+  k = k * C2;
+  h ^= k;
+  h = rotl32(h, 13);
+  h = h * 5u + 0xE6546B64u;
+  return h;
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bit-identical to ops/fingerprint.fp64_words (nonzero result guaranteed).
+uint64_t sr_fp64_words(const uint32_t* words, uint64_t n) {
+  uint32_t h1 = SEED_HI;
+  uint32_t h2 = SEED_LO;
+  for (uint64_t i = 0; i < n; ++i) {
+    h1 = mix32(h1, words[i]);
+    h2 = mix32(h2, words[i]);
+  }
+  h1 = fmix32(h1 ^ static_cast<uint32_t>(n));
+  h2 = fmix32(h2 ^ static_cast<uint32_t>(n * 0x9E3779B1u));
+  uint64_t fp = (static_cast<uint64_t>(h1) << 32) | h2;
+  return fp ? fp : 1;
+}
+
+// Batched form: rows of a [count, width] uint32 matrix.
+void sr_fp64_batch(const uint32_t* words, uint64_t count, uint64_t width,
+                   uint64_t* out) {
+  for (uint64_t i = 0; i < count; ++i) {
+    out[i] = sr_fp64_words(words + i * width, width);
+  }
+}
+
+// --- concurrent visited set (fp -> parent fp) -------------------------------
+//
+// Open addressing over power-of-two capacity with striped mutexes; the
+// GIL is released during ctypes calls, so checker worker threads contend
+// only per stripe — the moral equivalent of DashMap's shard locks.
+
+struct FpSet {
+  // Atomics: readers probe without stripe locks, so the key store must be
+  // a release (after the parent store) and reads acquires — a plain-store
+  // scheme would be a data race however the hardware orders it.
+  std::vector<std::atomic<uint64_t>> keys;     // 0 = empty (fps are nonzero)
+  std::vector<std::atomic<uint64_t>> parents;  // 0 = none
+  std::vector<std::mutex> locks;
+  std::atomic<uint64_t> count{0};
+  uint64_t mask = 0;
+
+  explicit FpSet(uint64_t capacity)
+      : keys(capacity), parents(capacity), locks(256), mask(capacity - 1) {
+    for (auto& k : keys) k.store(0, std::memory_order_relaxed);
+    for (auto& p : parents) p.store(0, std::memory_order_relaxed);
+  }
+};
+
+void* sr_fpset_new(uint64_t capacity_pow2) {
+  if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1))) {
+    return nullptr;
+  }
+  return new FpSet(capacity_pow2);
+}
+
+void sr_fpset_free(void* set) { delete static_cast<FpSet*>(set); }
+
+uint64_t sr_fpset_len(void* set) {
+  return static_cast<FpSet*>(set)->count.load(std::memory_order_relaxed);
+}
+
+static inline uint64_t home_of(uint64_t fp, uint64_t mask) {
+  // Independent second mix so slot position is uncorrelated with the key.
+  uint32_t h = fmix32(static_cast<uint32_t>(fp) ^
+                      rotl32(static_cast<uint32_t>(fp >> 32), 16) ^
+                      0x7FEB352Du);
+  return (static_cast<uint64_t>(h) ^ (fp >> 17)) & mask;
+}
+
+// Insert fp with parent; returns 1 if newly inserted, 0 if already present,
+// -1 if the table is full.
+int32_t sr_fpset_insert(void* set_ptr, uint64_t fp, uint64_t parent) {
+  FpSet* s = static_cast<FpSet*>(set_ptr);
+  uint64_t idx = home_of(fp, s->mask);
+  for (uint64_t probes = 0; probes <= s->mask; ++probes) {
+    std::mutex& m = s->locks[idx & 255];
+    {
+      std::lock_guard<std::mutex> g(m);
+      uint64_t cur = s->keys[idx].load(std::memory_order_acquire);
+      if (cur == 0) {
+        s->parents[idx].store(parent, std::memory_order_relaxed);
+        // Release: the parent store is visible before the key appears.
+        s->keys[idx].store(fp, std::memory_order_release);
+        s->count.fetch_add(1, std::memory_order_relaxed);
+        return 1;
+      }
+      if (cur == fp) {
+        return 0;
+      }
+    }
+    idx = (idx + 1) & s->mask;
+  }
+  return -1;
+}
+
+// Returns 1 and writes *parent_out if present; 0 otherwise.
+int32_t sr_fpset_get_parent(void* set_ptr, uint64_t fp, uint64_t* parent_out) {
+  FpSet* s = static_cast<FpSet*>(set_ptr);
+  uint64_t idx = home_of(fp, s->mask);
+  for (uint64_t probes = 0; probes <= s->mask; ++probes) {
+    uint64_t cur = s->keys[idx].load(std::memory_order_acquire);
+    if (cur == 0) {
+      return 0;
+    }
+    if (cur == fp) {
+      *parent_out = s->parents[idx].load(std::memory_order_relaxed);
+      return 1;
+    }
+    idx = (idx + 1) & s->mask;
+  }
+  return 0;
+}
+
+int32_t sr_fpset_contains(void* set_ptr, uint64_t fp) {
+  uint64_t unused;
+  return sr_fpset_get_parent(set_ptr, fp, &unused);
+}
+
+}  // extern "C"

@@ -55,7 +55,9 @@ class PingPongCompiled(CompiledModel):
         self.lossy = model.lossy_network
         self.e = 2 * (cfg.max_nat + 2)  # possible envelopes
         self.last_shift = _ENV_SHIFT + self.e
-        self.max_actions = 2 * self.e
+        # Drop lanes exist only on lossy networks; a lossless model's step
+        # emits just the Deliver family.
+        self.max_actions = 2 * self.e if self.lossy else self.e
 
     def cache_key(self):
         return (
@@ -102,13 +104,12 @@ class PingPongCompiled(CompiledModel):
             if (bits >> (_ENV_SHIFT + e)) & 1
         )
         last_code = (bits >> self.last_shift) & 0x1F
-        network = Network.new_unordered_duplicating(envs)
         if last_code:
-            network = Network(
-                kind=network.kind,
-                envelopes=network.envelopes,
-                last_msg=self._env_of(last_code - 1),
+            network = Network.new_unordered_duplicating_with_last_msg(
+                envs, self._env_of(last_code - 1)
             )
+        else:
+            network = Network.new_unordered_duplicating(envs)
         return ActorModelState(
             actor_states=(c0, c1),
             network=network,
@@ -181,14 +182,12 @@ class PingPongCompiled(CompiledModel):
             nhi = (nhi & last_clear_hi) | lhi
             emit(present & guard, nlo, nhi)
 
-        for e in range(self.e):
-            plo, phi = self._bit(_ENV_SHIFT + e)
-            present = ((lo & plo) | (hi & phi)) != 0
-            # Drop(e): remove the envelope; marker unchanged.
-            if self.lossy:
+        if self.lossy:
+            for e in range(self.e):
+                plo, phi = self._bit(_ENV_SHIFT + e)
+                present = ((lo & plo) | (hi & phi)) != 0
+                # Drop(e): remove the envelope; marker unchanged.
                 emit(present, lo & ~plo, hi & ~phi)
-            else:
-                emit(jnp.zeros((), jnp.bool_), lo, hi)
 
         nexts = jnp.stack(
             [jnp.stack(nexts_lo), jnp.stack(nexts_hi)], axis=-1

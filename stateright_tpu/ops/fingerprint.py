@@ -80,6 +80,26 @@ def fp64_words(words: Iterable[int]) -> int:
     return fp if fp != 0 else 1
 
 
+_py_fp64_words = fp64_words
+_native_fp64 = None
+_NATIVE_MIN_WORDS = 16  # ctypes call overhead beats Python mixing above this
+
+
+def _fp64_words_dispatch(words) -> int:
+    """Route long word lists through the C++ mixer (bit-identical; see
+    native/stateright_core.cpp) and short ones through Python."""
+    if isinstance(words, list) and len(words) >= _NATIVE_MIN_WORDS:
+        global _native_fp64
+        if _native_fp64 is None:
+            from .native import fp64_words_native, available
+
+            _native_fp64 = fp64_words_native if available() else _py_fp64_words
+        if _native_fp64 is not _py_fp64_words:
+            # canon_words masks to 32 bits already; the array copy is C-speed.
+            return _native_fp64(words)
+    return _py_fp64_words(words)
+
+
 # --- Canonical encoding of host Python values to uint32 words ---------------
 
 TAG_NONE = 0x4E4F4E45  # 'NONE'
@@ -221,7 +241,7 @@ def fingerprint(obj: Any) -> int:
             return cached
         words: List[int] = []
         canon_words(obj, words)
-        fp = fp64_words(words)
+        fp = _fp64_words_dispatch(words)
         try:
             object.__setattr__(obj, "_cached_fp", fp)
         except AttributeError:
@@ -229,4 +249,4 @@ def fingerprint(obj: Any) -> int:
         return fp
     words = []
     canon_words(obj, words)
-    return fp64_words(words)
+    return _fp64_words_dispatch(words)
