@@ -1,0 +1,461 @@
+"""Bit-packed codec for the paxos workload (docs/TPU_PAXOS_DESIGN.md).
+
+This module implements the host-side half of compiling `paxos check C`
+for the TPU wavefront: an injective packed encoding of the full
+``ActorModelState`` — three PaxosState server records, C scripted register
+clients, the nonduplicating network as sorted envelope-code slots, and the
+LinearizabilityTester history (phases + real-time snapshots + read
+values).  The differential tests enumerate the host model's entire
+reachable set and pin ``decode(encode(s)) == s``, which simultaneously
+validates every boundedness assumption (rounds, in-flight envelopes,
+multiset counts ≤ 1, proposal space) against reality.
+
+The device step kernel builds on this codec (next round; the design note
+has the plan).  Word layout (C clients, S=3 servers):
+
+- words 0..5: three 47-bit server records, 2 words each;
+- word 6: client records, 4 bits each (awaiting kind 2b + op_count 2b);
+- words 7..7+M: network slots — sorted nonzero envelope codes (M=16);
+- last C words: per-client tester record (phase 3b, write/read-invocation
+  snapshots 2b per other client each, read value 2b).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..actor.register import ClientState, Get, GetOk, Internal, Put, PutOk
+from ..parallel.compiled import CompiledModel
+from ..semantics import LinearizabilityTester, Register
+from ..semantics.register import READ, ReadOk, WriteOp, WRITE_OK
+from .paxos import (
+    Accept,
+    Accepted,
+    Decided,
+    NULL_VALUE,
+    PaxosState,
+    Prepare,
+    Prepared,
+)
+
+S = 3  # servers (the golden configurations fix three)
+MAX_ROUND = 15  # 4 bits; validated by the differential reachability test
+NET_SLOTS = 16
+
+# Message tags for envelope codes.
+_T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
+_T_PREPARE, _T_PREPARED, _T_ACCEPT, _T_ACCEPTED, _T_DECIDED = 4, 5, 6, 7, 8
+
+
+class PaxosCompiled(CompiledModel):
+    """Codec (encode/decode/init) for ``PaxosModelCfg.into_model()``."""
+
+    def __init__(self, model):
+        self.model = model
+        cfg = model.cfg
+        if cfg.server_count != S:
+            raise ValueError("packed paxos fixes server_count=3")
+        if cfg.client_count > 3:
+            raise ValueError("packed paxos supports at most 3 clients")
+        self.c = cfg.client_count
+        self.values = tuple(
+            chr(ord("A") + i) for i in range(self.c)
+        )  # client i's put value (actor/register.py:126)
+        # Proposal space: client i's put is (req_id=S+i, requester=S+i, v_i).
+        self.proposals = tuple(
+            (S + i, Id(S + i), self.values[i]) for i in range(self.c)
+        )
+        self.state_width = 2 * S + 1 + NET_SLOTS + self.c
+        self.max_actions = NET_SLOTS  # Deliver per slot (lossless, no timers)
+
+    def cache_key(self):
+        return (type(self).__qualname__, self.c)
+
+    # --- small-code helpers --------------------------------------------------
+
+    def _value_code(self, v) -> int:
+        """0 = NULL, 1+i = client i's value."""
+        if v == NULL_VALUE:
+            return 0
+        return 1 + self.values.index(v)
+
+    def _value_of(self, code: int):
+        return NULL_VALUE if code == 0 else self.values[code - 1]
+
+    def _proposal_code(self, p) -> int:
+        """0 = None, else 1+index."""
+        return 0 if p is None else 1 + self.proposals.index(tuple(p))
+
+    def _proposal_of(self, code: int):
+        return None if code == 0 else self.proposals[code - 1]
+
+    def _ballot_code(self, b) -> int:
+        r, leader = b
+        if r > MAX_ROUND:
+            raise ValueError(f"ballot round {r} exceeds MAX_ROUND")
+        return r * S + int(leader)
+
+    def _ballot_of(self, code: int) -> Tuple[int, Id]:
+        return (code // S, Id(code % S))
+
+    def _accepted_code(self, acc) -> int:
+        """Option<(ballot, proposal)> -> 0 or 1 + ballot*C + proposal_idx."""
+        if acc is None:
+            return 0
+        ballot, proposal = acc
+        return 1 + self._ballot_code(ballot) * self.c + self.proposals.index(
+            tuple(proposal)
+        )
+
+    def _accepted_of(self, code: int):
+        if code == 0:
+            return None
+        code -= 1
+        return (
+            self._ballot_of(code // self.c),
+            self.proposals[code % self.c],
+        )
+
+    # --- server record (47 bits in a u64 chunk) ------------------------------
+
+    _ACC_BITS = 9  # 1 + 15*3*3 = 136 accepted codes fit
+
+    def _encode_server(self, s: PaxosState) -> int:
+        bits = self._ballot_code(s.ballot)  # 6 bits (rounds 0..15 * 3)
+        assert bits < 64
+        off = 6
+        bits |= self._proposal_code(s.proposal) << off
+        off += 2
+        prepares = dict(s.prepares)
+        for sid in range(S):
+            if Id(sid) in prepares:
+                bits |= 1 << off
+                bits |= self._accepted_code(prepares[Id(sid)]) << (off + 1)
+            off += 1 + self._ACC_BITS
+        for sid in range(S):
+            if Id(sid) in s.accepts:
+                bits |= 1 << off
+            off += 1
+        bits |= self._accepted_code(s.accepted) << off
+        off += self._ACC_BITS
+        bits |= int(s.is_decided) << off
+        off += 1
+        assert off <= 64, off
+        return bits
+
+    def _decode_server(self, bits: int) -> PaxosState:
+        ballot = self._ballot_of(bits & 0x3F)
+        off = 6
+        proposal = self._proposal_of((bits >> off) & 0x3)
+        off += 2
+        prepares = []
+        for sid in range(S):
+            if (bits >> off) & 1:
+                acc = self._accepted_of(
+                    (bits >> (off + 1)) & ((1 << self._ACC_BITS) - 1)
+                )
+                prepares.append((Id(sid), acc))
+            off += 1 + self._ACC_BITS
+        accepts = frozenset(
+            Id(sid) for sid in range(S) if (bits >> (off + sid)) & 1
+        )
+        off += S
+        accepted = self._accepted_of((bits >> off) & ((1 << self._ACC_BITS) - 1))
+        off += self._ACC_BITS
+        is_decided = bool((bits >> off) & 1)
+        return PaxosState(
+            ballot=ballot,
+            proposal=proposal,
+            prepares=tuple(prepares),
+            accepts=accepts,
+            accepted=accepted,
+            is_decided=is_decided,
+        )
+
+    # --- envelope codes ------------------------------------------------------
+
+    def _env_code(self, env: Envelope) -> int:
+        """tag(4) | src(2) upper or client idx | fields; nonzero overall
+        (slot value 0 means empty, so add 1 at the end)."""
+        msg = env.msg
+        src, dst = int(env.src), int(env.dst)
+        if isinstance(msg, Put):
+            ci = src - S
+            assert msg == Put(S + ci, self.values[ci]) and dst == ci % S
+            code = (_T_PUT, ci, 0)
+        elif isinstance(msg, Get):
+            ci = src - S
+            assert msg.request_id == 2 * (S + ci) and dst == (S + ci + 1) % S
+            code = (_T_GET, ci, 0)
+        elif isinstance(msg, PutOk):
+            ci = dst - S
+            assert msg.request_id == S + ci
+            code = (_T_PUTOK, src * 4 + ci, 0)
+        elif isinstance(msg, GetOk):
+            ci = dst - S
+            assert msg.request_id == 2 * (S + ci)
+            code = (_T_GETOK, src * 4 + ci, self._value_code(msg.value))
+        elif isinstance(msg, Internal):
+            inner = msg.msg
+            if isinstance(inner, Prepare):
+                assert int(inner.ballot[1]) == src
+                code = (_T_PREPARE, src * 4 + dst, inner.ballot[0])
+            elif isinstance(inner, Prepared):
+                assert int(inner.ballot[1]) == dst
+                code = (
+                    _T_PREPARED,
+                    src * 4 + dst,
+                    inner.ballot[0] * 256 + self._accepted_code(inner.last_accepted),
+                )
+            elif isinstance(inner, Accept):
+                assert int(inner.ballot[1]) == src
+                code = (
+                    _T_ACCEPT,
+                    src * 4 + dst,
+                    inner.ballot[0] * 4
+                    + (self._proposal_code(inner.proposal) - 1),
+                )
+            elif isinstance(inner, Accepted):
+                assert int(inner.ballot[1]) == dst
+                code = (_T_ACCEPTED, src * 4 + dst, inner.ballot[0])
+            elif isinstance(inner, Decided):
+                code = (
+                    _T_DECIDED,
+                    src * 4 + dst,
+                    (self._ballot_code(inner.ballot) * 4)
+                    + (self._proposal_code(inner.proposal) - 1),
+                )
+            else:
+                raise ValueError(f"unknown internal message {inner!r}")
+        else:
+            raise ValueError(f"unknown message {msg!r}")
+        tag, addr, payload = code
+        assert addr < 16 and payload < (1 << 14), (addr, payload)
+        return 1 + ((tag << 18) | (addr << 14) | payload)
+
+    def _env_of(self, code: int) -> Envelope:
+        code -= 1
+        tag = code >> 18
+        addr = (code >> 14) & 0xF
+        payload = code & 0x3FFF
+        if tag == _T_PUT:
+            ci = addr
+            return Envelope(
+                Id(S + ci), Id(ci % S), Put(S + ci, self.values[ci])
+            )
+        if tag == _T_GET:
+            ci = addr
+            return Envelope(Id(S + ci), Id((S + ci + 1) % S), Get(2 * (S + ci)))
+        if tag == _T_PUTOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(Id(src), Id(S + ci), PutOk(S + ci))
+        if tag == _T_GETOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(
+                Id(src), Id(S + ci), GetOk(2 * (S + ci), self._value_of(payload))
+            )
+        src, dst = addr // 4, addr % 4
+        if tag == _T_PREPARE:
+            return Envelope(
+                Id(src), Id(dst), Internal(Prepare((payload, Id(src))))
+            )
+        if tag == _T_PREPARED:
+            return Envelope(
+                Id(src),
+                Id(dst),
+                Internal(
+                    Prepared((payload // 256, Id(dst)), self._accepted_of(payload % 256))
+                ),
+            )
+        if tag == _T_ACCEPT:
+            return Envelope(
+                Id(src),
+                Id(dst),
+                Internal(
+                    Accept(
+                        (payload // 4, Id(src)),
+                        self.proposals[payload % 4],
+                    )
+                ),
+            )
+        if tag == _T_ACCEPTED:
+            return Envelope(
+                Id(src), Id(dst), Internal(Accepted((payload, Id(dst))))
+            )
+        if tag == _T_DECIDED:
+            return Envelope(
+                Id(src),
+                Id(dst),
+                Internal(
+                    Decided(
+                        self._ballot_of(payload // 4),
+                        self.proposals[payload % 4],
+                    )
+                ),
+            )
+        raise ValueError(f"bad envelope code {code}")
+
+    # --- tester record -------------------------------------------------------
+
+    def _lc_code(self, last_completed, me: int) -> int:
+        """Snapshot tuple -> 2 bits per other client (0 absent, else idx+1)."""
+        lc = dict(last_completed)
+        bits = 0
+        slot = 0
+        for j in range(self.c):
+            if j == me:
+                continue
+            v = lc.get(Id(S + j))
+            bits |= (0 if v is None else v + 1) << (2 * slot)
+            slot += 1
+        return bits
+
+    def _lc_of(self, bits: int, me: int):
+        out = []
+        slot = 0
+        for j in range(self.c):
+            if j == me:
+                continue
+            v = (bits >> (2 * slot)) & 0x3
+            if v:
+                out.append((Id(S + j), v - 1))
+            slot += 1
+        return tuple(sorted(out))
+
+    def _encode_tester(self, h: LinearizabilityTester, me: int) -> int:
+        tid = Id(S + me)
+        hist = h.history_by_thread.get(tid)
+        inflight = h.in_flight_by_thread.get(tid)
+        lc_bits = 2 * (self.c - 1)
+        if hist is None and inflight is None:
+            return 0  # phase 0
+        if inflight is not None and not hist:
+            lc, op = inflight
+            assert op == WriteOp(self.values[me])
+            return 1 | (self._lc_code(lc, me) << 3)
+        assert hist[0][1] == WriteOp(self.values[me]) and hist[0][2] == WRITE_OK
+        lc_w = self._lc_code(hist[0][0], me)
+        if len(hist) == 1 and inflight is None:
+            return 2 | (lc_w << 3)
+        if len(hist) == 1:
+            lc, op = inflight
+            assert op == READ
+            return 3 | (lc_w << 3) | (self._lc_code(lc, me) << (3 + lc_bits))
+        assert len(hist) == 2 and inflight is None and hist[1][1] == READ
+        lc_r = self._lc_code(hist[1][0], me)
+        vcode = self._value_code(hist[1][2].value)
+        return (
+            4
+            | (lc_w << 3)
+            | (lc_r << (3 + lc_bits))
+            | (vcode << (3 + 2 * lc_bits))
+        )
+
+    def _decode_tester_into(self, h: LinearizabilityTester, bits: int, me: int):
+        tid = Id(S + me)
+        phase = bits & 0x7
+        if phase == 0:
+            return
+        lc_bits = 2 * (self.c - 1)
+        lc_w = self._lc_of((bits >> 3) & ((1 << lc_bits) - 1), me)
+        if phase == 1:
+            h.in_flight_by_thread[tid] = (lc_w, WriteOp(self.values[me]))
+            h.history_by_thread[tid] = ()
+            return
+        entry_w = (lc_w, WriteOp(self.values[me]), WRITE_OK)
+        if phase == 2:
+            h.history_by_thread[tid] = (entry_w,)
+            return
+        lc_r = self._lc_of((bits >> (3 + lc_bits)) & ((1 << lc_bits) - 1), me)
+        if phase == 3:
+            h.history_by_thread[tid] = (entry_w,)
+            h.in_flight_by_thread[tid] = (lc_r, READ)
+            return
+        vcode = (bits >> (3 + 2 * lc_bits)) & 0x3
+        h.history_by_thread[tid] = (
+            entry_w,
+            (lc_r, READ, ReadOk(self._value_of(vcode))),
+        )
+
+    # --- full state ----------------------------------------------------------
+
+    def encode(self, st: ActorModelState) -> np.ndarray:
+        words = np.zeros(self.state_width, dtype=np.uint32)
+        for i in range(S):
+            bits = self._encode_server(st.actor_states[i])
+            words[2 * i] = bits & 0xFFFFFFFF
+            words[2 * i + 1] = bits >> 32
+        cbits = 0
+        for i in range(self.c):
+            cs: ClientState = st.actor_states[S + i]
+            if cs.awaiting is None:
+                kind = 0
+            elif cs.awaiting == S + i:
+                kind = 1  # awaiting the put
+            else:
+                assert cs.awaiting == 2 * (S + i)
+                kind = 2  # awaiting the get
+            assert cs.op_count <= 3
+            cbits |= (kind | (cs.op_count << 2)) << (4 * i)
+        words[2 * S] = cbits
+        env_codes = []
+        for env, count in sorted(
+            st.network.counts, key=lambda ec: self._env_code(ec[0])
+        ):
+            assert count == 1, f"multiset count {count} for {env!r}"
+            env_codes.append(self._env_code(env))
+        if len(env_codes) > NET_SLOTS:
+            raise ValueError(
+                f"{len(env_codes)} in-flight envelopes exceed {NET_SLOTS} slots"
+            )
+        for k, code in enumerate(env_codes):
+            words[2 * S + 1 + k] = code
+        for i in range(self.c):
+            words[2 * S + 1 + NET_SLOTS + i] = self._encode_tester(
+                st.history, i
+            )
+        return words
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        servers = tuple(
+            self._decode_server(int(words[2 * i]) | (int(words[2 * i + 1]) << 32))
+            for i in range(S)
+        )
+        cbits = int(words[2 * S])
+        clients = []
+        for i in range(self.c):
+            nib = (cbits >> (4 * i)) & 0xF
+            kind, op_count = nib & 0x3, nib >> 2
+            awaiting = {0: None, 1: S + i, 2: 2 * (S + i)}[kind]
+            clients.append(ClientState(awaiting=awaiting, op_count=op_count))
+        envs = []
+        for k in range(NET_SLOTS):
+            code = int(words[2 * S + 1 + k])
+            if code:
+                envs.append((self._env_of(code), 1))
+        network = Network(
+            kind="unordered_nonduplicating", counts=frozenset(envs)
+        )
+        tester = LinearizabilityTester(Register(NULL_VALUE))
+        for i in range(self.c):
+            self._decode_tester_into(
+                tester, int(words[2 * S + 1 + NET_SLOTS + i]), i
+            )
+        n = S + self.c
+        return ActorModelState(
+            actor_states=tuple(servers) + tuple(clients),
+            network=network,
+            timers_set=(frozenset(),) * n,
+            random_choices=((),) * n,
+            crashed=(False,) * n,
+            history=tester,
+            actor_storages=(None,) * n,
+        )
+
+
+def compiled_paxos(model) -> PaxosCompiled:
+    return PaxosCompiled(model)
