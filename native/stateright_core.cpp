@@ -50,7 +50,8 @@ inline uint32_t fmix32(uint32_t h) {
 
 extern "C" {
 
-// Bit-identical to ops/fingerprint.fp64_words (nonzero result guaranteed).
+// Bit-identical to ops/fingerprint.fp64_words: nonzero (0 = empty slot)
+// and never all-ones (device inactive-lane sentinel).
 uint64_t sr_fp64_words(const uint32_t* words, uint64_t n) {
   uint32_t h1 = SEED_HI;
   uint32_t h2 = SEED_LO;
@@ -61,7 +62,9 @@ uint64_t sr_fp64_words(const uint32_t* words, uint64_t n) {
   h1 = fmix32(h1 ^ static_cast<uint32_t>(n));
   h2 = fmix32(h2 ^ static_cast<uint32_t>(n * 0x9E3779B1u));
   uint64_t fp = (static_cast<uint64_t>(h1) << 32) | h2;
-  return fp ? fp : 1;
+  if (fp == 0) return 1;
+  if (fp == ~0ull) return ~0ull - 1;
+  return fp;
 }
 
 // Batched form: rows of a [count, width] uint32 matrix.

@@ -66,7 +66,19 @@ def device_fp64(words):
         h2 = _mix32(h2, words[..., i])
     h1 = _fmix32(h1 ^ _U32(w))
     h2 = _fmix32(h2 ^ _U32((w * 0x9E3779B1) & 0xFFFFFFFF))
-    # Avoid the (0, 0) empty-slot marker, mirroring the host's nonzero rule.
+    return _remap_pair(h1, h2)
+
+
+def _remap_pair(h1, h2):
+    """Avoid the (0, 0) empty-slot marker and the all-ones inactive-lane
+    sentinel, bit-identically to the host's ``fingerprint._remap_fp``:
+    without the latter remap, a state hashing to 0xFFFF… would be
+    *deterministically* dropped on device while the host oracle kept it — a
+    permanent cross-backend discovery-set divergence, unlike an ordinary
+    collision."""
+    ones = _U32(0xFFFFFFFF)
     both_zero = (h1 == 0) & (h2 == 0)
     h2 = jnp.where(both_zero, _U32(1), h2)
+    both_ones = (h1 == ones) & (h2 == ones)
+    h2 = jnp.where(both_ones, _U32(0xFFFFFFFE), h2)
     return h1, h2

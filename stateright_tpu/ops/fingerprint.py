@@ -76,8 +76,19 @@ def fp64_words(words: Iterable[int]) -> int:
         n += 1
     h1 = _fmix32(h1 ^ n)
     h2 = _fmix32(h2 ^ (n * 0x9E3779B1 & M32))
-    fp = (h1 << 32) | h2
-    return fp if fp != 0 else 1
+    return _remap_fp((h1 << 32) | h2)
+
+
+def _remap_fp(fp: int) -> int:
+    """Steer the two reserved 64-bit values away from real fingerprints:
+    zero marks empty hash-table slots, all-ones marks inactive device lanes
+    (parallel/hashset.py).  Must stay bit-identical across the Python, C++
+    (sr_fp64_words) and device (device_fp._remap_pair) implementations."""
+    if fp == 0:
+        return 1
+    if fp == M64:
+        return M64 - 1
+    return fp
 
 
 _py_fp64_words = fp64_words

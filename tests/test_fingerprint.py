@@ -63,6 +63,32 @@ def test_stable_across_processes():
     assert out1 == str(fingerprint((1, "abc", frozenset([4, 5]))))
 
 
+def test_reserved_fingerprints_remapped():
+    """Zero (empty hash-table slot) and all-ones (inactive device lane) are
+    unreachable fingerprint values, remapped identically on host, native,
+    and device; a state hashing to the sentinel would otherwise be
+    deterministically dropped by the device dedup while the host kept it."""
+    import numpy as np
+
+    from stateright_tpu.ops.device_fp import _remap_pair
+    from stateright_tpu.ops.fingerprint import M64, _remap_fp
+
+    assert _remap_fp(0) == 1
+    assert _remap_fp(M64) == M64 - 1
+    assert _remap_fp(12345) == 12345
+
+    ones = np.uint32(0xFFFFFFFF)
+    cases = [(0, 0), (ones, ones), (ones, 0), (0, ones), (7, 9)]
+    import jax.numpy as jnp
+
+    h1 = jnp.asarray(np.array([c[0] for c in cases], np.uint32))
+    h2 = jnp.asarray(np.array([c[1] for c in cases], np.uint32))
+    r1, r2 = _remap_pair(h1, h2)
+    got = [(int(a) << 32) | int(b) for a, b in zip(r1, r2)]
+    want = [_remap_fp((int(c[0]) << 32) | int(c[1])) for c in cases]
+    assert got == want
+
+
 def test_fp64_words_golden():
     # Pin concrete values so any accidental change to the mixer (which must
     # stay in lockstep with the device implementation) is caught.
