@@ -203,9 +203,11 @@ class PaxosCompiled(CompiledModel):
             inner = msg.msg
             if isinstance(inner, Prepare):
                 assert int(inner.ballot[1]) == src
+                self._ballot_code(inner.ballot)  # round bounds check
                 code = (_T_PREPARE, src * 4 + dst, inner.ballot[0])
             elif isinstance(inner, Prepared):
                 assert int(inner.ballot[1]) == dst
+                self._ballot_code(inner.ballot)
                 code = (
                     _T_PREPARED,
                     src * 4 + dst,
@@ -213,6 +215,7 @@ class PaxosCompiled(CompiledModel):
                 )
             elif isinstance(inner, Accept):
                 assert int(inner.ballot[1]) == src
+                self._ballot_code(inner.ballot)
                 code = (
                     _T_ACCEPT,
                     src * 4 + dst,
@@ -221,6 +224,7 @@ class PaxosCompiled(CompiledModel):
                 )
             elif isinstance(inner, Accepted):
                 assert int(inner.ballot[1]) == dst
+                self._ballot_code(inner.ballot)
                 code = (_T_ACCEPTED, src * 4 + dst, inner.ballot[0])
             elif isinstance(inner, Decided):
                 code = (
