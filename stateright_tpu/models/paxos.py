@@ -207,7 +207,7 @@ class PaxosModelCfg:
             RegisterClient(put_count=1, server_count=self.server_count)
             for _ in range(self.client_count)
         )
-        return (
+        model = (
             model.init_network_(self.network)
             .property(
                 Expectation.ALWAYS,
@@ -218,3 +218,11 @@ class PaxosModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+
+        def _compiled():
+            from .paxos_compiled import PaxosCompiled
+
+            return PaxosCompiled(model)
+
+        model.compiled = _compiled
+        return model

@@ -10,12 +10,16 @@ reachable set and pin ``decode(encode(s)) == s``, which simultaneously
 validates every boundedness assumption (rounds, in-flight envelopes,
 multiset counts ≤ 1, proposal space) against reality.
 
-The device step kernel builds on this codec (next round; the design note
-has the plan).  Word layout (C clients, S=3 servers):
+The device half lives in the same class: a step kernel expanding one
+Deliver lane per network slot (fused 9-way message dispatch over the packed
+records, canonical slot re-sort with overflow/duplicate flagging) and an
+exact on-device linearizability decision (``_device_linearizable``, a
+Wing&Gong-style subset-reachability DP).  Word layout (C clients, S=3
+servers, M = 16 slots for C<=2 / 32 for C=3):
 
-- words 0..5: three 47-bit server records, 2 words each;
+- words 0..5: three 51-bit server records, 2 words each;
 - word 6: client records, 4 bits each (awaiting kind 2b + op_count 2b);
-- words 7..7+M: network slots — sorted nonzero envelope codes (M=16);
+- words 7..7+M: network slots — sorted nonzero envelope codes;
 - last C words: per-client tester record (phase 3b, write/read-invocation
   snapshots 2b per other client each, read value 2b).
 """
@@ -44,7 +48,7 @@ from .paxos import (
 
 S = 3  # servers (the golden configurations fix three)
 MAX_ROUND = 15  # 4 bits; validated by the differential reachability test
-NET_SLOTS = 16
+NET_SLOTS = 16  # c <= 2 (observed in-flight peak 10); widened for c == 3
 
 # Message tags for envelope codes.
 _T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
@@ -52,7 +56,9 @@ _T_PREPARE, _T_PREPARED, _T_ACCEPT, _T_ACCEPTED, _T_DECIDED = 4, 5, 6, 7, 8
 
 
 class PaxosCompiled(CompiledModel):
-    """Codec (encode/decode/init) for ``PaxosModelCfg.into_model()``."""
+    """Codec + device step kernel for ``PaxosModelCfg.into_model()``."""
+
+    step_flags = True  # the step kernel reports encoding-capacity overflow
 
     def __init__(self, model):
         self.model = model
@@ -69,8 +75,9 @@ class PaxosCompiled(CompiledModel):
         self.proposals = tuple(
             (S + i, Id(S + i), self.values[i]) for i in range(self.c)
         )
-        self.state_width = 2 * S + 1 + NET_SLOTS + self.c
-        self.max_actions = NET_SLOTS  # Deliver per slot (lossless, no timers)
+        self.m = NET_SLOTS if self.c <= 2 else 32
+        self.state_width = 2 * S + 1 + self.m + self.c
+        self.max_actions = self.m  # Deliver per slot (lossless, no timers)
 
     def cache_key(self):
         return (type(self).__qualname__, self.c)
@@ -412,14 +419,14 @@ class PaxosCompiled(CompiledModel):
         ):
             assert count == 1, f"multiset count {count} for {env!r}"
             env_codes.append(self._env_code(env))
-        if len(env_codes) > NET_SLOTS:
+        if len(env_codes) > self.m:
             raise ValueError(
-                f"{len(env_codes)} in-flight envelopes exceed {NET_SLOTS} slots"
+                f"{len(env_codes)} in-flight envelopes exceed {self.m} slots"
             )
         for k, code in enumerate(env_codes):
             words[2 * S + 1 + k] = code
         for i in range(self.c):
-            words[2 * S + 1 + NET_SLOTS + i] = self._encode_tester(
+            words[2 * S + 1 + self.m + i] = self._encode_tester(
                 st.history, i
             )
         return words
@@ -437,7 +444,7 @@ class PaxosCompiled(CompiledModel):
             awaiting = {0: None, 1: S + i, 2: 2 * (S + i)}[kind]
             clients.append(ClientState(awaiting=awaiting, op_count=op_count))
         envs = []
-        for k in range(NET_SLOTS):
+        for k in range(self.m):
             code = int(words[2 * S + 1 + k])
             if code:
                 envs.append((self._env_of(code), 1))
@@ -447,7 +454,7 @@ class PaxosCompiled(CompiledModel):
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
             self._decode_tester_into(
-                tester, int(words[2 * S + 1 + NET_SLOTS + i]), i
+                tester, int(words[2 * S + 1 + self.m + i]), i
             )
         n = S + self.c
         return ActorModelState(
@@ -459,6 +466,480 @@ class PaxosCompiled(CompiledModel):
             history=tester,
             actor_storages=(None,) * n,
         )
+
+
+    # --- device side (jnp, traced) ------------------------------------------
+    #
+    # The step kernel mirrors ActorModel.next_state for the one action family
+    # paxos has (Deliver per in-flight envelope; lossless, crash-free, no
+    # timers — actor/model.py:288-310): one lane per network slot, each lane
+    # decoding its envelope code, running the dst actor's handler as fused
+    # u32 arithmetic over the packed records, and re-canonicalizing the
+    # network slots (delivered envelope removed, sends inserted, sorted).
+    # A lane is valid iff the host handler would not be a no-op (returns a
+    # state or emits sends — actor/base.py is_no_op).
+
+    _NET0 = 2 * S + 1
+    _CLI = 2 * S
+
+    # server-record field offsets (51 bits over a lo/hi u32 pair)
+    _F_BALLOT = (0, 6)
+    _F_PROP = (6, 2)
+    _F_ACCEPTS = 38  # +sid, 1 bit each
+    _F_ACCEPTED = (41, 9)
+    _F_DECIDED = (50, 1)
+
+    @staticmethod
+    def _ext(lo, hi, off: int, width: int):
+        """Extract a static-width bit field from a (lo, hi) u32 pair."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        mask = u((1 << width) - 1)
+        if off + width <= 32:
+            return (lo >> u(off)) & mask
+        if off >= 32:
+            return (hi >> u(off - 32)) & mask
+        return ((lo >> u(off)) | (hi << u(32 - off))) & mask
+
+    @staticmethod
+    def _ins(lo, hi, off: int, width: int, val):
+        """Insert ``val`` (< 2**width) into a (lo, hi) u32 pair."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        m = (1 << width) - 1
+        val = val.astype(jnp.uint32) if hasattr(val, "astype") else u(val)
+        if off + width <= 32:
+            lo = (lo & u(~(m << off) & 0xFFFFFFFF)) | (val << u(off))
+        elif off >= 32:
+            o = off - 32
+            hi = (hi & u(~(m << o) & 0xFFFFFFFF)) | (val << u(o))
+        else:
+            nlo = 32 - off  # bits landing in lo
+            lo = (lo & u(~((m & ((1 << nlo) - 1)) << off) & 0xFFFFFFFF)) | (
+                (val & u((1 << nlo) - 1)) << u(off)
+            )
+            hi = (hi & u(~(m >> nlo) & 0xFFFFFFFF)) | (val >> u(nlo))
+        return lo, hi
+
+    def step(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jnp.arange(self.m, dtype=jnp.uint32)
+        nexts, valid, flags = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        return nexts, valid, jnp.any(flags)
+
+    def _deliver_lane(self, state, k):
+        """One Deliver lane: expand slot ``k``'s envelope (if occupied)."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        c = self.c
+        m = self.m
+        net0 = self._NET0
+        tst0 = net0 + m
+
+        # No dynamic gathers/scatters anywhere in this lane: with 3 servers
+        # and <= 3 clients every data-dependent index is a short where-select
+        # chain, which XLA vectorizes cleanly on TPU (and avoids a observed
+        # XLA:CPU batched-scatter miscompilation at large batch shapes).
+        lane_sel = jnp.arange(self.m, dtype=u) == k
+        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
+        occupied = code != u(0)
+        e = code - u(1)
+        tag = e >> u(18)
+        addr = (e >> u(14)) & u(0xF)
+        payload = e & u(0x3FFF)
+        i_src = addr >> u(2)
+        i_dst = addr & u(3)
+
+        # dst server index per tag (clients' put goes to ci % 3, their get to
+        # (ci+1) % 3 — actor/register.py:127,138-146; internal msgs carry it).
+        dsrv = jnp.where(
+            tag == u(_T_PUT),
+            addr % u(3),
+            jnp.where(tag == u(_T_GET), (addr + u(1)) % u(3), i_dst),
+        )
+        lo = u(0)
+        hi = u(0)
+        for s in range(S):
+            lo = jnp.where(dsrv == u(s), state[2 * s], lo)
+            hi = jnp.where(dsrv == u(s), state[2 * s + 1], hi)
+
+        ballot = self._ext(lo, hi, *self._F_BALLOT)
+        prop = self._ext(lo, hi, *self._F_PROP)
+        prep_p = [self._ext(lo, hi, 8 + 10 * s, 1) for s in range(S)]
+        prep_a = [self._ext(lo, hi, 9 + 10 * s, 9) for s in range(S)]
+        acc_bit = [self._ext(lo, hi, self._F_ACCEPTS + s, 1) for s in range(S)]
+        accepted = self._ext(lo, hi, *self._F_ACCEPTED)
+        decided = self._ext(lo, hi, *self._F_DECIDED)
+        not_dec = decided == u(0)
+
+        p1 = (dsrv + u(1)) % u(3)
+        p2 = (dsrv + u(2)) % u(3)
+
+        def mk(t, a, p):
+            return u(1) + ((u(t) << u(18)) | (a << u(14)) | p)
+
+        # --- Put (models/paxos.py:104-114) -----------------------------------
+        put_ci = addr
+        put_guard = not_dec & (prop == u(0))
+        r_new = ballot // u(3) + u(1)
+        put_flag = put_guard & (r_new > u(MAX_ROUND))
+        plo, phi = self._ins(lo, hi, *self._F_BALLOT, r_new * u(3) + dsrv)
+        plo, phi = self._ins(plo, phi, *self._F_PROP, put_ci + u(1))
+        for s in range(S):
+            self_entry = dsrv == u(s)
+            plo, phi = self._ins(plo, phi, 8 + 10 * s, 1, self_entry)
+            plo, phi = self._ins(
+                plo, phi, 9 + 10 * s, 9, jnp.where(self_entry, accepted, u(0))
+            )
+            plo, phi = self._ins(plo, phi, self._F_ACCEPTS + s, 1, u(0))
+        put_s0 = mk(_T_PREPARE, dsrv * u(4) + p1, r_new)
+        put_s1 = mk(_T_PREPARE, dsrv * u(4) + p2, r_new)
+
+        # --- Get on a decided server (models/paxos.py:98-101) ----------------
+        get_guard = decided == u(1)
+        get_flag = get_guard & (accepted == u(0))
+        get_v = u(1) + (accepted - u(1)) % u(c)
+        get_s0 = mk(_T_GETOK, dsrv * u(4) + addr, get_v)
+
+        # --- Prepare (models/paxos.py:116-123) -------------------------------
+        prep_mb = payload * u(3) + i_src
+        prepare_guard = not_dec & (ballot < prep_mb)
+        qlo, qhi = self._ins(lo, hi, *self._F_BALLOT, prep_mb)
+        prepare_s0 = mk(_T_PREPARED, i_dst * u(4) + i_src, payload * u(256) + accepted)
+
+        # --- Prepared (models/paxos.py:125-143) ------------------------------
+        pd_mb = (payload // u(256)) * u(3) + i_dst
+        pd_acc = payload % u(256)
+        prepared_guard = not_dec & (pd_mb == ballot)
+        pd_p = [prep_p[s] | (i_src == u(s)).astype(u) for s in range(S)]
+        pd_a = [
+            jnp.where(i_src == u(s), pd_acc, prep_a[s]) for s in range(S)
+        ]
+        pd_count = sum(pd_p)
+        pd_trigger = pd_count == u(2)  # majority(3) (models/paxos.py:130)
+        pd_best = u(0)
+        for s in range(S):
+            pd_best = jnp.maximum(pd_best, jnp.where(pd_p[s] == u(1), pd_a[s], u(0)))
+        pd_prop = jnp.where(pd_best > u(0), u(1) + (pd_best - u(1)) % u(c), prop)
+        pd_flag = prepared_guard & pd_trigger & (pd_prop == u(0))
+        rlo, rhi = lo, hi
+        for s in range(S):
+            rlo, rhi = self._ins(rlo, rhi, 8 + 10 * s, 1, pd_p[s])
+            rlo, rhi = self._ins(rlo, rhi, 9 + 10 * s, 9, pd_a[s])
+        # Majority: adopt proposal, self-accept, broadcast Accept.
+        tlo, thi = self._ins(rlo, rhi, *self._F_PROP, pd_prop)
+        tlo, thi = self._ins(
+            tlo, thi, *self._F_ACCEPTED, u(1) + ballot * u(c) + (pd_prop - u(1))
+        )
+        for s in range(S):
+            tlo, thi = self._ins(
+                tlo, thi, self._F_ACCEPTS + s, 1, (i_dst == u(s)).astype(u)
+            )
+        rlo = jnp.where(pd_trigger, tlo, rlo)
+        rhi = jnp.where(pd_trigger, thi, rhi)
+        pd_payload = (ballot // u(3)) * u(4) + (pd_prop - u(1))
+        pd_s0 = jnp.where(
+            pd_trigger, mk(_T_ACCEPT, i_dst * u(4) + p1, pd_payload), u(0)
+        )
+        pd_s1 = jnp.where(
+            pd_trigger, mk(_T_ACCEPT, i_dst * u(4) + p2, pd_payload), u(0)
+        )
+
+        # --- Accept (models/paxos.py:145-153) --------------------------------
+        ac_mb = (payload // u(4)) * u(3) + i_src
+        accept_guard = not_dec & (ballot <= ac_mb)
+        alo, ahi = self._ins(lo, hi, *self._F_BALLOT, ac_mb)
+        alo, ahi = self._ins(
+            alo, ahi, *self._F_ACCEPTED, u(1) + ac_mb * u(c) + payload % u(4)
+        )
+        accept_s0 = mk(_T_ACCEPTED, i_dst * u(4) + i_src, payload // u(4))
+
+        # --- Accepted (models/paxos.py:155-167) ------------------------------
+        ad_mb = payload * u(3) + i_dst
+        accepted_guard = not_dec & (ad_mb == ballot)
+        ad_bits = [acc_bit[s] | (i_src == u(s)).astype(u) for s in range(S)]
+        ad_count = sum(ad_bits)
+        ad_trigger = ad_count == u(2)
+        ad_flag = accepted_guard & ad_trigger & (prop == u(0))
+        blo, bhi = lo, hi
+        for s in range(S):
+            blo, bhi = self._ins(blo, bhi, self._F_ACCEPTS + s, 1, ad_bits[s])
+        blo, bhi = self._ins(
+            blo, bhi, *self._F_DECIDED, jnp.where(ad_trigger, u(1), u(0))
+        )
+        ad_payload = ballot * u(4) + (prop - u(1))
+        ad_s0 = jnp.where(
+            ad_trigger, mk(_T_DECIDED, i_dst * u(4) + p1, ad_payload), u(0)
+        )
+        ad_s1 = jnp.where(
+            ad_trigger, mk(_T_DECIDED, i_dst * u(4) + p2, ad_payload), u(0)
+        )
+        ad_s2 = jnp.where(
+            ad_trigger, mk(_T_PUTOK, i_dst * u(4) + (prop - u(1)), u(0)), u(0)
+        )
+
+        # --- Decided (models/paxos.py:169-175) -------------------------------
+        decided_guard = not_dec
+        dlo, dhi = self._ins(lo, hi, *self._F_BALLOT, payload // u(4))
+        dlo, dhi = self._ins(
+            dlo, dhi, *self._F_ACCEPTED, u(1) + (payload // u(4)) * u(c) + payload % u(4)
+        )
+        dlo, dhi = self._ins(dlo, dhi, *self._F_DECIDED, u(1))
+
+        # --- PutOk / GetOk to a client (actor/register.py:130-150) -----------
+        ci = jnp.minimum(i_dst, u(c - 1))  # in-bounds clamp; guard rejects
+        cli = state[self._CLI]
+        nib = (cli >> (u(4) * ci)) & u(0xF)
+        kind = nib & u(3)
+        lcb = 2 * (c - 1)
+        tw = u(0)
+        for j in range(c):
+            tw = jnp.where(ci == u(j), state[tst0 + j], tw)
+
+        putok_guard = (kind == u(1)) & (i_dst < u(c))
+        cli_putok = (cli & ~(u(0xF) << (u(4) * ci))) | (u(10) << (u(4) * ci))
+        # phase 1 -> 3: record WRITE_OK return, then the Get invocation
+        # snapshots the other clients' completed counts (consistency.py:215).
+        phases = [
+            jnp.take(state, tst0 + j) & u(0x7) for j in range(c)
+        ]
+        counts = [
+            (phases[j] >= u(2)).astype(u) + (phases[j] == u(4)).astype(u)
+            for j in range(c)
+        ]
+        lc_opts = []
+        for me in range(c):
+            bits = u(0)
+            slot = 0
+            for j in range(c):
+                if j == me:
+                    continue
+                bits = bits | (counts[j] << u(2 * slot))
+                slot += 1
+            lc_opts.append(bits)
+        lc_r = u(0)
+        for me in range(c):
+            lc_r = jnp.where(ci == u(me), lc_opts[me], lc_r)
+        lc_w_old = (tw >> u(3)) & u((1 << lcb) - 1)
+        tw_putok = u(3) | (lc_w_old << u(3)) | (lc_r << u(3 + lcb))
+        putok_s0 = mk(_T_GET, ci, u(0))
+
+        getok_guard = (kind == u(2)) & (i_dst < u(c))
+        cli_getok = (cli & ~(u(0xF) << (u(4) * ci))) | (u(12) << (u(4) * ci))
+        tw_getok = (tw & ~u(7)) | u(4) | (payload << u(3 + 2 * lcb))
+
+        # --- select by tag ----------------------------------------------------
+        def sel(pairs, default):
+            out = default
+            for t, v in pairs:
+                out = jnp.where(tag == u(t), v, out)
+            return out
+
+        valid = occupied & sel(
+            [
+                (_T_PUT, put_guard),
+                (_T_GET, get_guard),
+                (_T_PREPARE, prepare_guard),
+                (_T_PREPARED, prepared_guard),
+                (_T_ACCEPT, accept_guard),
+                (_T_ACCEPTED, accepted_guard),
+                (_T_DECIDED, decided_guard),
+                (_T_PUTOK, putok_guard),
+                (_T_GETOK, getok_guard),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+        srv_lo = sel(
+            [
+                (_T_PUT, plo),
+                (_T_PREPARE, qlo),
+                (_T_PREPARED, rlo),
+                (_T_ACCEPT, alo),
+                (_T_ACCEPTED, blo),
+                (_T_DECIDED, dlo),
+            ],
+            lo,
+        )
+        srv_hi = sel(
+            [
+                (_T_PUT, phi),
+                (_T_PREPARE, qhi),
+                (_T_PREPARED, rhi),
+                (_T_ACCEPT, ahi),
+                (_T_ACCEPTED, bhi),
+                (_T_DECIDED, dhi),
+            ],
+            hi,
+        )
+        cli_f = sel([(_T_PUTOK, cli_putok), (_T_GETOK, cli_getok)], cli)
+        tw_f = sel([(_T_PUTOK, tw_putok), (_T_GETOK, tw_getok)], tw)
+        s0 = sel(
+            [
+                (_T_PUT, put_s0),
+                (_T_GET, get_s0),
+                (_T_PREPARE, prepare_s0),
+                (_T_PREPARED, pd_s0),
+                (_T_ACCEPT, accept_s0),
+                (_T_ACCEPTED, ad_s0),
+                (_T_PUTOK, putok_s0),
+            ],
+            u(0),
+        )
+        s1 = sel([(_T_PUT, put_s1), (_T_PREPARED, pd_s1), (_T_ACCEPTED, ad_s1)], u(0))
+        s2 = sel([(_T_ACCEPTED, ad_s2)], u(0))
+        branch_flag = sel(
+            [
+                (_T_PUT, put_flag),
+                (_T_GET, get_flag),
+                (_T_PREPARED, pd_flag),
+                (_T_ACCEPTED, ad_flag),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+
+        # Invalid lanes must not contribute phantom sends to the slot math.
+        s0 = jnp.where(valid, s0, u(0))
+        s1 = jnp.where(valid, s1, u(0))
+        s2 = jnp.where(valid, s2, u(0))
+
+        # --- re-canonicalize network slots ------------------------------------
+        slots = jnp.where(lane_sel, u(0), state[self._NET0 : self._NET0 + m])
+        cand = jnp.concatenate([slots, jnp.stack([s0, s1, s2])])
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        slot_overflow = valid & jnp.any(cand[m:] != ones)
+        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
+
+        flag = (branch_flag & valid) | slot_overflow | dup
+
+        # --- assemble the successor (fully static word construction) ---------
+        head = []
+        for s in range(S):
+            head.append(jnp.where(dsrv == u(s), srv_lo, state[2 * s]))
+            head.append(jnp.where(dsrv == u(s), srv_hi, state[2 * s + 1]))
+        head.append(cli_f)
+        tail = [
+            jnp.where(ci == u(j), tw_f, state[tst0 + j]) for j in range(c)
+        ]
+        ns = jnp.concatenate(
+            [jnp.stack(head), new_slots, jnp.stack(tail)]
+        ).astype(u)
+        return ns, valid, flag
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        lin = self._device_linearizable(state)
+        # sometimes "value chosen": a GetOk with a non-null value in flight
+        # (models/paxos.py:193-197).
+        slots = state[self._NET0 : self._NET0 + self.m]
+        e = slots - u(1)
+        getok = (slots != u(0)) & ((e >> u(18)) == u(_T_GETOK))
+        chosen = jnp.any(getok & ((e & u(0x3FFF)) != u(0)))
+        return jnp.stack([lin, chosen])
+
+    def _device_linearizable(self, state):
+        """Exact linearizability of the recorded register history.
+
+        The host property runs ``LinearizabilityTester.serialized_history()``
+        — an exponential interleaving search with real-time pruning
+        (semantics/consistency.py:241-295).  On device the same decision is
+        a reachability DP over Wing&Gong-style configurations: subsets of
+        the ≤ 2C register operations crossed with the register value, where
+        an op may be appended to a configuration iff its real-time
+        prerequisites (from the tester's last-completed snapshots) are
+        already in the subset and, for a read, the register holds the value
+        it returned.  The history is linearizable iff a configuration
+        containing every *completed* op is reachable (in-flight writes are
+        optional; in-flight reads are always droppable).  Exactness is
+        pinned by tests/test_paxos_tpu.py against the host tester over both
+        the full reachable state space and an exhaustive synthetic
+        tester-state enumeration (including violations).
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        c = self.c
+        n_ops = 2 * c  # op i = W_i (client i's put), op c+i = R_i (its get)
+        nsub = 1 << n_ops
+        nv = c + 1  # register values: 0 = NULL, 1+i = client i's value
+        lcb = 2 * (c - 1)
+        tst0 = self._NET0 + self.m
+
+        tw = [state[tst0 + i] for i in range(c)]
+        phase = [w & u(7) for w in tw]
+        lc_r = [(w >> u(3 + lcb)) & u((1 << lcb) - 1) for w in tw]
+        v_read = [(w >> u(3 + 2 * lcb)) & u(3) for w in tw]
+
+        w_completed = [phase[i] >= u(2) for i in range(c)]
+        w_present = [phase[i] >= u(1) for i in range(c)]
+        r_present = [phase[i] == u(4) for i in range(c)]  # completed reads
+
+        # Real-time prerequisite masks.  A snapshot code about thread j
+        # constrains only j's *completed* ops (consistency.py:252-261).
+        pm = []
+        for i in range(c):
+            pm.append(u(0))  # writes invoke at init: empty snapshot
+        for i in range(c):
+            mask = u(1 << i)  # program order: W_i before R_i
+            slot = 0
+            for j in range(c):
+                if j == i:
+                    continue
+                cj = (lc_r[i] >> u(2 * slot)) & u(3)
+                mask = mask | jnp.where(
+                    (cj >= u(1)) & w_completed[j], u(1 << j), u(0)
+                )
+                mask = mask | jnp.where(
+                    (cj >= u(2)) & r_present[j], u(1 << (c + j)), u(0)
+                )
+                slot += 1
+            pm.append(mask)
+        present = w_present + r_present
+
+        sub = np.arange(nsub, dtype=np.uint32)
+        dp = jnp.zeros((nsub, nv), jnp.bool_)
+        dp = dp.at[0, 0].set(True)
+        col = np.eye(nv, dtype=bool)
+        for _ in range(n_ops):
+            for o in range(n_ops):
+                bit = 1 << o
+                has = (sub & bit) != 0  # static
+                src = np.where(has, sub ^ bit, 0).astype(np.uint32)
+                dp_src = dp[src]
+                predok = ((pm[o] & ~jnp.asarray(src)) == u(0)) & present[o]
+                if o < c:  # write: register becomes 1+o
+                    add = (
+                        jnp.any(dp_src, axis=-1)
+                        & predok
+                        & jnp.asarray(has)
+                    )
+                    dp = dp | (add[:, None] & jnp.asarray(col[1 + o])[None, :])
+                else:  # read: register must equal the returned value
+                    vmatch = jnp.arange(nv, dtype=u) == v_read[o - c]
+                    add = (
+                        dp_src
+                        & vmatch[None, :]
+                        & predok[:, None]
+                        & jnp.asarray(has)[:, None]
+                    )
+                    dp = dp | add
+
+        req = u(0)
+        for i in range(c):
+            req = req | jnp.where(w_completed[i], u(1 << i), u(0))
+            req = req | jnp.where(r_present[i], u(1 << (c + i)), u(0))
+        covers = (req & ~jnp.asarray(sub)) == u(0)
+        return jnp.any(dp & covers[:, None])
 
 
 def compiled_paxos(model) -> PaxosCompiled:

@@ -48,6 +48,14 @@ class CompiledModel:
     state_width: int
     max_actions: int
 
+    # When True, :meth:`step` returns a third value — a boolean scalar (or
+    # any shape the engine can ``jnp.any``) flagging that some successor
+    # exceeded the packed encoding's capacity assumptions (e.g. more
+    # in-flight messages than the layout holds).  The engines surface the
+    # flag as a hard error instead of silently corrupting states, mirroring
+    # the loud refusal of the host-side ``encode``.
+    step_flags: bool = False
+
     # --- host side -----------------------------------------------------------
 
     def init_packed(self) -> np.ndarray:
@@ -71,6 +79,7 @@ class CompiledModel:
         Invalid lanes may contain arbitrary words; the engine masks them.
         A successor lane is valid iff the corresponding host action is
         enabled AND produces a state change (``next_state`` not None).
+        With ``step_flags`` True, returns a third encoding-overflow flag.
         """
         raise NotImplementedError
 
@@ -96,17 +105,6 @@ class CompiledModel:
             self.max_actions,
             repr(self.model),
         )
-
-    # --- hybrid properties ---------------------------------------------------
-
-    @property
-    def host_property_indices(self) -> tuple:
-        """Indices of properties whose device predicate is only a cheap
-        *necessary* filter; states flagged by the device are re-checked on
-        the host with the real condition (e.g. linearizability's
-        backtracking serialization search — SURVEY §7 hard-part 4)."""
-        return ()
-
 
 def compiled_model_for(model: Model) -> CompiledModel:
     """Resolve the compiled form of ``model``.

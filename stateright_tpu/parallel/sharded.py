@@ -133,11 +133,14 @@ class ShardedTpuChecker(Checker):
             # Shared expansion-time evaluation; ids are global this time.
             my_gids = (me << jnp.uint32(slot_bits)) | safe_slots
             disc0 = jnp.full((n_props,), NO_GID, jnp.uint32) | (me & 0)
-            cand, eb, nexts, valid, gen_local = wave_eval(
+            cand, eb, nexts, valid, gen_local, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, my_gids,
                 ebits[safe_slots], disc0,
             )
             generated = jax.lax.psum(gen_local, "shards")
+            step_flag_global = (
+                jax.lax.psum(step_flag.astype(jnp.uint32), "shards") > 0
+            )
 
             # Bucket candidates by owner shard and exchange over ICI.
             flat = nexts.reshape(b, w)
@@ -217,6 +220,7 @@ class ShardedTpuChecker(Checker):
                 cand,
                 probe_global[None],
                 dd_global[None],
+                step_flag_global[None],
             )
 
         shard = P("shards")
@@ -228,7 +232,7 @@ class ShardedTpuChecker(Checker):
                 in_specs=specs_table + (shard, shard),
                 out_specs=(
                     specs_table
-                    + (shard, shard, shard, shard, shard, shard, shard)
+                    + (shard, shard, shard, shard, shard, shard, shard, shard)
                 ),
             ),
             donate_argnums=(0, 1, 2, 3, 4),
@@ -395,6 +399,7 @@ class ShardedTpuChecker(Checker):
                     cand,
                     probe_ok,
                     dd_overflow,
+                    step_flag,
                 ) = wave(
                     key_hi,
                     key_lo,
@@ -415,6 +420,13 @@ class ShardedTpuChecker(Checker):
                         "than its insert dedup buffer holds; lower "
                         f"dedup_factor (now {self._dedup_factor}) or "
                         "chunk_size"
+                    )
+                if np.asarray(step_flag).any():
+                    raise RuntimeError(
+                        "the model step kernel flagged an encoding-capacity "
+                        "overflow (a successor exceeded the packed layout's "
+                        "bounds); the compiled model's capacity assumptions "
+                        "do not hold for this configuration"
                     )
                 n_new_local_h = np.asarray(n_new_local).reshape(n)
                 new_slots_h = np.asarray(new_slots).reshape(n, -1)

@@ -24,6 +24,7 @@ class WaveEval(NamedTuple):
     nexts: object  # uint32[F, A, W] successor candidates
     valid: object  # bool[F, A]
     generated: object  # uint32 scalar: local boundary-passing successors
+    step_flag: object  # bool scalar: a successor overflowed the encoding
 
 
 def compact(mask, values, size: int):
@@ -76,7 +77,12 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
         eb = eb & ~(conds[:, p].astype(jnp.uint32) << bit)
 
     # Successor expansion.
-    nexts, valid = jax.vmap(cm.step)(states)  # [F, A, W], [F, A]
+    if getattr(cm, "step_flags", False):
+        nexts, valid, lane_flags = jax.vmap(cm.step)(states)
+        step_flag = jnp.any(jnp.asarray(lane_flags) & active)
+    else:
+        nexts, valid = jax.vmap(cm.step)(states)  # [F, A, W], [F, A]
+        step_flag = jnp.zeros((), jnp.bool_)
     valid = valid & active[:, None]
     if cm.boundary(states[0]) is not None:
         valid = valid & jax.vmap(jax.vmap(cm.boundary))(nexts)
@@ -91,4 +97,4 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
         cand = jnp.where(jnp.any(hit), ids[idx], jnp.uint32(NO_ID))
         disc = disc.at[p].set(jnp.where(disc[p] == jnp.uint32(NO_ID), cand, disc[p]))
 
-    return WaveEval(disc, eb, nexts, valid, generated)
+    return WaveEval(disc, eb, nexts, valid, generated, step_flag)

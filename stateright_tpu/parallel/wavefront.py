@@ -173,7 +173,7 @@ class TpuChecker(Checker):
             safe_slots = jnp.where(active, frontier, 0)
             states = store[safe_slots]  # [F, W]
 
-            disc, eb, nexts, valid, generated = wave_eval(
+            disc, eb, nexts, valid, generated, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, safe_slots,
                 ebits[safe_slots], disc,
             )
@@ -211,6 +211,7 @@ class TpuChecker(Checker):
                 n_new > jnp.uint32(f), 2, 0
             ).astype(jnp.uint32)
             flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
+            flags = flags | jnp.where(step_flag, 8, 0).astype(jnp.uint32)
 
             return (
                 table.key_hi,
@@ -435,6 +436,13 @@ class TpuChecker(Checker):
                         "insert dedup buffer holds (batch/dedup_factor); "
                         f"lower spawn_tpu(dedup_factor=...) (now "
                         f"{self._dedup_factor})"
+                    )
+                if flags_h & 8:
+                    raise RuntimeError(
+                        "the model step kernel flagged an encoding-capacity "
+                        "overflow (a successor exceeded the packed layout's "
+                        "bounds); the compiled model's capacity assumptions "
+                        "do not hold for this configuration"
                     )
                 if fcount_h == 0:
                     break

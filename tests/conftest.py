@@ -6,13 +6,24 @@ exercised without hardware; set up before any jax import.
 
 import os
 import pathlib
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hermetic default: force the cpu platform (ambient JAX_PLATFORMS often
+# points at a TPU plugin that sitecustomize preloads).  To validate on real
+# hardware, opt in explicitly: STATERIGHT_TPU_TEST_PLATFORM=tpu pytest …
+_platform = os.environ.get("STATERIGHT_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# If jax is already imported (sitecustomize), the env var is too late —
+# pin the config directly, before any backend initializes.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
 
 # Persistent XLA compilation cache: the wavefront programs take tens of
 # seconds to compile cold but are stable across runs.

@@ -1,0 +1,275 @@
+"""Device-kernel gates for the paxos flagship workload.
+
+Three layers of defense, per docs/TPU_PAXOS_DESIGN.md:
+
+1. step-kernel differential: device successor sets == host successor sets
+   over the *entire* reachable space (C=1 exhaustively per-lane, C=2 as
+   successor-set equality over all 16,668 states);
+2. exact-linearizability differential: the on-device Wing&Gong-style
+   subset-DP (`_device_linearizable`) agrees with the host
+   ``LinearizabilityTester.serialized_history()`` on an exhaustive
+   enumeration of consistent tester states — crucially including
+   NON-linearizable ones, which the reachable paxos space never produces;
+3. full-checker golden: ``spawn_tpu`` reproduces the reference's 16,668
+   unique states (examples/paxos.rs:328) with a discovery set identical to
+   the host oracle's.
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.actor import Id, Network
+from stateright_tpu.actor.model import Deliver
+from stateright_tpu.models.paxos import PaxosModelCfg
+from stateright_tpu.models.paxos_compiled import PaxosCompiled
+
+from .test_paxos_compiled import enumerate_reachable, paxos_model
+
+
+def lane_fn_for(cm):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        jax.vmap(
+            lambda st: jax.vmap(lambda k: cm._deliver_lane(st, k))(
+                jnp.arange(cm.m, dtype=jnp.uint32)
+            )
+        )
+    )
+
+
+def test_step_differential_full_reachable_c1(reachable_c1):
+    """Per-lane: validity, successor words, and flags for all 265 states."""
+    import jax.numpy as jnp
+
+    model = paxos_model(1)
+    cm = PaxosCompiled(model)
+    states = list(reachable_c1.values())
+    enc = np.stack([cm.encode(s) for s in states]).astype(np.uint32)
+    nexts, valid, flags = (
+        np.asarray(x) for x in lane_fn_for(cm)(jnp.asarray(enc))
+    )
+    assert not flags.any()
+    for bi, s in enumerate(states):
+        host_map = {}
+        for env in s.network.iter_deliverable():
+            ns = model.next_state(s, Deliver(env.src, env.dst, env.msg))
+            host_map[cm._env_code(env)] = None if ns is None else cm.encode(ns)
+        for k in range(cm.m):
+            code = int(enc[bi][cm._NET0 + k])
+            if code == 0:
+                assert not valid[bi, k]
+                continue
+            want = host_map[code]
+            if want is None:
+                assert not valid[bi, k], cm._env_of(code)
+            else:
+                assert valid[bi, k], cm._env_of(code)
+                assert np.array_equal(nexts[bi, k], want), cm._env_of(code)
+
+
+def test_step_differential_full_reachable_c2(reachable_c2):
+    """Successor-set equality over the full golden 16,668-state space."""
+    import jax.numpy as jnp
+
+    model = paxos_model(2)
+    cm = PaxosCompiled(model)
+    states = list(reachable_c2.values())
+    enc = np.stack([cm.encode(s) for s in states]).astype(np.uint32)
+    lane_fn = lane_fn_for(cm)
+    bad = 0
+    for off in range(0, len(states), 2048):
+        chunk = enc[off : off + 2048]
+        nexts, valid, flags = (
+            np.asarray(x) for x in lane_fn(jnp.asarray(chunk))
+        )
+        assert not flags.any()
+        for bi in range(len(chunk)):
+            s = states[off + bi]
+            host_succ = set()
+            for env in s.network.iter_deliverable():
+                ns = model.next_state(s, Deliver(env.src, env.dst, env.msg))
+                if ns is not None:
+                    host_succ.add(cm.encode(ns).tobytes())
+            dev_succ = {
+                nexts[bi, k].tobytes() for k in range(cm.m) if valid[bi, k]
+            }
+            bad += dev_succ != host_succ
+    assert bad == 0
+
+
+def _consistent_tester_words(cm, rng=None, limit=None):
+    """Enumerate (or sample) consistent synthetic tester states as per-client
+    packed words.  Consistency: a last-completed snapshot about thread j
+    cannot claim more completed ops than j currently has (counts only grow,
+    so any reachable state satisfies this)."""
+    c = cm.c
+    lcb = 2 * (c - 1)
+    choices = []
+    for phase in (0, 1, 2, 3, 4):
+        lc_opts = [0]
+        if phase >= 3:
+            lc_opts = range(1 << lcb)
+        v_opts = [0]
+        if phase == 4:
+            v_opts = range(c + 1)
+        for lc in lc_opts:
+            if any(((lc >> (2 * s)) & 3) == 3 for s in range(c - 1)):
+                continue  # code 3 (index 2) does not exist: ops/thread <= 2
+            for v in v_opts:
+                choices.append((phase, lc, v))
+    import itertools
+
+    combos = itertools.product(choices, repeat=c)
+    if limit is not None:
+        combos = list(combos)
+        rng.shuffle(combos)
+        combos = combos[:limit]
+    for combo in combos:
+        phases = [x[0] for x in combo]
+        ok = True
+        words = []
+        for i, (phase, lc, v) in enumerate(combo):
+            slot = 0
+            for j in range(c):
+                if j == i:
+                    continue
+                code = (lc >> (2 * slot)) & 3
+                cnt_j = (phases[j] >= 2) + (phases[j] == 4)
+                if code > cnt_j:
+                    ok = False
+                slot += 1
+            words.append(phase | (lc << (3 + lcb)) | (v << (3 + 2 * lcb)))
+        if ok:
+            yield words
+
+
+def _lin_cases(c, rng=None, limit=None):
+    from stateright_tpu.models.paxos import NULL_VALUE
+    from stateright_tpu.semantics import LinearizabilityTester, Register
+
+    model = paxos_model(c)
+    cm = PaxosCompiled(model)
+    cases = []
+    for words in _consistent_tester_words(cm, rng=rng, limit=limit):
+        tester = LinearizabilityTester(Register(NULL_VALUE))
+        for i, w in enumerate(words):
+            cm._decode_tester_into(tester, w, i)
+        state = np.zeros(cm.state_width, np.uint32)
+        tst0 = cm._NET0 + cm.m
+        for i, w in enumerate(words):
+            state[tst0 + i] = w
+        cases.append((state, tester.serialized_history() is not None))
+    return cm, cases
+
+
+def _assert_lin_matches(cm, cases):
+    import jax
+    import jax.numpy as jnp
+
+    lin = jax.jit(jax.vmap(cm._device_linearizable))
+    enc = np.stack([s for s, _ in cases])
+    got = np.asarray(lin(jnp.asarray(enc)))
+    want = np.array([w for _, w in cases])
+    mism = np.flatnonzero(got != want)
+    assert len(mism) == 0, (
+        f"{len(mism)} mismatches, first state={enc[mism[0]]}, "
+        f"host={want[mism[0]]}, device={got[mism[0]]}"
+    )
+    # The enumeration must actually exercise violations.
+    assert (~want).sum() > 0
+
+
+def test_device_linearizability_exhaustive_c2():
+    cm, cases = _lin_cases(2)
+    _assert_lin_matches(cm, cases)
+
+
+def test_device_linearizability_sampled_c3():
+    import random
+
+    cm, cases = _lin_cases(3, rng=random.Random(7), limit=2500)
+    _assert_lin_matches(cm, cases)
+
+
+def test_spawn_tpu_paxos2_matches_host_oracle(reachable_c2):
+    model = paxos_model(2)
+    tpu = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 18, max_frontier=1 << 13)
+        .join()
+    )
+    assert tpu.unique_state_count() == 16_668  # examples/paxos.rs:328
+    host = paxos_model(2).checker().spawn_bfs().join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    # The device discovery must replay as a genuine example trace.
+    tpu.assert_properties()
+
+
+def test_step_flag_overflow_is_loud():
+    """A delivery whose sends exceed the slot budget must flag, not corrupt."""
+    import jax
+    import jax.numpy as jnp
+
+    from stateright_tpu.actor import Envelope
+    from stateright_tpu.actor.register import Internal, Put
+    from stateright_tpu.models.paxos import Prepare
+
+    model = paxos_model(2)
+    cm = PaxosCompiled(model)
+    state = np.zeros(cm.state_width, np.uint32)
+    # Slot 0: client 0's Put to server 0 (delivery broadcasts 2 Prepares).
+    codes = [cm._env_code(Envelope(Id(3), Id(0), Put(3, "A")))]
+    # Fill the rest with distinct well-formed Prepare envelopes.
+    for r in range(2, 10):
+        for src in range(3):
+            for dst in range(3):
+                if src != dst and len(codes) < cm.m:
+                    codes.append(
+                        cm._env_code(
+                            Envelope(Id(src), Id(dst), Internal(Prepare((r, Id(src)))))
+                        )
+                    )
+    assert len(codes) == cm.m
+    for k, code in enumerate(sorted(codes)):
+        state[cm._NET0 + k] = code
+    nexts, valid, flag = cm.step(jnp.asarray(state))
+    assert bool(jnp.any(flag))
+
+
+def test_engine_surfaces_step_flag():
+    """The wavefront engine turns a step flag into a hard error."""
+    import jax.numpy as jnp
+
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.models.twophase_compiled import TwoPhaseCompiled
+
+    class Flagging(TwoPhaseCompiled):
+        step_flags = True
+
+        def step(self, state):
+            nexts, valid = super().step(state)
+            return nexts, valid, jnp.ones((), jnp.bool_)
+
+        def cache_key(self):
+            return (type(self).__qualname__, self.n)
+
+    model = TwoPhaseSys(rm_count=3)
+    with pytest.raises(RuntimeError, match="encoding-capacity overflow"):
+        model.checker().spawn_tpu(
+            capacity=1 << 12, compiled=Flagging(model)
+        ).join()
+
+
+@pytest.fixture(scope="module")
+def reachable_c1():
+    return enumerate_reachable(paxos_model(1))
+
+
+@pytest.fixture(scope="module")
+def reachable_c2():
+    return enumerate_reachable(paxos_model(2))
