@@ -67,6 +67,13 @@ class PaxosCompiled(CompiledModel):
             raise ValueError("packed paxos fixes server_count=3")
         if cfg.client_count > 3:
             raise ValueError("packed paxos supports at most 3 clients")
+        if model.lossy_network or model.max_crashes:
+            # The step kernel expands Deliver lanes only; a lossy or crashy
+            # configuration has Drop/Crash/Recover action families the
+            # device would silently skip (actor/model.py:252-272).
+            raise ValueError(
+                "packed paxos supports lossless, crash-free configurations"
+            )
         self.c = cfg.client_count
         self.values = tuple(
             chr(ord("A") + i) for i in range(self.c)

@@ -48,12 +48,13 @@ class CompiledModel:
     state_width: int
     max_actions: int
 
-    # When True, :meth:`step` returns a third value — a boolean scalar (or
-    # any shape the engine can ``jnp.any``) flagging that some successor
-    # exceeded the packed encoding's capacity assumptions (e.g. more
-    # in-flight messages than the layout holds).  The engines surface the
-    # flag as a hard error instead of silently corrupting states, mirroring
-    # the loud refusal of the host-side ``encode``.
+    # When True, :meth:`step` returns a third value — a boolean *scalar*
+    # (one flag per input state; fold per-action flags with ``jnp.any``
+    # inside ``step``) marking that some successor exceeded the packed
+    # encoding's capacity assumptions (e.g. more in-flight messages than
+    # the layout holds).  The engines surface the flag as a hard error
+    # instead of silently corrupting states, mirroring the loud refusal of
+    # the host-side ``encode``.
     step_flags: bool = False
 
     # --- host side -----------------------------------------------------------

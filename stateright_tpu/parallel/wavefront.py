@@ -64,7 +64,7 @@ class TpuChecker(Checker):
         self,
         options,
         capacity: int = 1 << 20,
-        max_frontier: int = 1 << 15,
+        max_frontier: int = 1 << 15,  # per-chunk batch size, not a level cap
         dedup_factor: int = 4,
         waves_per_call: Optional[int] = None,
         device=None,
@@ -120,28 +120,38 @@ class TpuChecker(Checker):
     # --- device program ------------------------------------------------------
 
     def _build_run(self):
-        """Build the fused multi-wave program.
+        """Build the fused multi-chunk program.
 
-        Carry: (key_hi, key_lo, store, parent, ebits, frontier, fcount,
-        sc_lo, sc_hi, unique_count, depth, disc[P], waves_left, flags).
-        ``sc_lo``/``sc_hi`` form the 64-bit generated-state counter (no u64
-        on device).  flags: bit 0 = table overfull / probe failure; bit 1 =
-        frontier overflow (> max_frontier new states in one wave); bit 2 =
-        insert dedup-buffer overflow (batch had > B/dedup_factor distinct
-        keys).
+        The frontier is a FIFO *slot queue* in HBM with explicit BFS-level
+        boundaries: each loop iteration expands one chunk (≤ ``chunk``
+        states) of the current level, appends newly inserted slots at the
+        queue tail, and advances ``depth`` only when a level is fully
+        drained — so levels may be arbitrarily wide (no frontier-overflow
+        failure mode) while depth/target semantics stay exactly those of a
+        level-at-a-time BFS.
+
+        Carry: (key_hi, key_lo, store, parent, ebits, queue, level_start,
+        level_end, tail, sc_lo, sc_hi, unique_count, depth, disc[P],
+        waves_left, flags).  ``sc_lo``/``sc_hi`` form the 64-bit
+        generated-state counter (no u64 on device).  flag values: 1 = table
+        overfull (probe failure or beyond 50% load); 2 = queue overflow
+        (cannot happen before 1 at queue size == capacity; kept as a
+        backstop); 4 = insert dedup-buffer overflow; 8 = model step kernel
+        capacity overflow.
         """
         import jax
         import jax.numpy as jnp
 
         from ..ops.device_fp import device_fp64
         from .hashset import HashSet, insert_batch
-        from .wave_common import compact, wave_eval
+        from .wave_common import wave_eval
 
         cm = self._compiled
         w = cm.state_width
         a = cm.max_actions
-        f = self._max_frontier
+        f = self._max_frontier  # chunk size
         cap = self._capacity
+        qcap = cap  # every unique state enters the queue exactly once
         dedup_factor = self._dedup_factor
         props = self._properties
         n_props = len(props)
@@ -156,8 +166,10 @@ class TpuChecker(Checker):
                 store,
                 parent,
                 ebits,
-                frontier,
-                fcount,
+                queue,
+                level_start,
+                level_end,
+                tail,
                 sc_lo,
                 sc_hi,
                 unique_count,
@@ -166,11 +178,12 @@ class TpuChecker(Checker):
                 waves_left,
                 flags,
             ) = carry
-            depth = depth + 1
 
+            count = jnp.minimum(level_end - level_start, jnp.uint32(f))
+            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
             lane = jnp.arange(f, dtype=jnp.uint32)
-            active = lane < fcount
-            safe_slots = jnp.where(active, frontier, 0)
+            active = lane < count
+            safe_slots = jnp.where(active, chunk, 0)
             states = store[safe_slots]  # [F, W]
 
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
@@ -198,17 +211,25 @@ class TpuChecker(Checker):
             n_new = jnp.sum(is_new, dtype=jnp.uint32)
             unique_count = unique_count + n_new
 
-            # Compact new slots into the next frontier (cumsum positions
-            # preserve wave order; far cheaper than a sort at B lanes).
-            frontier = compact(is_new, slot, f)
-            fcount = jnp.minimum(n_new, jnp.uint32(f))
+            # Append new slots at the queue tail in lane order (cumsum
+            # positions keep discovery order deterministic).
+            qpos = tail + jnp.cumsum(is_new.astype(jnp.uint32)) - 1
+            qidx = jnp.where(is_new, qpos, jnp.uint32(qcap + f))
+            queue = queue.at[qidx].set(slot, mode="drop")
+            tail = tail + n_new
+
+            # Advance within the level; roll the level boundary when drained.
+            level_start = level_start + count
+            done_level = level_start >= level_end
+            depth = depth + done_level.astype(jnp.uint32)
+            level_end = jnp.where(done_level, tail, level_end)
 
             flags = flags | jnp.where(probe_ok, 0, 1).astype(jnp.uint32)
             flags = flags | jnp.where(
                 unique_count * 2 > jnp.uint32(cap), 1, 0
             ).astype(jnp.uint32)
             flags = flags | jnp.where(
-                n_new > jnp.uint32(f), 2, 0
+                tail > jnp.uint32(qcap), 2, 0
             ).astype(jnp.uint32)
             flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
             flags = flags | jnp.where(step_flag, 8, 0).astype(jnp.uint32)
@@ -219,8 +240,10 @@ class TpuChecker(Checker):
                 store,
                 parent,
                 ebits,
-                frontier,
-                fcount,
+                queue,
+                level_start,
+                level_end,
+                tail,
                 sc_lo,
                 sc_hi,
                 unique_count,
@@ -231,14 +254,15 @@ class TpuChecker(Checker):
             )
 
         def wave_cond(carry):
-            fcount = carry[6]
-            depth = carry[10]
-            disc = carry[11]
-            waves_left = carry[12]
-            flags = carry[13]
-            go = (fcount > 0) & (waves_left > 0) & (flags == 0)
+            level_start = carry[6]
+            level_end = carry[7]
+            depth = carry[12]
+            disc = carry[13]
+            waves_left = carry[14]
+            flags = carry[15]
+            go = (level_start < level_end) & (waves_left > 0) & (flags == 0)
             if target_depth:
-                # The next wave would expand states at depth+1; the
+                # The next chunk would expand states at depth+1; the
                 # reference skips jobs with depth >= target at pop time, so
                 # states at the target depth are counted but not expanded.
                 go = go & (depth < target_depth - 1)
@@ -246,17 +270,20 @@ class TpuChecker(Checker):
                 go = go & jnp.any(disc == jnp.uint32(0xFFFFFFFF))
             return go
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-        def run(key_hi, key_lo, store, parent, ebits, frontier, fcount,
-                sc_lo, sc_hi, unique_count, depth, disc, waves):
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+        def run(key_hi, key_lo, store, parent, ebits, queue, level_start,
+                level_end, tail, sc_lo, sc_hi, unique_count, depth, disc,
+                waves):
             carry = (
                 key_hi,
                 key_lo,
                 store,
                 parent,
                 ebits,
-                frontier,
-                fcount,
+                queue,
+                level_start,
+                level_end,
+                tail,
                 sc_lo,
                 sc_hi,
                 unique_count,
@@ -271,6 +298,8 @@ class TpuChecker(Checker):
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def seed(key_hi, key_lo, store, ebits, init_padded, n_init):
+            from .wave_common import compact
+
             hi, lo = device_fp64(init_padded)
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
             table, slot, is_new, probe_ok, dd_overflow = insert_batch(
@@ -279,7 +308,10 @@ class TpuChecker(Checker):
             sslot = jnp.where(is_new, slot, jnp.uint32(cap))
             store = store.at[sslot].set(init_padded, mode="drop")
             ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            frontier = compact(is_new, slot, f)
+            # Queue is padded by one chunk so mid-level dynamic slices never
+            # clamp; slots beyond the tail are masked by `count` anyway.
+            queue = jnp.zeros((qcap + f,), jnp.uint32)
+            queue = queue.at[:f].set(compact(is_new, slot, f))
             fcount = jnp.sum(is_new, dtype=jnp.uint32)
             ok = probe_ok & ~dd_overflow
             return (
@@ -287,7 +319,7 @@ class TpuChecker(Checker):
                 table.key_lo,
                 store,
                 ebits,
-                frontier,
+                queue,
                 fcount,
                 ok,
             )
@@ -353,7 +385,7 @@ class TpuChecker(Checker):
             pad = np.zeros((f - n_init, cm.state_width), np.uint32)
             init_padded = jnp.asarray(np.concatenate([init, pad]))
             seed, run = self._programs()
-            key_hi, key_lo, store, ebits, frontier, fcount, seed_ok = seed(
+            key_hi, key_lo, store, ebits, queue, fcount, seed_ok = seed(
                 table.key_hi,
                 table.key_lo,
                 store,
@@ -372,6 +404,9 @@ class TpuChecker(Checker):
             sc_lo = jnp.uint32(n_init)
             sc_hi = jnp.uint32(0)
             unique_count = fcount
+            level_start = jnp.uint32(0)
+            level_end = unique_count
+            tail = unique_count
             depth = jnp.uint32(0)
             disc = jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32)
 
@@ -382,8 +417,10 @@ class TpuChecker(Checker):
                     store,
                     parent,
                     ebits,
-                    frontier,
-                    fcount,
+                    queue,
+                    level_start,
+                    level_end,
+                    tail,
                     sc_lo,
                     sc_hi,
                     unique_count,
@@ -397,8 +434,10 @@ class TpuChecker(Checker):
                     store,
                     parent,
                     ebits,
-                    frontier,
-                    fcount,
+                    queue,
+                    level_start,
+                    level_end,
+                    tail,
                     sc_lo,
                     sc_hi,
                     unique_count,
@@ -406,15 +445,15 @@ class TpuChecker(Checker):
                     disc,
                     jnp.int32(self._waves_per_call),
                 )
-                # One small sync per waves_per_call waves.
-                fcount_h = int(fcount)
+                # One small sync per waves_per_call chunks.
+                remaining_h = int(level_end) - int(level_start)
                 depth_h = int(depth)
                 flags_h = int(flags)
                 disc_h = np.asarray(disc)
                 with self._lock:
                     self._state_count = (int(sc_hi) << 32) | int(sc_lo)
                     self._unique_count = int(unique_count)
-                    self._max_depth = depth_h + (1 if fcount_h else 0)
+                    self._max_depth = depth_h + (1 if remaining_h else 0)
                     for p, prop in enumerate(props):
                         if int(disc_h[p]) != NO_SLOT_HOST:
                             self._discovery_slots.setdefault(
@@ -427,8 +466,8 @@ class TpuChecker(Checker):
                     )
                 if flags_h & 2:
                     raise RuntimeError(
-                        f"frontier exceeded max_frontier ({f}); raise "
-                        "spawn_tpu(max_frontier=...)"
+                        "frontier queue overflowed its backstop bound; raise "
+                        "spawn_tpu(capacity=...)"
                     )
                 if flags_h & 4:
                     raise RuntimeError(
@@ -444,7 +483,7 @@ class TpuChecker(Checker):
                         "bounds); the compiled model's capacity assumptions "
                         "do not hold for this configuration"
                     )
-                if fcount_h == 0:
+                if remaining_h == 0:
                     break
                 if (
                     opts._target_max_depth is not None

@@ -154,6 +154,32 @@ def test_twophase5_golden_tpu():
     assert tpu.unique_state_count() == 8832
 
 
+def test_levels_wider_than_chunk_match_host():
+    """A BFS level far wider than max_frontier is processed in chunks from
+    the slot queue instead of failing; counts, depth, and discoveries still
+    match the host oracle exactly (2pc(5)'s peak level is ~2,000 states,
+    checked here with 128-state chunks)."""
+    model = TwoPhaseSys(rm_count=5)
+    _host, tpu = _assert_checker_parity(
+        model, capacity=1 << 15, max_frontier=1 << 7
+    )
+    assert tpu.unique_state_count() == 8832
+
+
+def test_target_max_depth_with_chunked_levels():
+    """Depth gating must trigger at level boundaries, not chunk boundaries."""
+    model = TwoPhaseSys(rm_count=5)
+    host = model.checker().target_max_depth(6).spawn_bfs().join()
+    tpu = (
+        model.checker()
+        .target_max_depth(6)
+        .spawn_tpu(capacity=1 << 15, max_frontier=1 << 7)
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.max_depth() == host.max_depth()
+
+
 # --- eventually-property machinery on device ---------------------------------
 
 
