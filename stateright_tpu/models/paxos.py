@@ -188,6 +188,10 @@ class PaxosModelCfg:
     client_count: int
     server_count: int
     network: Network
+    # Adds an (intentionally false) always-property "never decided" — the
+    # property-violating variant BASELINE.md's time-to-first-violation
+    # metric is measured on.
+    never_decided: bool = False
 
     def into_model(self) -> ActorModel:
         def value_chosen(_m, state):
@@ -218,6 +222,14 @@ class PaxosModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+        if self.never_decided:
+            model.property(
+                Expectation.ALWAYS,
+                "never decided",
+                lambda _m, s: not any(
+                    getattr(a, "is_decided", False) for a in s.actor_states
+                ),
+            )
 
         def _compiled():
             from .paxos_compiled import PaxosCompiled

@@ -87,7 +87,7 @@ class PaxosCompiled(CompiledModel):
         self.max_actions = self.m  # Deliver per slot (lossless, no timers)
 
     def cache_key(self):
-        return (type(self).__qualname__, self.c)
+        return (type(self).__qualname__, self.c, self.model.cfg.never_decided)
 
     # --- small-code helpers --------------------------------------------------
 
@@ -851,7 +851,16 @@ class PaxosCompiled(CompiledModel):
         e = slots - u(1)
         getok = (slots != u(0)) & ((e >> u(18)) == u(_T_GETOK))
         chosen = jnp.any(getok & ((e & u(0x3FFF)) != u(0)))
-        return jnp.stack([lin, chosen])
+        conds = [lin, chosen]
+        if self.model.cfg.never_decided:
+            decided_any = jnp.zeros((), jnp.bool_)
+            for s in range(S):
+                lo, hi = state[2 * s], state[2 * s + 1]
+                decided_any = decided_any | (
+                    self._ext(lo, hi, *self._F_DECIDED) == u(1)
+                )
+            conds.append(~decided_any)
+        return jnp.stack(conds)
 
     def _device_linearizable(self, state):
         """Exact linearizability of the recorded register history.

@@ -41,7 +41,6 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..core.checker import Checker
-from ..core.has_discoveries import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
 from .compiled import CompiledModel, compiled_model_for
@@ -87,11 +86,12 @@ class TpuChecker(Checker):
         self._max_frontier = max_frontier
         self._dedup_factor = dedup_factor
         if waves_per_call is None:
-            # Fidelity knobs that need host checks between waves.
+            # Fidelity knobs that need host checks between chunks
+            # (finish_when is mirrored inside the device loop, so it does
+            # not force per-chunk syncs).
             fine_grained = (
                 options._timeout is not None
                 or options._target_state_count is not None
-                or options._finish_when is not HasDiscoveries.ALL
             )
             waves_per_call = 1 if fine_grained else 256
         self._waves_per_call = waves_per_call
@@ -156,8 +156,46 @@ class TpuChecker(Checker):
         props = self._properties
         n_props = len(props)
         ev_indices = self._ev_indices
-        stop_when_all = self._options._finish_when is HasDiscoveries.ALL
         target_depth = self._options._target_max_depth or 0
+
+        # finish_when, mirrored on device (has_discoveries.py matches()):
+        # the fused loop exits as soon as the policy is satisfied, so e.g.
+        # time-to-first-violation runs don't pay a host sync per chunk.
+        fw = self._options._finish_when
+        fw_kind = fw._kind
+        fail_idx = [
+            i
+            for i, p in enumerate(props)
+            if p.expectation.discovery_is_failure
+        ]
+        name_idx = {p.name: i for i, p in enumerate(props)}
+        fw_named = [name_idx[n] for n in sorted(fw._names) if n in name_idx]
+        fw_names_all_known = all(n in name_idx for n in fw._names)
+
+        def fw_matched(disc):
+            """Device mirror of matches(); constant-TRUE policies (e.g.
+            ALL with zero properties) return False here instead — the
+            host-side check between run() calls owns those, preserving the
+            at-least-one-block-first behavior of the reference's engines."""
+            import jax.numpy as jnp
+
+            found = disc != jnp.uint32(0xFFFFFFFF)  # bool[P]
+            false = jnp.zeros((), jnp.bool_)
+            if fw_kind == "all":
+                return jnp.all(found) if n_props else false
+            if fw_kind == "any":
+                return jnp.any(found) if n_props else false
+            if fw_kind == "any_failures":
+                return jnp.any(found[jnp.asarray(fail_idx)]) if fail_idx else false
+            if fw_kind == "all_failures":
+                return jnp.all(found[jnp.asarray(fail_idx)]) if fail_idx else false
+            if fw_kind == "all_of":
+                if not fw_names_all_known or not fw_named:
+                    return false
+                return jnp.all(found[jnp.asarray(fw_named)])
+            if fw_kind == "any_of":
+                return jnp.any(found[jnp.asarray(fw_named)]) if fw_named else false
+            raise ValueError(fw_kind)
 
         def wave_body(carry):
             (
@@ -266,8 +304,7 @@ class TpuChecker(Checker):
                 # reference skips jobs with depth >= target at pop time, so
                 # states at the target depth are counted but not expanded.
                 go = go & (depth < target_depth - 1)
-            if stop_when_all and n_props:
-                go = go & jnp.any(disc == jnp.uint32(0xFFFFFFFF))
+            go = go & ~fw_matched(disc)
             return go
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
@@ -333,7 +370,11 @@ class TpuChecker(Checker):
             self._max_frontier,
             self._dedup_factor,
             tuple(p.expectation for p in self._properties),
-            self._options._finish_when is HasDiscoveries.ALL,
+            (
+                self._options._finish_when._kind,
+                tuple(sorted(self._options._finish_when._names)),
+                tuple(p.name for p in self._properties),
+            ),
             self._options._target_max_depth or 0,
         )
         progs = _PROGRAM_CACHE.get(key)
@@ -381,7 +422,14 @@ class TpuChecker(Checker):
             init = cm.init_packed()
             n_init = init.shape[0]
             if n_init > f:
-                raise ValueError("more init states than max_frontier")
+                # The one level still bounded by the chunk size: seeding
+                # writes the init batch into the queue in a single program.
+                raise ValueError(
+                    f"{n_init} init states exceed the chunk size "
+                    f"({f}); raise spawn_tpu(max_frontier=...) to at "
+                    "least the init-state count (interior levels are "
+                    "unbounded)"
+                )
             pad = np.zeros((f - n_init, cm.state_width), np.uint32)
             init_padded = jnp.asarray(np.concatenate([init, pad]))
             seed, run = self._programs()

@@ -268,6 +268,30 @@ def test_spawn_tpu_paxos2_matches_host_oracle(reachable_c2):
     tpu.assert_properties()
 
 
+def test_violating_variant_found_on_device():
+    """The bench's time-to-first-violation variant: an always-"never
+    decided" property that paxos falsifies; the device discovery must
+    replay as a genuine counterexample trace."""
+    from stateright_tpu.actor import Network
+    from stateright_tpu.core.has_discoveries import HasDiscoveries
+
+    model = PaxosModelCfg(
+        client_count=2,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+        never_decided=True,
+    ).into_model()
+    tpu = (
+        model.checker()
+        .finish_when(HasDiscoveries.ANY_FAILURES)
+        .spawn_tpu(capacity=1 << 16, max_frontier=1 << 10)
+        .join()
+    )
+    assert "never decided" in tpu.discoveries()
+    final = tpu.discoveries()["never decided"].last_state()
+    assert any(getattr(a, "is_decided", False) for a in final.actor_states)
+
+
 def test_step_flag_overflow_is_loud():
     """A delivery whose sends exceed the slot budget must flag, not corrupt."""
     import jax
