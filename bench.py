@@ -121,6 +121,7 @@ def main() -> None:
         .checker()
         .threads(threads)
         .finish_when(HasDiscoveries.ANY_FAILURES)
+        .timeout(600)  # fail fast instead of hanging if the host regresses
         .spawn_bfs()
         .join()
     )

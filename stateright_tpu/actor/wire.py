@@ -1,0 +1,81 @@
+"""JSON wire codec for actor messages on the real-network runtime.
+
+The reference's ``spawn`` examples serialize typed message enums with
+serde_json, so running systems can be poked with ``nc -u`` and hand-written
+JSON (examples/paxos.rs:488-512).  Python dataclass messages get the same
+treatment here: a message encodes as a JSON object tagged with its class
+name (``{"__t": "Put", "request_id": 1, "value": "X"}``), nested
+dataclasses recurse, actor ``Id``s encode as ``{"__id": n}``, tuples and
+frozensets as tagged lists.  Classes decode through an explicit registry —
+register a protocol's message types once with :func:`register_wire_types`
+before deserializing (the model CLIs' ``spawn`` subcommands register their
+protocol's types when they start).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+from .ids import Id
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register_wire_types(*classes: Type) -> None:
+    for c in classes:
+        existing = _REGISTRY.get(c.__name__)
+        if existing is not None and existing is not c:
+            raise ValueError(
+                f"wire type name collision: {c.__name__} already registered "
+                f"for {existing.__module__}.{existing.__qualname__}"
+            )
+        _REGISTRY[c.__name__] = c
+
+
+def _enc(v: Any) -> Any:
+    if isinstance(v, Id):
+        return {"__id": int(v)}
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        out = {"__t": type(v).__name__}
+        for f in dataclasses.fields(v):
+            out[f.name] = _enc(getattr(v, f.name))
+        return out
+    if isinstance(v, tuple):
+        return {"__tup": [_enc(x) for x in v]}
+    if isinstance(v, (frozenset, set)):
+        return {"__set": sorted((_enc(x) for x in v), key=json.dumps)}
+    if isinstance(v, list):
+        return [_enc(x) for x in v]
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    raise TypeError(f"cannot wire-encode {type(v).__name__}: {v!r}")
+
+
+def _dec(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__id" in v:
+            return Id(v["__id"])
+        if "__tup" in v:
+            return tuple(_dec(x) for x in v["__tup"])
+        if "__set" in v:
+            return frozenset(_dec(x) for x in v["__set"])
+        if "__t" in v:
+            cls = _REGISTRY.get(v["__t"])
+            if cls is None:
+                raise ValueError(f"unknown wire type {v['__t']!r}")
+            fields = {k: _dec(x) for k, x in v.items() if k != "__t"}
+            return cls(**fields)
+        return {k: _dec(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_dec(x) for x in v]
+    return v
+
+
+def wire_serialize(msg: Any) -> bytes:
+    return json.dumps(_enc(msg)).encode()
+
+
+def wire_deserialize(data: bytes) -> Any:
+    return _dec(json.loads(data.decode()))

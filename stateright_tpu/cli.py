@@ -1,0 +1,240 @@
+"""Per-example command-line interface, shared by every model module.
+
+The reference ships each example as a mini-binary with ``check`` /
+``check-sym`` / ``check-simulation`` / ``explore`` / ``spawn`` subcommands
+and a NETWORK positional parsed through the network name registry
+(reference: examples/paxos.rs:355-513, src/actor/network.rs:318-331) — the
+"embedded TLC" UX: run a model from a shell, point a browser at
+``explore``.  Here every model module under ``stateright_tpu.models`` is
+runnable the same way::
+
+    python -m stateright_tpu.models.paxos check 2
+    python -m stateright_tpu.models.paxos check-tpu 3
+    python -m stateright_tpu.models.twophase check-sym 5
+    python -m stateright_tpu.models.paxos explore 2 localhost:3017
+    python -m stateright_tpu.models.paxos spawn
+
+This package adds two subcommands the reference does not have: ``check-dfs``
+(the reference folds it into per-example flags) and ``check-tpu`` (the TPU
+wavefront engine, for models with a compiled form).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Optional
+
+from .actor.network import Network
+
+
+def _usage(name: str, spec: "CliSpec") -> str:
+    lines = [f"usage for {name}:"]
+    n_meta = spec.n_meta
+    net = " [NETWORK]" if spec.default_network else ""
+    lines.append(f"  check [{n_meta}]{net}")
+    lines.append(f"  check-dfs [{n_meta}]{net}")
+    if spec.symmetry:
+        lines.append(f"  check-sym [{n_meta}]{net}")
+    lines.append(f"  check-simulation [{n_meta}] [SEED]{net}")
+    if spec.tpu:
+        lines.append(f"  check-tpu [{n_meta}]{net}")
+    lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
+    if spec.spawn is not None:
+        lines.append("  spawn")
+    if spec.default_network:
+        lines.append(f"NETWORK: one of {' | '.join(Network.names())}")
+    return "\n".join(lines)
+
+
+class CliSpec:
+    def __init__(
+        self,
+        name: str,
+        build: Callable[..., Any],  # build(n) or build(n, network) -> Model
+        default_n: int,
+        n_meta: str = "N",
+        default_network: Optional[str] = None,
+        symmetry: bool = False,
+        tpu: bool = False,
+        tpu_kwargs: Optional[dict] = None,
+        spawn: Optional[Callable[[], Any]] = None,
+        default_address: str = "localhost:3017",
+        target_max_depth: Optional[int] = None,
+    ):
+        self.name = name
+        self.build = build
+        self.default_n = default_n
+        self.n_meta = n_meta
+        self.default_network = default_network
+        self.symmetry = symmetry
+        self.tpu = tpu
+        self.tpu_kwargs = tpu_kwargs or {}
+        self.spawn = spawn
+        self.default_address = default_address
+        self.target_max_depth = target_max_depth
+
+
+def _parse_n(args, default):
+    if args and args[0].isdigit():
+        return int(args.pop(0))
+    return default
+
+
+def _parse_network(args, spec):
+    """Consume the NETWORK positional (front of the remaining args).  An
+    unknown name is an error, like the reference's FromStr parse
+    (src/actor/network.rs:318-331) — never a silent default."""
+    if spec.default_network is None:
+        return None
+    if args:
+        return Network.from_name(args.pop(0))
+    return Network.from_name(spec.default_network)
+
+
+def _reject_leftovers(args, spec):
+    if args:
+        print(f"unexpected argument(s): {' '.join(args)}", file=sys.stderr)
+        print(_usage(spec.name, spec), file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _build(spec, n, network):
+    if spec.default_network is None:
+        return spec.build(n)
+    return spec.build(n, network)
+
+
+def example_main(spec: CliSpec, argv=None) -> int:
+    from .core.report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("-h", "--help", "help"):
+        print(_usage(spec.name, spec))
+        return 0
+    sub = args.pop(0)
+    threads = os.cpu_count() or 1
+
+    if sub in ("check", "check-bfs", "check-dfs", "check-sym", "check-tpu"):
+        n = _parse_n(args, spec.default_n)
+        try:
+            network = _parse_network(args, spec)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+        _reject_leftovers(args, spec)
+        model = _build(spec, n, network)
+        print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
+              + (f", network={network.kind}" if network is not None else ""))
+        builder = model.checker().threads(threads)
+        if spec.target_max_depth is not None:
+            # Some examples bound their default check (e.g. raft's
+            # target_max_depth(12), examples/raft.rs:520-535).
+            builder = builder.target_max_depth(spec.target_max_depth)
+        if sub == "check-dfs":
+            checker = builder.spawn_dfs()
+        elif sub == "check-sym":
+            if not spec.symmetry:
+                print(f"{spec.name} has no symmetry reduction", file=sys.stderr)
+                return 2
+            checker = builder.symmetry().spawn_dfs()
+        elif sub == "check-tpu":
+            if not spec.tpu:
+                print(f"{spec.name} has no compiled TPU form", file=sys.stderr)
+                return 2
+            checker = builder.spawn_tpu(**spec.tpu_kwargs)
+        else:
+            checker = builder.spawn_bfs()
+        checker.join_and_report(WriteReporter(sys.stdout))
+        return 0
+
+    if sub == "check-simulation":
+        n = _parse_n(args, spec.default_n)
+        seed = int(args.pop(0)) if args and args[0].isdigit() else 0
+        try:
+            network = _parse_network(args, spec)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+        _reject_leftovers(args, spec)
+        model = _build(spec, n, network)
+        print(f"Simulating {spec.name} with {spec.n_meta.lower()}={n}, "
+              f"seed={seed}")
+        from .core.simulation import UniformChooser
+
+        (
+            model.checker()
+            .threads(threads)
+            .target_state_count(1_000_000)
+            .spawn_simulation(seed, UniformChooser())
+            .join_and_report(WriteReporter(sys.stdout))
+        )
+        return 0
+
+    if sub == "explore":
+        # Positionals mirror the reference: [N] [ADDRESS] [NETWORK].
+        n = _parse_n(args, spec.default_n)
+        address = spec.default_address
+        if args and args[0] not in Network.names():
+            address = args.pop(0)
+        try:
+            network = _parse_network(args, spec)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+        _reject_leftovers(args, spec)
+        host, _, port = address.partition(":")
+        model = _build(spec, n, network)
+        print(
+            f"Exploring state space for {spec.name} with "
+            f"{spec.n_meta.lower()}={n} on http://{host}:{port or 3017}"
+        )
+        model.checker().threads(threads).serve((host, int(port or 3017)))
+        return 0
+
+    if sub == "spawn":
+        if spec.spawn is None:
+            print(f"{spec.name} has no spawn target", file=sys.stderr)
+            return 2
+        spec.spawn()
+        return 0
+
+    print(_usage(spec.name, spec))
+    return 2
+
+
+# --- shared spawn helper for register-harness systems ------------------------
+
+
+def spawn_register_system(make_actors, count: int, name: str) -> None:
+    """Run register-protocol servers over real localhost UDP, mirroring the
+    reference examples' ``spawn`` subcommands (examples/paxos.rs:488-512):
+    servers at 127.0.0.1:3000+i, JSON-over-datagram message encoding, until
+    interrupted.  ``make_actors(ids)`` builds the server actors given their
+    real socket-addr ``Id``s (peers must reference these, not model
+    indices)."""
+    from .actor.ids import Id
+    from .actor.spawn import spawn
+    from .actor.wire import wire_deserialize, wire_serialize
+
+    ids = [
+        Id.from_socket_addr((127, 0, 0, 1), 3000 + i) for i in range(count)
+    ]
+    server_actors = make_actors(ids)
+    print(f"A set of {name} servers is now running on:")
+    for i in ids:
+        print(f"  udp://127.0.0.1:{i.to_socket_addr()[1]}")
+    print("Messages are JSON, e.g.:")
+    print('  {"__t": "Get", "request_id": 1}')
+    print('  {"__t": "Put", "request_id": 2, "value": "X"}')
+    runtime = spawn(
+        wire_serialize,
+        wire_deserialize,
+        wire_serialize,
+        wire_deserialize,
+        list(zip(ids, server_actors)),
+    )
+    try:
+        runtime.join()
+    except KeyboardInterrupt:
+        runtime.stop()

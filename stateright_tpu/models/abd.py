@@ -222,3 +222,47 @@ class AbdModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/linearizable-register.rs."""
+    from ..cli import CliSpec, example_main, spawn_register_system
+
+    def spawn_servers():
+        from ..actor.register import (
+            Get, GetOk, Internal, Put, PutOk, RegisterServer,
+        )
+        from ..actor.wire import register_wire_types
+
+        register_wire_types(
+            Put, Get, PutOk, GetOk, Internal,
+            Query, AckQuery, Record, AckRecord,
+        )
+        spawn_register_system(
+            lambda ids: [
+                RegisterServer(AbdActor([p for p in ids if p != me]))
+                for me in ids
+            ],
+            3,
+            "ABD replicas",
+        )
+
+    return example_main(
+        CliSpec(
+            name="ABD linearizable register",
+            build=lambda n, net: AbdModelCfg(
+                client_count=n, server_count=2, network=net
+            ).into_model(),
+            default_n=2,
+            n_meta="CLIENT_COUNT",
+            default_network="unordered_nonduplicating",
+            spawn=spawn_servers,
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

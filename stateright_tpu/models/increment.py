@@ -123,3 +123,34 @@ class IncrementLock(Model):
                 lambda _m, st: sum(1 for (_t, pc) in st.s if 1 <= pc < 4) <= 1,
             ),
         ]
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/increment.rs and examples/increment_lock.rs;
+    pass ``lock`` as the first argument for the locked variant."""
+    import sys as _sys
+
+    from ..cli import CliSpec, example_main
+
+    args = list(_sys.argv[1:] if argv is None else argv)
+    lock = bool(args) and args[0] == "lock"
+    if lock:
+        args = args[1:]
+    return example_main(
+        CliSpec(
+            name="increment-lock" if lock else "increment",
+            build=lambda n: (IncrementLock if lock else Increment)(
+                thread_count=n
+            ),
+            default_n=2,
+            n_meta="THREAD_COUNT",
+            symmetry=True,
+        ),
+        args,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

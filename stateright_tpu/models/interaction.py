@@ -117,3 +117,26 @@ def build_model(threshold: int = 3, network=None) -> ActorModel:
         )
         .property(Expectation.EVENTUALLY, "success", success)
     )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/interaction.rs (eventually property checked
+    to the example's depth bound, examples/interaction.rs:37-47)."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="interaction",
+            build=lambda n: build_model(threshold=n),
+            default_n=3,
+            n_meta="THRESHOLD",
+            target_max_depth=30,
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -116,3 +116,24 @@ def build_model(num_actors: int = 2) -> ActorModel:
     ).property(
         Expectation.ALWAYS, "eventually consistent", eventually_consistent
     )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/lww-register.rs."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="LWW-register CRDT",
+            build=lambda n: build_model(num_actors=n),
+            default_n=2,
+            n_meta="ACTOR_COUNT",
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

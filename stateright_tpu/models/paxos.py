@@ -238,3 +238,51 @@ class PaxosModelCfg:
 
         model.compiled = _compiled
         return model
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/paxos.rs:355-513."""
+    from ..cli import CliSpec, example_main, spawn_register_system
+
+    def spawn_servers():
+        from ..actor.register import (
+            Get, GetOk, Internal, Put, PutOk, RegisterServer,
+        )
+        from ..actor.wire import register_wire_types
+
+        register_wire_types(
+            Put, Get, PutOk, GetOk, Internal,
+            Prepare, Prepared, Accept, Accepted, Decided,
+        )
+        spawn_register_system(
+            lambda ids: [
+                RegisterServer(
+                    PaxosActor([p for p in ids if p != me])
+                )
+                for me in ids
+            ],
+            3,
+            "Single Decree Paxos",
+        )
+
+    return example_main(
+        CliSpec(
+            name="Single Decree Paxos",
+            build=lambda n, net: PaxosModelCfg(
+                client_count=n, server_count=3, network=net
+            ).into_model(),
+            default_n=2,
+            n_meta="CLIENT_COUNT",
+            default_network="unordered_nonduplicating",
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 23, max_frontier=1 << 13),
+            spawn=spawn_servers,
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

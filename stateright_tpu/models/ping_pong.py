@@ -102,3 +102,28 @@ class PingPongCfg:
                 lambda _m, s: s.history[1] <= s.history[0] + 1,
             )
         )
+
+
+def main(argv=None) -> int:
+    """CLI for the ping_pong fixture (src/actor/actor_test_util.rs)."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="ping_pong",
+            build=lambda n: PingPongCfg(
+                maintains_history=False, max_nat=n
+            ).into_model(),
+            default_n=5,
+            n_meta="MAX_NAT",
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 10),
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

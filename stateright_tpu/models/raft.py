@@ -421,3 +421,28 @@ class RaftModelCfg:
                 Expectation.ALWAYS, "State Machine Safety", state_machine_safety
             )
         )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/raft.rs (default check bounds depth at 12)."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="raft",
+            build=lambda n, net: RaftModelCfg(
+                server_count=n, network=net
+            ).into_model(),
+            default_n=3,
+            n_meta="SERVER_COUNT",
+            default_network="unordered_nonduplicating",
+            target_max_depth=12,
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

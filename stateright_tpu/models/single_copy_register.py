@@ -74,3 +74,41 @@ class SingleCopyModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/single-copy-register.rs."""
+    from ..cli import CliSpec, example_main, spawn_register_system
+
+    def spawn_servers():
+        from ..actor.register import (
+            Get, GetOk, Put, PutOk, RegisterServer,
+        )
+        from ..actor.wire import register_wire_types
+
+        register_wire_types(Put, Get, PutOk, GetOk)
+        spawn_register_system(
+            lambda ids: [RegisterServer(SingleCopyActor())],
+            1,
+            "single-copy register",
+        )
+
+    return example_main(
+        CliSpec(
+            name="single-copy register",
+            build=lambda n, net: SingleCopyModelCfg(
+                client_count=n, server_count=1, network=net
+            ).into_model(),
+            default_n=2,
+            n_meta="CLIENT_COUNT",
+            default_network="unordered_nonduplicating",
+            spawn=spawn_servers,
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -68,3 +68,24 @@ def build_model(server_count: int = 3, network=None) -> ActorModel:
     return model.init_network_(
         network if network is not None else Network.new_unordered_nonduplicating()
     ).property(Expectation.ALWAYS, "true", lambda _m, _s: True)
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/timers.rs."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="timers",
+            build=lambda n: build_model(server_count=n),
+            default_n=3,
+            n_meta="SERVER_COUNT",
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -143,3 +143,27 @@ class TwoPhaseSys(Model):
                 ),
             ),
         ]
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/2pc.rs:172-239."""
+    from ..cli import CliSpec, example_main
+
+    return example_main(
+        CliSpec(
+            name="two-phase commit",
+            build=lambda n: TwoPhaseSys(rm_count=n),
+            default_n=3,
+            n_meta="RM_COUNT",
+            symmetry=True,
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 13),
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

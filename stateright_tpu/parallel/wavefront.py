@@ -86,12 +86,26 @@ class TpuChecker(Checker):
         self._max_frontier = max_frontier
         self._dedup_factor = dedup_factor
         if waves_per_call is None:
-            # Fidelity knobs that need host checks between chunks
-            # (finish_when is mirrored inside the device loop, so it does
-            # not force per-chunk syncs).
+            # Fidelity knobs that need host checks between chunks.
+            # finish_when is mirrored inside the device loop, so it does
+            # not force per-chunk syncs — except for trivially-true
+            # policies (e.g. ALL with zero properties), which only the
+            # host-side matches() stops; those keep the one-chunk-per-call
+            # granularity so the run still ends after the first chunk.
+            props = options.model.properties()
+            fail_props = [
+                p for p in props if p.expectation.discovery_is_failure
+            ]
+            fw = options._finish_when
+            fw_trivially_true = (
+                (fw._kind == "all" and not props)
+                or (fw._kind == "all_failures" and not fail_props)
+                or (fw._kind == "all_of" and not fw._names)
+            )
             fine_grained = (
                 options._timeout is not None
                 or options._target_state_count is not None
+                or fw_trivially_true
             )
             waves_per_call = 1 if fine_grained else 256
         self._waves_per_call = waves_per_call

@@ -1,0 +1,165 @@
+"""End-to-end CLI tests: every model module is a runnable mini-binary with
+check/check-sym/check-simulation/check-tpu/explore/spawn subcommands,
+mirroring the reference examples' pico_args CLIs (examples/paxos.rs:355-513).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(module, *args, timeout=180):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", f"stateright_tpu.models.{module}", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_check_subcommand_single_copy_register():
+    r = run_cli("single_copy_register", "check", "2")
+    assert r.returncode == 0, r.stderr
+    assert "unique=93" in r.stdout  # examples/single-copy-register.rs:111
+    assert 'Discovered "value chosen" example' in r.stdout
+
+
+def test_check_sym_subcommand_twophase():
+    r = run_cli("twophase", "check-sym", "5")
+    assert r.returncode == 0, r.stderr
+    assert "unique=665" in r.stdout  # examples/2pc.rs:163-168
+
+
+def test_network_positional():
+    r = run_cli("single_copy_register", "check", "2", "ordered")
+    assert r.returncode == 0, r.stderr
+    assert "network=ordered" in r.stdout
+    assert "Done." in r.stdout
+
+
+def test_unknown_network_name_errors():
+    r = run_cli("single_copy_register", "check", "2", "ordred")
+    assert r.returncode == 2
+    assert "unable to parse network name" in r.stderr
+
+
+def test_unexpected_argument_errors():
+    r = run_cli("twophase", "check", "3", "extra")
+    assert r.returncode == 2
+    assert "unexpected argument" in r.stderr
+
+
+def test_check_simulation_subcommand():
+    r = run_cli("increment", "check-simulation", "2", "7")
+    assert r.returncode == 0, r.stderr
+    assert "Done." in r.stdout
+
+
+def test_usage_on_no_args():
+    r = run_cli("paxos")
+    assert r.returncode == 0
+    assert "check [CLIENT_COUNT] [NETWORK]" in r.stdout
+    assert "spawn" in r.stdout
+    for name in ("ordered", "unordered_duplicating", "unordered_nonduplicating"):
+        assert name in r.stdout
+
+
+def test_unknown_subcommand_fails():
+    r = run_cli("paxos", "frobnicate")
+    assert r.returncode == 2
+
+
+def test_explore_subcommand_serves_http():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "stateright_tpu.models.single_copy_register",
+            "explore",
+            "2",
+            "localhost:3919",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        cwd=REPO,
+    )
+    try:
+        status = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    "http://localhost:3919/.status", timeout=2
+                ) as resp:
+                    status = json.loads(resp.read())
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert status is not None, "explorer never came up"
+        assert "properties" in status or "model" in status
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_spawn_subcommand_real_udp_paxos():
+    """`spawn` runs the checked actors on real UDP: a Put reaches quorum
+    and returns PutOk; a Get on a *different* replica returns the decided
+    value (the reference's spawn UX, examples/paxos.rs:488-512)."""
+    import socket
+
+    sys.path.insert(0, REPO)
+    from stateright_tpu.actor.register import Get, GetOk, Internal, Put, PutOk
+    from stateright_tpu.actor.wire import register_wire_types, wire_deserialize, wire_serialize
+    from stateright_tpu.models.paxos import (
+        Accept, Accepted, Decided, Prepare, Prepared,
+    )
+
+    register_wire_types(
+        Put, Get, PutOk, GetOk, Internal, Prepare, Prepared, Accept,
+        Accepted, Decided,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "stateright_tpu.models.paxos", "spawn"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        cwd=REPO,
+    )
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.bind(("127.0.0.1", 3103))
+        s.settimeout(20)
+        time.sleep(2.0)
+        s.sendto(
+            wire_serialize(Put(request_id=1, value="X")), ("127.0.0.1", 3000)
+        )
+        msg, _ = s.recvfrom(65535)
+        assert wire_deserialize(msg) == PutOk(request_id=1)
+        s.sendto(wire_serialize(Get(request_id=2)), ("127.0.0.1", 3001))
+        msg, _ = s.recvfrom(65535)
+        assert wire_deserialize(msg) == GetOk(request_id=2, value="X")
+    finally:
+        s.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_check_tpu_subcommand():
+    r = run_cli("twophase", "check-tpu", "3", timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "unique=288" in r.stdout
