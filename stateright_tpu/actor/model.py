@@ -362,6 +362,148 @@ class ActorModel(Model):
 
     # --- formatting (reference: src/actor/model.rs:459-597) -----------------
 
+    def as_svg(self, path) -> Optional[str]:
+        """Message-sequence diagram for a path: one vertical timeline per
+        actor, an arrow per delivery (from its send time on the sender's
+        line to its delivery time on the receiver's), circles for
+        timeout/crash/recover/random events, labels drawn last.
+
+        Reference: src/actor/model.rs:600-821 — same layout constants
+        (``spacing = max(100, longest name * 10)``, 30px per time step),
+        same CSS class names so the Explorer styles carry over; message
+        text is additionally XML-escaped here.
+        """
+        from xml.sax.saxutils import escape
+
+        steps = path.into_vec() if hasattr(path, "into_vec") else list(path)
+        if not steps:
+            return None
+        actor_names = []
+        for i, a in enumerate(self.actors):
+            name = a.name() or ""
+            actor_names.append(f"{i} {name}" if name else str(i))
+        max_name_len = max((len(n) for n in actor_names), default=0) * 10
+        spacing = max(100, max_name_len)
+
+        def plot(x: int, y: int) -> Tuple[int, int]:
+            return (x * spacing, y * 30)
+
+        actor_count = len(steps[-1][0].actor_states)
+        svg_w, svg_h = plot(actor_count, len(steps))
+        svg_w += 300  # KLUDGE kept from the reference: room for labels
+        out = [
+            f"<svg version='1.1' baseProfile='full' "
+            f"width='{svg_w}' height='{svg_h}' "
+            f"viewbox='-20 -20 {svg_w + 20} {svg_h + 20}' "
+            f"xmlns='http://www.w3.org/2000/svg'>",
+            "<defs>"
+            "<marker class='svg-event-shape' id='arrow' markerWidth='12' "
+            "markerHeight='10' refX='12' refY='5' orient='auto'>"
+            "<polygon points='0 0, 12 5, 0 10' /></marker></defs>",
+        ]
+        for i, name in enumerate(actor_names):
+            (x1, y1) = plot(i, 0)
+            (x2, y2) = plot(i, len(steps))
+            out.append(
+                f"<line x1='{x1}' y1='{y1}' x2='{x2}' y2='{y2}' "
+                "class='svg-actor-timeline' />"
+            )
+            out.append(
+                f"<text x='{x1}' y='{y1}' class='svg-actor-label'>"
+                f"{escape(name)}</text>"
+            )
+
+        def handler_sends(index: int, run) -> List[Tuple[Id, Any]]:
+            o = Out()
+            if index < len(self.actors):
+                run(self.actors[index], o)
+            return [
+                (c.dst, c.msg) for c in o.commands if isinstance(c, SendCmd)
+            ]
+
+        # Arrows for deliveries, circles for other events; sends tracked so
+        # arrows start at the send time (0 for init-time sends).
+        send_time: dict = {}
+        for time, (state, action) in enumerate(steps):
+            time += 1  # the action leads out of this state
+            if isinstance(action, Deliver):
+                src_time = send_time.get(
+                    (action.src, action.dst, action.msg), 0
+                )
+                (x1, y1) = plot(int(action.src), src_time)
+                (x2, y2) = plot(int(action.dst), time)
+                out.append(
+                    f"<line x1='{x1}' x2='{x2}' y1='{y1}' y2='{y2}' "
+                    "marker-end='url(#arrow)' class='svg-event-line' />"
+                )
+                index = int(action.dst)
+                if index < len(state.actor_states):
+                    for dst, msg in handler_sends(
+                        index,
+                        lambda actor, o: actor.on_msg(
+                            action.dst,
+                            state.actor_states[index],
+                            action.src,
+                            action.msg,
+                            o,
+                        ),
+                    ):
+                        send_time[(action.dst, dst, msg)] = time
+            elif isinstance(action, (Timeout, Crash, Recover, SelectRandom)):
+                actor_id = getattr(action, "id", getattr(action, "actor", None))
+                (x, y) = plot(int(actor_id), time)
+                out.append(
+                    f"<circle cx='{x}' cy='{y}' r='10' "
+                    "class='svg-event-shape' />"
+                )
+                index = int(actor_id)
+                if isinstance(action, Timeout) and index < len(
+                    state.actor_states
+                ):
+                    for dst, msg in handler_sends(
+                        index,
+                        lambda actor, o: actor.on_timeout(
+                            actor_id, state.actor_states[index], action.timer, o
+                        ),
+                    ):
+                        send_time[(actor_id, dst, msg)] = time
+                elif isinstance(action, SelectRandom) and index < len(
+                    state.actor_states
+                ):
+                    for dst, msg in handler_sends(
+                        index,
+                        lambda actor, o: actor.on_random(
+                            actor_id, state.actor_states[index], action.random, o
+                        ),
+                    ):
+                        send_time[(actor_id, dst, msg)] = time
+
+        # Labels last so they draw over the shapes.
+        for time, (_state, action) in enumerate(steps):
+            time += 1
+            if isinstance(action, Deliver):
+                (x, y) = plot(int(action.dst), time)
+                label = escape(repr(action.msg))
+            elif isinstance(action, Timeout):
+                (x, y) = plot(int(action.id), time)
+                label = escape(f"Timeout({action.timer!r})")
+            elif isinstance(action, Crash):
+                (x, y) = plot(int(action.id), time)
+                label = "Crash"
+            elif isinstance(action, Recover):
+                (x, y) = plot(int(action.id), time)
+                label = "Recover"
+            elif isinstance(action, SelectRandom):
+                (x, y) = plot(int(action.actor), time)
+                label = escape(f"Random({action.random!r})")
+            else:
+                continue
+            out.append(
+                f"<text x='{x}' y='{y}' class='svg-event-label'>{label}</text>"
+            )
+        out.append("</svg>")
+        return "".join(out)
+
     def format_action(self, action) -> str:
         if isinstance(action, Deliver):
             return f"{action.src!r} → {action.msg!r} → {action.dst!r}"

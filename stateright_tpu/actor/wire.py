@@ -54,19 +54,31 @@ def _enc(v: Any) -> Any:
 
 
 def _dec(v: Any) -> Any:
+    # Decode failures must surface as ValueError: the runtime's receive
+    # loop treats that as "malformed datagram, drop it" — anything else
+    # would kill the replica thread on a hand-typed probe message.
     if isinstance(v, dict):
         if "__id" in v:
             return Id(v["__id"])
         if "__tup" in v:
+            if not isinstance(v["__tup"], list):
+                raise ValueError(f"malformed __tup payload: {v!r}")
             return tuple(_dec(x) for x in v["__tup"])
         if "__set" in v:
+            if not isinstance(v["__set"], list):
+                raise ValueError(f"malformed __set payload: {v!r}")
             return frozenset(_dec(x) for x in v["__set"])
         if "__t" in v:
             cls = _REGISTRY.get(v["__t"])
             if cls is None:
                 raise ValueError(f"unknown wire type {v['__t']!r}")
             fields = {k: _dec(x) for k, x in v.items() if k != "__t"}
-            return cls(**fields)
+            try:
+                return cls(**fields)
+            except TypeError as e:
+                raise ValueError(
+                    f"wire message fields do not match {v['__t']}: {e}"
+                ) from e
         return {k: _dec(x) for k, x in v.items()}
     if isinstance(v, list):
         return [_dec(x) for x in v]

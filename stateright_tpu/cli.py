@@ -184,12 +184,17 @@ def example_main(spec: CliSpec, argv=None) -> int:
             return 2
         _reject_leftovers(args, spec)
         host, _, port = address.partition(":")
+        try:
+            port = int(port or 3017)
+        except ValueError:
+            print(f"invalid ADDRESS port: {address!r}", file=sys.stderr)
+            return 2
         model = _build(spec, n, network)
         print(
             f"Exploring state space for {spec.name} with "
             f"{spec.n_meta.lower()}={n} on http://{host}:{port or 3017}"
         )
-        model.checker().threads(threads).serve((host, int(port or 3017)))
+        model.checker().threads(threads).serve((host, port))
         return 0
 
     if sub == "spawn":

@@ -284,3 +284,69 @@ def test_crash_and_recover():
     assert checker.is_done()
     # Crashing wipes volatile state; recovery restores from storage.
     assert checker.unique_state_count() > 4
+
+
+def test_as_svg_message_sequence_diagram():
+    """ActorModel.as_svg renders a message-sequence chart for a path
+    (reference src/actor/model.rs:600-821; structure snapshot mirrors the
+    reference's Explorer SVG test, src/checker/explorer.rs:403-522)."""
+    from stateright_tpu.core.path import Path
+    from stateright_tpu.models.ping_pong import Ping, PingPongCfg, Pong
+    from stateright_tpu.actor.model import Deliver
+
+    model = PingPongCfg(maintains_history=False, max_nat=2).into_model()
+    init = model.init_states()[0]
+    path = Path.from_actions(
+        model,
+        init,
+        [
+            Deliver(Id(0), Id(1), Ping(0)),
+            Deliver(Id(1), Id(0), Pong(0)),
+        ],
+    )
+    svg = model.as_svg(path)
+    assert svg is not None and svg.startswith("<svg") and svg.endswith("</svg>")
+    # Two actor timelines with labels.
+    assert svg.count("svg-actor-timeline") == 2
+    assert "0 Pinger" in svg or ">0<" in svg or "svg-actor-label" in svg
+    # Two delivery arrows: Ping(0) was sent at init (time 0) from actor 0,
+    # delivered at time 1 on actor 1's line; Pong(0) sent at time 1,
+    # delivered at time 2.
+    assert svg.count("svg-event-line") == 2
+    assert "<line x1='0' x2='100' y1='0' y2='30'" in svg
+    assert "<line x1='100' x2='0' y1='30' y2='60'" in svg
+    # Labels drawn last, over the shapes.
+    assert "Ping(value=0)" in svg and "Pong(value=0)" in svg
+    assert svg.index("svg-event-label") > svg.index("svg-event-line")
+
+
+def test_as_svg_marks_timeouts_and_crashes():
+    from stateright_tpu.core.path import Path
+    from stateright_tpu.actor.model import Crash, Timeout
+
+    class Ticker(Actor):
+        def name(self):
+            return "Ticker"
+
+        def on_start(self, id, storage, o: Out):
+            o.set_timer("tick")
+            return 0
+
+        def on_timeout(self, id, state, timer, o: Out):
+            o.set_timer("tick")
+            return state + 1
+
+    model = (
+        ActorModel()
+        .actor(Ticker())
+        .max_crashes_(1)
+        .within_boundary_(lambda _c, s: all(c <= 3 for c in s.actor_states))
+    )
+    init = model.init_states()[0]
+    path = Path.from_actions(
+        model, init, [Timeout(Id(0), "tick"), Crash(Id(0))]
+    )
+    svg = model.as_svg(path)
+    assert svg is not None
+    assert svg.count("svg-event-shape'") >= 2  # circle markers
+    assert "Timeout(" in svg and ">Crash<" in svg

@@ -163,3 +163,26 @@ def test_check_tpu_subcommand():
     r = run_cli("twophase", "check-tpu", "3", timeout=300)
     assert r.returncode == 0, r.stderr
     assert "unique=288" in r.stdout
+
+
+def test_wire_codec_malformed_messages_raise_valueerror():
+    """A hand-typed probe datagram with wrong fields must surface as
+    ValueError (which the UDP runtime drops) — never a TypeError that
+    would kill a replica thread."""
+    sys.path.insert(0, REPO)
+    from stateright_tpu.actor.register import Put
+    from stateright_tpu.actor.wire import register_wire_types, wire_deserialize
+
+    register_wire_types(Put)
+    with pytest.raises(ValueError):
+        wire_deserialize(b'{"__t": "Put", "request_id": 1}')  # missing value
+    with pytest.raises(ValueError):
+        wire_deserialize(b'{"__t": "NoSuchType"}')
+    with pytest.raises(ValueError):
+        wire_deserialize(b'{"__tup": 5}')
+
+
+def test_explore_invalid_port_is_clean_error():
+    r = run_cli("paxos", "explore", "2", "localhost:abc")
+    assert r.returncode == 2
+    assert "invalid ADDRESS port" in r.stderr
