@@ -59,6 +59,8 @@ def _dec(v: Any) -> Any:
     # would kill the replica thread on a hand-typed probe message.
     if isinstance(v, dict):
         if "__id" in v:
+            if not isinstance(v["__id"], int) or isinstance(v["__id"], bool):
+                raise ValueError(f"malformed __id payload: {v!r}")
             return Id(v["__id"])
         if "__tup" in v:
             if not isinstance(v["__tup"], list):
@@ -67,7 +69,10 @@ def _dec(v: Any) -> Any:
         if "__set" in v:
             if not isinstance(v["__set"], list):
                 raise ValueError(f"malformed __set payload: {v!r}")
-            return frozenset(_dec(x) for x in v["__set"])
+            try:
+                return frozenset(_dec(x) for x in v["__set"])
+            except TypeError as e:  # unhashable element
+                raise ValueError(f"malformed __set payload: {v!r}") from e
         if "__t" in v:
             cls = _REGISTRY.get(v["__t"])
             if cls is None:

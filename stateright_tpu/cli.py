@@ -192,7 +192,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
         model = _build(spec, n, network)
         print(
             f"Exploring state space for {spec.name} with "
-            f"{spec.n_meta.lower()}={n} on http://{host}:{port or 3017}"
+            f"{spec.n_meta.lower()}={n} on http://{host}:{port}"
         )
         model.checker().threads(threads).serve((host, port))
         return 0

@@ -100,14 +100,33 @@ class ShardedTpuChecker(Checker):
 
     # --- device program ------------------------------------------------------
 
-    def _build_wave(self):
+    def _build_run(self):
+        """Fused multi-chunk program, the sharded analog of the single-chip
+        engine: each shard drains a FIFO slot queue of its own states with
+        *global* BFS-level boundaries (depth advances only when a psum says
+        every shard finished the level), exchanging successor candidates
+        over ICI each chunk.  The whole loop runs inside one shard_map'd
+        ``while_loop`` — the host syncs once per ``waves`` chunks instead
+        of once per chunk per wave (on tunneled or DCN-attached hosts a
+        single scalar sync costs ~100ms; the old per-chunk dispatch spent
+        most of wall-clock there).
+
+        All loop-control decisions (work-remaining, flags, finish_when,
+        depth gating) derive from psum reductions, so every shard takes the
+        same branch — a requirement for collectives inside the loop body.
+
+        Exchange-buffer memory: the all_to_all operates on
+        ``[n, chunk*max_actions, W+3]`` uint32 per shard — e.g. n=8,
+        chunk=2^11, A=32, W=42: ~95 MB per shard.  Size ``chunk_size``
+        accordingly.
+        """
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from ..ops.device_fp import device_fp64
         from .hashset import HashSet, insert_batch
-        from .wave_common import compact, wave_eval
+        from .wave_common import make_finish_when_device, wave_eval
 
         cm = self._compiled
         w = cm.state_width
@@ -115,32 +134,69 @@ class ShardedTpuChecker(Checker):
         f = self._chunk
         n = self._n
         cap_s = self._cap_s
+        qcap = cap_s
         slot_bits = self._slot_bits
         props = self._properties
-        n_props = len(props)
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
         b = f * a  # per-shard candidate lanes; also the exchange bucket size
+        target_depth = self._options._target_max_depth or 0
+        fw_found_matched = make_finish_when_device(
+            self._options._finish_when, props
+        )
+        u = jnp.uint32
 
-        def wave_shard(key_hi, key_lo, store, parent, ebits, slots, count):
-            """One wave on one shard.  Shapes: per-shard views."""
-            me = jax.lax.axis_index("shards").astype(jnp.uint32)
-            lane = jnp.arange(f, dtype=jnp.uint32)
-            active = lane < count[0]
-            safe_slots = jnp.where(active, slots, 0)
+        def go_from(level_start, level_end, depth, disc, waves_left, flags):
+            work = jax.lax.psum(level_end - level_start, "shards") > u(0)
+            found = (
+                jax.lax.psum((disc != u(NO_GID)).astype(u), "shards") > u(0)
+            )
+            go = work & (waves_left > 0) & (flags == u(0))
+            go = go & ~fw_found_matched(found)
+            if target_depth:
+                go = go & (depth < u(target_depth - 1))
+            return go
+
+        def body(carry):
+            (
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                queue,
+                level_start,
+                level_end,
+                tail,
+                sc_lo,
+                sc_hi,
+                unique_g,
+                unique_l,
+                depth,
+                disc,
+                waves_left,
+                flags,
+                _go,
+            ) = carry
+            me = jax.lax.axis_index("shards").astype(u)
+
+            count = jnp.minimum(level_end - level_start, u(f))
+            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
+            lane = jnp.arange(f, dtype=u)
+            active = lane < count
+            safe_slots = jnp.where(active, chunk, 0)
             states = store[safe_slots]
 
             # Shared expansion-time evaluation; ids are global this time.
-            my_gids = (me << jnp.uint32(slot_bits)) | safe_slots
-            disc0 = jnp.full((n_props,), NO_GID, jnp.uint32) | (me & 0)
-            cand, eb, nexts, valid, gen_local, step_flag = wave_eval(
+            my_gids = (me << u(slot_bits)) | safe_slots
+            disc, eb, nexts, valid, gen_local, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, my_gids,
-                ebits[safe_slots], disc0,
+                ebits[safe_slots], disc,
             )
             generated = jax.lax.psum(gen_local, "shards")
-            step_flag_global = (
-                jax.lax.psum(step_flag.astype(jnp.uint32), "shards") > 0
-            )
+            new_lo = sc_lo + generated
+            sc_hi = sc_hi + (new_lo < sc_lo).astype(u)
+            sc_lo = new_lo
 
             # Bucket candidates by owner shard and exchange over ICI.
             flat = nexts.reshape(b, w)
@@ -148,22 +204,22 @@ class ShardedTpuChecker(Checker):
             par_gid = jnp.repeat(my_gids, a)
             child_eb = jnp.repeat(eb, a)
             hi, lo = device_fp64(flat)
-            owner = _owner_mix(hi, lo) % jnp.uint32(n)
-            key = jnp.where(flat_valid, owner, jnp.uint32(n))
+            owner = _owner_mix(hi, lo) % u(n)
+            key = jnp.where(flat_valid, owner, u(n))
             order = jnp.argsort(key, stable=True)
             key_s = key[order]
-            counts = jnp.zeros((n + 1,), jnp.uint32).at[key].add(1)
+            counts = jnp.zeros((n + 1,), u).at[key].add(1)
             offsets = jnp.concatenate(
-                [jnp.zeros((1,), jnp.uint32), jnp.cumsum(counts)[:-1]]
+                [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
             )
-            pos = jnp.arange(b, dtype=jnp.uint32) - offsets[key_s]
-            dst = jnp.where(key_s < n, key_s, jnp.uint32(n))  # drop invalid
+            pos = jnp.arange(b, dtype=u) - offsets[key_s]
+            dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
 
-            send_words = jnp.zeros((n, b, w), jnp.uint32)
+            send_words = jnp.zeros((n, b, w), u)
             send_words = send_words.at[dst, pos].set(flat[order], mode="drop")
-            send_gid = jnp.full((n, b), NO_GID, jnp.uint32)
+            send_gid = jnp.full((n, b), NO_GID, u)
             send_gid = send_gid.at[dst, pos].set(par_gid[order], mode="drop")
-            send_eb = jnp.zeros((n, b), jnp.uint32)
+            send_eb = jnp.zeros((n, b), u)
             send_eb = send_eb.at[dst, pos].set(child_eb[order], mode="drop")
             send_valid = jnp.zeros((n, b), jnp.bool_)
             send_valid = send_valid.at[dst, pos].set(
@@ -193,51 +249,128 @@ class ShardedTpuChecker(Checker):
                 HashSet(key_hi, key_lo), rhi, rlo, rv,
                 dedup_factor=dedup_factor,
             )
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
+            sslot = jnp.where(is_new, slot, u(cap_s))
             store = store.at[sslot].set(rw, mode="drop")
             parent = parent.at[sslot].set(rg, mode="drop")
             ebits = ebits.at[sslot].set(reb, mode="drop")
+            n_new = jnp.sum(is_new, dtype=u)
+            unique_l = unique_l + n_new
+            unique_g = unique_g + jax.lax.psum(n_new, "shards")
 
-            new_slots = compact(is_new, slot, f * a)
-            n_new_local = jnp.sum(is_new, dtype=jnp.uint32)
-            n_new_global = jax.lax.psum(n_new_local, "shards")
-            probe_global = (
-                jax.lax.psum(probe_ok.astype(jnp.uint32), "shards") == n
-            )
-            dd_global = (
-                jax.lax.psum(dd_overflow.astype(jnp.uint32), "shards") > 0
-            )
+            # Append new slots at this shard's queue tail.
+            qpos = tail + jnp.cumsum(is_new.astype(u)) - 1
+            qidx = jnp.where(is_new, qpos, u(qcap + f))
+            queue = queue.at[qidx].set(slot, mode="drop")
+            tail = tail + n_new
+
+            # Advance within the level; the boundary is global.
+            level_start = level_start + count
+            rem_g = jax.lax.psum(level_end - level_start, "shards")
+            done_level = rem_g == u(0)
+            depth = depth + done_level.astype(u)
+            level_end = jnp.where(done_level, tail, level_end)
+
+            def any_shard(x):
+                return jax.lax.psum(x.astype(u), "shards") > u(0)
+
+            flags = flags | jnp.where(any_shard(~probe_ok), 1, 0).astype(u)
+            flags = flags | jnp.where(
+                any_shard(unique_l * u(2) > u(cap_s)), 1, 0
+            ).astype(u)
+            flags = flags | jnp.where(any_shard(tail > u(qcap)), 2, 0).astype(u)
+            flags = flags | jnp.where(any_shard(dd_overflow), 4, 0).astype(u)
+            flags = flags | jnp.where(any_shard(step_flag), 8, 0).astype(u)
+
+            waves_left = waves_left - 1
+            go = go_from(level_start, level_end, depth, disc, waves_left, flags)
             return (
                 table.key_hi,
                 table.key_lo,
                 store,
                 parent,
                 ebits,
-                new_slots,
-                n_new_local[None],
-                n_new_global[None],
-                generated[None],
-                cand,
-                probe_global[None],
-                dd_global[None],
-                step_flag_global[None],
+                queue,
+                level_start,
+                level_end,
+                tail,
+                sc_lo,
+                sc_hi,
+                unique_g,
+                unique_l,
+                depth,
+                disc,
+                waves_left,
+                flags,
+                go,
+            )
+
+        def cond(carry):
+            return carry[-1]
+
+        def run_shard(
+            key_hi, key_lo, store, parent, ebits, queue, level_start,
+            level_end, tail, sc_lo, sc_hi, unique_g, unique_l, depth, disc,
+            waves,
+        ):
+            carry = (
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                queue,
+                level_start[0],
+                level_end[0],
+                tail[0],
+                sc_lo[0],
+                sc_hi[0],
+                unique_g[0],
+                unique_l[0],
+                depth[0],
+                disc,
+                waves[0].astype(jnp.int32),
+                u(0),
+                jnp.zeros((), jnp.bool_),
+            )
+            carry = carry[:-1] + (
+                go_from(
+                    carry[6], carry[7], carry[13], carry[14], carry[15],
+                    carry[16],
+                ),
+            )
+            out = jax.lax.while_loop(cond, body, carry)
+            return (
+                out[0],
+                out[1],
+                out[2],
+                out[3],
+                out[4],
+                out[5],
+                out[6][None],
+                out[7][None],
+                out[8][None],
+                out[9][None],
+                out[10][None],
+                out[11][None],
+                out[12][None],
+                out[13][None],
+                out[14],
+                out[15][None],
+                out[16][None],
             )
 
         shard = P("shards")
-        specs_table = (shard, shard, shard, shard, shard)
-        wave = jax.jit(
+        specs = (shard,) * 16
+        run = jax.jit(
             jax.shard_map(
-                wave_shard,
+                run_shard,
                 mesh=self._mesh,
-                in_specs=specs_table + (shard, shard),
-                out_specs=(
-                    specs_table
-                    + (shard, shard, shard, shard, shard, shard, shard, shard)
-                ),
+                in_specs=specs,
+                out_specs=(shard,) * 17,
             ),
-            donate_argnums=(0, 1, 2, 3, 4),
+            donate_argnums=(0, 1, 2, 3, 4, 5),
         )
-        return wave
+        return run
 
     # --- host loop -----------------------------------------------------------
 
@@ -305,6 +438,8 @@ class ShardedTpuChecker(Checker):
 
         from .hashset import HashSet
 
+        qcap = cap_s
+
         def seed_shard(key_hi, key_lo, store, ebits, states, valid):
             from .wave_common import compact
 
@@ -317,15 +452,19 @@ class ShardedTpuChecker(Checker):
             sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
             store = store.at[sslot].set(sts, mode="drop")
             ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            compacted = compact(is_new, slot, is_new.shape[0])
+            n_new = jnp.sum(is_new, dtype=jnp.uint32)
+            queue = jnp.zeros((qcap + f,), jnp.uint32)
+            queue = queue.at[: is_new.shape[0]].set(
+                compact(is_new, slot, is_new.shape[0])
+            )
             ok = probe_ok & ~dd_overflow
             return (
                 table.key_hi,
                 table.key_lo,
                 store,
                 ebits,
-                compacted,
-                jnp.sum(is_new, dtype=jnp.uint32)[None],
+                queue,
+                n_new[None],
                 ok[None],
             )
 
@@ -339,7 +478,7 @@ class ShardedTpuChecker(Checker):
             ),
             donate_argnums=(0, 1, 2, 3),
         )
-        key_hi, key_lo, store, ebits, seed_slots, seed_counts, seed_ok = seed(
+        key_hi, key_lo, store, ebits, queue, seed_counts, seed_ok = seed(
             key_hi,
             key_lo,
             store,
@@ -352,129 +491,129 @@ class ShardedTpuChecker(Checker):
                 "init-state seeding overflowed the insert buffers; raise "
                 "capacity or lower dedup_factor"
             )
-        seed_slots = np.asarray(seed_slots).reshape(n, seed_w)
-        seed_counts = np.asarray(seed_counts).reshape(n)
-        frontiers = [seed_slots[d, : seed_counts[d]] for d in range(n)]
+        seed_counts_h = np.asarray(seed_counts).reshape(n).astype(np.uint32)
 
         self._state_count = n_init
-        self._unique_count = int(seed_counts.sum())
+        self._unique_count = int(seed_counts_h.sum())
 
-        wave = self._build_wave()
-        depth = 0
+        from .wave_common import default_waves_per_call
 
-        while any(len(fr) for fr in frontiers):
-            depth += 1
+        waves_per_call = default_waves_per_call(opts)
+
+        run = self._build_run()
+
+        def shard_scalars(values):
+            return jax.device_put(
+                jnp.asarray(np.asarray(values, np.uint32)), shard
+            )
+
+        level_start = shard_scalars(np.zeros(n))
+        level_end = shard_scalars(seed_counts_h)
+        tail = shard_scalars(seed_counts_h)
+        sc_lo = shard_scalars([n_init] * n)
+        sc_hi = shard_scalars(np.zeros(n))
+        unique_g = shard_scalars([self._unique_count] * n)
+        unique_l = shard_scalars(seed_counts_h)
+        depth = shard_scalars(np.zeros(n))
+        disc = jax.device_put(
+            jnp.full((n * len(props),), NO_GID, jnp.uint32), shard
+        )
+
+        while True:
+            (
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                queue,
+                level_start,
+                level_end,
+                tail,
+                sc_lo,
+                sc_hi,
+                unique_g,
+                unique_l,
+                depth,
+                disc,
+                _waves_left,
+                flags,
+            ) = run(
+                key_hi,
+                key_lo,
+                store,
+                parent,
+                ebits,
+                queue,
+                level_start,
+                level_end,
+                tail,
+                sc_lo,
+                sc_hi,
+                unique_g,
+                unique_l,
+                depth,
+                disc,
+                shard_scalars([waves_per_call] * n),
+            )
+            ls_h = np.asarray(level_start).astype(np.int64)
+            le_h = np.asarray(level_end).astype(np.int64)
+            remaining_h = int((le_h - ls_h).sum())
+            depth_h = int(np.asarray(depth)[0])
+            flags_h = int(np.asarray(flags)[0])
+            disc_h = np.asarray(disc).reshape(n, len(props))
             with self._lock:
-                self._max_depth = depth
+                self._state_count = (
+                    int(np.asarray(sc_hi)[0]) << 32
+                ) | int(np.asarray(sc_lo)[0])
+                self._unique_count = int(np.asarray(unique_g)[0])
+                self._max_depth = depth_h + (1 if remaining_h else 0)
+                for d in range(n):
+                    for p, prop in enumerate(props):
+                        g = int(disc_h[d, p])
+                        if g != NO_GID:
+                            self._discovery_gids.setdefault(prop.name, g)
+            if flags_h & 1:
+                raise RuntimeError(
+                    f"sharded fingerprint table overfull (per-shard "
+                    f"capacity {cap_s}); raise capacity"
+                )
+            if flags_h & 2:
+                raise RuntimeError(
+                    "a shard's frontier queue overflowed its backstop "
+                    "bound; raise capacity"
+                )
+            if flags_h & 4:
+                raise RuntimeError(
+                    "a shard received more distinct states in one chunk "
+                    "than its insert dedup buffer holds; lower "
+                    f"dedup_factor (now {self._dedup_factor}) or chunk_size"
+                )
+            if flags_h & 8:
+                raise RuntimeError(
+                    "the model step kernel flagged an encoding-capacity "
+                    "overflow (a successor exceeded the packed layout's "
+                    "bounds); the compiled model's capacity assumptions "
+                    "do not hold for this configuration"
+                )
+            if remaining_h == 0:
+                break
             if (
                 opts._target_max_depth is not None
-                and depth >= opts._target_max_depth
+                and depth_h + 1 >= opts._target_max_depth
+            ):
+                break
+            if opts._finish_when.matches(
+                frozenset(self._discovery_gids), props
+            ):
+                break
+            if (
+                opts._target_state_count is not None
+                and opts._target_state_count <= self._state_count
             ):
                 break
             if deadline is not None and _time.monotonic() >= deadline:
                 break
-
-            next_frontiers: List[List[np.ndarray]] = [[] for _ in range(n)]
-            stop = False
-            n_chunks = max(
-                (len(fr) + f - 1) // f for fr in frontiers
-            ) or 1
-            for ci in range(n_chunks):
-                slots_np = np.zeros((n, f), np.uint32)
-                counts_np = np.zeros((n, 1), np.uint32)
-                for d in range(n):
-                    chunk = frontiers[d][ci * f : (ci + 1) * f]
-                    slots_np[d, : len(chunk)] = chunk
-                    counts_np[d, 0] = len(chunk)
-                (
-                    key_hi,
-                    key_lo,
-                    store,
-                    parent,
-                    ebits,
-                    new_slots,
-                    n_new_local,
-                    n_new_global,
-                    generated,
-                    cand,
-                    probe_ok,
-                    dd_overflow,
-                    step_flag,
-                ) = wave(
-                    key_hi,
-                    key_lo,
-                    store,
-                    parent,
-                    ebits,
-                    jax.device_put(jnp.asarray(slots_np.reshape(-1)), shard),
-                    jax.device_put(jnp.asarray(counts_np.reshape(-1)), shard),
-                )
-                if not np.asarray(probe_ok).all():
-                    raise RuntimeError(
-                        f"sharded fingerprint table overfull (per-shard "
-                        f"capacity {cap_s}); raise capacity"
-                    )
-                if np.asarray(dd_overflow).any():
-                    raise RuntimeError(
-                        "a shard received more distinct states in one wave "
-                        "than its insert dedup buffer holds; lower "
-                        f"dedup_factor (now {self._dedup_factor}) or "
-                        "chunk_size"
-                    )
-                if np.asarray(step_flag).any():
-                    raise RuntimeError(
-                        "the model step kernel flagged an encoding-capacity "
-                        "overflow (a successor exceeded the packed layout's "
-                        "bounds); the compiled model's capacity assumptions "
-                        "do not hold for this configuration"
-                    )
-                n_new_local_h = np.asarray(n_new_local).reshape(n)
-                new_slots_h = np.asarray(new_slots).reshape(n, -1)
-                if (n_new_local_h > new_slots_h.shape[1]).any():
-                    raise RuntimeError(
-                        "per-shard wave produced more new states than the "
-                        "frontier buffer holds; raise chunk_size"
-                    )
-                for d in range(n):
-                    if n_new_local_h[d]:
-                        next_frontiers[d].append(
-                            new_slots_h[d, : n_new_local_h[d]]
-                        )
-                with self._lock:
-                    self._state_count += int(np.asarray(generated)[0])
-                    self._unique_count += int(n_new_local_h.sum())
-                cand_h = np.asarray(cand).reshape(n, len(props))
-                for d in range(n):
-                    for p, prop in enumerate(props):
-                        g = int(cand_h[d, p])
-                        if g != NO_GID:
-                            with self._lock:
-                                self._discovery_gids.setdefault(prop.name, g)
-                if self._unique_count > (n * cap_s) // 2:
-                    raise RuntimeError(
-                        "sharded fingerprint table beyond 50% load; raise "
-                        "capacity"
-                    )
-                if opts._finish_when.matches(
-                    frozenset(self._discovery_gids), props
-                ):
-                    stop = True
-                    break
-                if (
-                    opts._target_state_count is not None
-                    and opts._target_state_count <= self._state_count
-                ):
-                    stop = True
-                    break
-                if deadline is not None and _time.monotonic() >= deadline:
-                    stop = True
-                    break
-            if stop:
-                break
-            frontiers = [
-                np.concatenate(nf) if nf else np.zeros((0,), np.uint32)
-                for nf in next_frontiers
-            ]
 
         self._tables_host = (
             np.asarray(parent).reshape(n, cap_s),

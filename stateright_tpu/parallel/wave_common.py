@@ -27,6 +27,72 @@ class WaveEval(NamedTuple):
     step_flag: object  # bool scalar: a successor overflowed the encoding
 
 
+def finish_when_trivially_true(fw, props) -> bool:
+    """Policies that match with zero discoveries (e.g. ALL with no
+    properties); only the host-side ``matches()`` check stops those,
+    preserving the at-least-one-block-first behavior of the reference."""
+    fail_props = [p for p in props if p.expectation.discovery_is_failure]
+    return (
+        (fw._kind == "all" and not props)
+        or (fw._kind == "all_failures" and not fail_props)
+        or (fw._kind == "all_of" and not fw._names)
+    )
+
+
+def default_waves_per_call(options) -> int:
+    """How many chunks each fused run() call may execute before a host
+    sync.  Fidelity knobs that only the host can check (wall-clock timeout,
+    target_state_count) and trivially-true finish_when policies force
+    one-chunk granularity; everything else — including finish_when, which
+    is mirrored on device — runs 256 chunks per sync.  Shared so the
+    single-chip and sharded engines cannot drift apart."""
+    fine_grained = (
+        options._timeout is not None
+        or options._target_state_count is not None
+        or finish_when_trivially_true(
+            options._finish_when, options.model.properties()
+        )
+    )
+    return 1 if fine_grained else 256
+
+
+def make_finish_when_device(fw, props):
+    """Device mirror of ``HasDiscoveries.matches()`` (has_discoveries.py):
+    returns ``fn(found: bool[P]) -> bool scalar`` deciding whether the
+    policy is satisfied.  Constant-TRUE policies return False here — see
+    :func:`finish_when_trivially_true`."""
+    n_props = len(props)
+    fail_idx = [
+        i for i, p in enumerate(props) if p.expectation.discovery_is_failure
+    ]
+    name_idx = {p.name: i for i, p in enumerate(props)}
+    named = [name_idx[n] for n in sorted(fw._names) if n in name_idx]
+    names_all_known = all(n in name_idx for n in fw._names)
+    kind = fw._kind
+
+    def matched(found):
+        import jax.numpy as jnp
+
+        false = jnp.zeros((), jnp.bool_)
+        if kind == "all":
+            return jnp.all(found) if n_props else false
+        if kind == "any":
+            return jnp.any(found) if n_props else false
+        if kind == "any_failures":
+            return jnp.any(found[jnp.asarray(fail_idx)]) if fail_idx else false
+        if kind == "all_failures":
+            return jnp.all(found[jnp.asarray(fail_idx)]) if fail_idx else false
+        if kind == "all_of":
+            if not names_all_known or not named:
+                return false
+            return jnp.all(found[jnp.asarray(named)])
+        if kind == "any_of":
+            return jnp.any(found[jnp.asarray(named)]) if named else false
+        raise ValueError(kind)
+
+    return matched
+
+
 def compact(mask, values, size: int):
     """Stream-compact ``values[mask]`` into a ``size``-wide buffer (excess
     dropped; caller checks counts).  One shared definition of the

@@ -86,28 +86,9 @@ class TpuChecker(Checker):
         self._max_frontier = max_frontier
         self._dedup_factor = dedup_factor
         if waves_per_call is None:
-            # Fidelity knobs that need host checks between chunks.
-            # finish_when is mirrored inside the device loop, so it does
-            # not force per-chunk syncs — except for trivially-true
-            # policies (e.g. ALL with zero properties), which only the
-            # host-side matches() stops; those keep the one-chunk-per-call
-            # granularity so the run still ends after the first chunk.
-            props = options.model.properties()
-            fail_props = [
-                p for p in props if p.expectation.discovery_is_failure
-            ]
-            fw = options._finish_when
-            fw_trivially_true = (
-                (fw._kind == "all" and not props)
-                or (fw._kind == "all_failures" and not fail_props)
-                or (fw._kind == "all_of" and not fw._names)
-            )
-            fine_grained = (
-                options._timeout is not None
-                or options._target_state_count is not None
-                or fw_trivially_true
-            )
-            waves_per_call = 1 if fine_grained else 256
+            from .wave_common import default_waves_per_call
+
+            waves_per_call = default_waves_per_call(options)
         self._waves_per_call = waves_per_call
         self._device = device or jax.devices()[0]
         self._properties = self._model.properties()
@@ -172,44 +153,19 @@ class TpuChecker(Checker):
         ev_indices = self._ev_indices
         target_depth = self._options._target_max_depth or 0
 
-        # finish_when, mirrored on device (has_discoveries.py matches()):
-        # the fused loop exits as soon as the policy is satisfied, so e.g.
-        # time-to-first-violation runs don't pay a host sync per chunk.
-        fw = self._options._finish_when
-        fw_kind = fw._kind
-        fail_idx = [
-            i
-            for i, p in enumerate(props)
-            if p.expectation.discovery_is_failure
-        ]
-        name_idx = {p.name: i for i, p in enumerate(props)}
-        fw_named = [name_idx[n] for n in sorted(fw._names) if n in name_idx]
-        fw_names_all_known = all(n in name_idx for n in fw._names)
+        # finish_when, mirrored on device (wave_common.py): the fused loop
+        # exits as soon as the policy is satisfied, so e.g. time-to-first-
+        # violation runs don't pay a host sync per chunk.
+        from .wave_common import make_finish_when_device
+
+        fw_found_matched = make_finish_when_device(
+            self._options._finish_when, props
+        )
 
         def fw_matched(disc):
-            """Device mirror of matches(); constant-TRUE policies (e.g.
-            ALL with zero properties) return False here instead — the
-            host-side check between run() calls owns those, preserving the
-            at-least-one-block-first behavior of the reference's engines."""
             import jax.numpy as jnp
 
-            found = disc != jnp.uint32(0xFFFFFFFF)  # bool[P]
-            false = jnp.zeros((), jnp.bool_)
-            if fw_kind == "all":
-                return jnp.all(found) if n_props else false
-            if fw_kind == "any":
-                return jnp.any(found) if n_props else false
-            if fw_kind == "any_failures":
-                return jnp.any(found[jnp.asarray(fail_idx)]) if fail_idx else false
-            if fw_kind == "all_failures":
-                return jnp.all(found[jnp.asarray(fail_idx)]) if fail_idx else false
-            if fw_kind == "all_of":
-                if not fw_names_all_known or not fw_named:
-                    return false
-                return jnp.all(found[jnp.asarray(fw_named)])
-            if fw_kind == "any_of":
-                return jnp.any(found[jnp.asarray(fw_named)]) if fw_named else false
-            raise ValueError(fw_kind)
+            return fw_found_matched(disc != jnp.uint32(0xFFFFFFFF))
 
         def wave_body(carry):
             (
