@@ -68,6 +68,7 @@ class TpuChecker(Checker):
         waves_per_call: Optional[int] = None,
         device=None,
         compiled: Optional[CompiledModel] = None,
+        resume_from: Optional[str] = None,
     ):
         super().__init__(options.model)
         import jax
@@ -106,6 +107,8 @@ class TpuChecker(Checker):
         self._done = threading.Event()
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
+        self._resume_from = resume_from
+        self._carry_dev: Optional[dict] = None  # full run state at stop
         self._tables_host: Optional[tuple] = None  # (parent, states) np arrays
         self._tables_dev: Optional[tuple] = None  # same, still on device
 
@@ -383,50 +386,84 @@ class TpuChecker(Checker):
         )
 
         with jax.default_device(self._device):
-            table = make_hashset(cap)
-            store = jnp.zeros((cap, cm.state_width), jnp.uint32)
-            parent = jnp.full((cap,), NO_SLOT_HOST, jnp.uint32)
-            ebits = jnp.zeros((cap,), jnp.uint32)
-
-            # Seed init states.
-            init = cm.init_packed()
-            n_init = init.shape[0]
-            if n_init > f:
-                # The one level still bounded by the chunk size: seeding
-                # writes the init batch into the queue in a single program.
-                raise ValueError(
-                    f"{n_init} init states exceed the chunk size "
-                    f"({f}); raise spawn_tpu(max_frontier=...) to at "
-                    "least the init-state count (interior levels are "
-                    "unbounded)"
-                )
-            pad = np.zeros((f - n_init, cm.state_width), np.uint32)
-            init_padded = jnp.asarray(np.concatenate([init, pad]))
             seed, run = self._programs()
-            key_hi, key_lo, store, ebits, queue, fcount, seed_ok = seed(
-                table.key_hi,
-                table.key_lo,
-                store,
-                ebits,
-                init_padded,
-                jnp.uint32(n_init),
-            )
-            if not bool(seed_ok):
-                raise RuntimeError(
-                    "init-state seeding overflowed the insert buffers; "
-                    "raise spawn_tpu(capacity=...) or lower dedup_factor"
-                )
+            if self._resume_from is not None:
+                snap = np.load(self._resume_from, allow_pickle=False)
+                want_key = self._snapshot_key()
+                got_key = str(snap["engine_key"])
+                if got_key != want_key:
+                    raise ValueError(
+                        "snapshot does not match this checker configuration"
+                        f" (snapshot {got_key}, expected {want_key})"
+                    )
+                key_hi = jnp.asarray(snap["key_hi"])
+                key_lo = jnp.asarray(snap["key_lo"])
+                store = jnp.asarray(snap["store"])
+                parent = jnp.asarray(snap["parent"])
+                ebits = jnp.asarray(snap["ebits"])
+                queue = jnp.asarray(snap["queue"])
+                level_start = jnp.uint32(int(snap["level_start"]))
+                level_end = jnp.uint32(int(snap["level_end"]))
+                tail = jnp.uint32(int(snap["tail"]))
+                sc_lo = jnp.uint32(int(snap["sc_lo"]))
+                sc_hi = jnp.uint32(int(snap["sc_hi"]))
+                unique_count = jnp.uint32(int(snap["unique_count"]))
+                depth = jnp.uint32(int(snap["depth"]))
+                disc = jnp.asarray(snap["disc"])
+                with self._lock:
+                    self._state_count = (int(sc_hi) << 32) | int(sc_lo)
+                    self._unique_count = int(unique_count)
+                    self._max_depth = int(depth)
+                    # Discovery names derive from the persisted disc array
+                    # and the property order, which the key above pins.
+                    disc_np = np.asarray(snap["disc"])
+                    for p, prop in enumerate(props):
+                        if int(disc_np[p]) != NO_SLOT_HOST:
+                            self._discovery_slots[prop.name] = int(disc_np[p])
+            else:
+                table = make_hashset(cap)
+                store = jnp.zeros((cap, cm.state_width), jnp.uint32)
+                parent = jnp.full((cap,), NO_SLOT_HOST, jnp.uint32)
+                ebits = jnp.zeros((cap,), jnp.uint32)
 
-            self._state_count = n_init
-            self._unique_count = int(fcount)
-            sc_lo = jnp.uint32(n_init)
-            sc_hi = jnp.uint32(0)
-            unique_count = fcount
-            level_start = jnp.uint32(0)
-            level_end = unique_count
-            tail = unique_count
-            depth = jnp.uint32(0)
-            disc = jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32)
+                # Seed init states.
+                init = cm.init_packed()
+                n_init = init.shape[0]
+                if n_init > f:
+                    # The one level still bounded by the chunk size: seeding
+                    # writes the init batch into the queue in one program.
+                    raise ValueError(
+                        f"{n_init} init states exceed the chunk size "
+                        f"({f}); raise spawn_tpu(max_frontier=...) to at "
+                        "least the init-state count (interior levels are "
+                        "unbounded)"
+                    )
+                pad = np.zeros((f - n_init, cm.state_width), np.uint32)
+                init_padded = jnp.asarray(np.concatenate([init, pad]))
+                key_hi, key_lo, store, ebits, queue, fcount, seed_ok = seed(
+                    table.key_hi,
+                    table.key_lo,
+                    store,
+                    ebits,
+                    init_padded,
+                    jnp.uint32(n_init),
+                )
+                if not bool(seed_ok):
+                    raise RuntimeError(
+                        "init-state seeding overflowed the insert buffers; "
+                        "raise spawn_tpu(capacity=...) or lower dedup_factor"
+                    )
+
+                self._state_count = n_init
+                self._unique_count = int(fcount)
+                sc_lo = jnp.uint32(n_init)
+                sc_hi = jnp.uint32(0)
+                unique_count = fcount
+                level_start = jnp.uint32(0)
+                level_end = unique_count
+                tail = unique_count
+                depth = jnp.uint32(0)
+                disc = jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32)
 
             while True:
                 (
@@ -523,6 +560,68 @@ class TpuChecker(Checker):
             # Keep the device arrays; path reconstruction pulls them to the
             # host lazily (the readback is expensive on tunneled devices).
             self._tables_dev = (parent, store)
+            # Full run state, for snapshotting: the reference cannot persist
+            # a run's visited set at all (SURVEY §5); here the whole checker
+            # state is a handful of dense arrays.
+            self._carry_dev = {
+                "key_hi": key_hi,
+                "key_lo": key_lo,
+                "store": store,
+                "parent": parent,
+                "ebits": ebits,
+                "queue": queue,
+                "level_start": level_start,
+                "level_end": level_end,
+                "tail": tail,
+                "sc_lo": sc_lo,
+                "sc_hi": sc_hi,
+                "unique_count": unique_count,
+                "depth": depth,
+                "disc": disc,
+            }
+
+    def _snapshot_key(self) -> str:
+        """Process-stable compatibility key for snapshots.  Deliberately
+        avoids ``cache_key()`` (whose default embeds ``repr(model)``, which
+        is identity-based for some models and would spuriously reject
+        resumes in a new process); the packed init states hash in the model
+        configuration instead."""
+        import hashlib
+
+        cm = self._compiled
+        init_digest = hashlib.sha256(
+            cm.init_packed().tobytes()
+        ).hexdigest()[:16]
+        return repr(
+            (
+                type(cm).__qualname__,
+                cm.state_width,
+                cm.max_actions,
+                self._capacity,
+                self._max_frontier,
+                tuple(p.name for p in self._properties),
+                init_digest,
+            )
+        )
+
+    def save_snapshot(self, path: str) -> None:
+        """Persist the full checker state (visited table, state store,
+        parent links, frontier queue, counters, discoveries) so a bounded
+        run — e.g. stopped by ``timeout`` or ``target_state_count`` — can
+        be resumed later with ``spawn_tpu(resume_from=path)``.  The
+        reference has no checker persistence (its visited set is not
+        persistable, SURVEY §5); on device the whole run state is dense
+        arrays, so snapshots are a plain ``np.savez``.
+
+        Note: to stay snapshot-ready, a finished checker keeps its key
+        planes, ebits, and queue (16 bytes × capacity) on device alongside
+        the store/parent arrays that path reconstruction already retains;
+        dropping the checker object frees all of it."""
+        self.join()
+        if self._carry_dev is None:
+            raise RuntimeError("no run state to snapshot")
+        arrays = {k: np.asarray(v) for k, v in self._carry_dev.items()}
+        np.savez_compressed(path, engine_key=self._snapshot_key(), **arrays)
 
     # --- Checker surface -----------------------------------------------------
 

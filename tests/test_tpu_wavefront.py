@@ -297,3 +297,39 @@ def test_twophase3_golden_on_default_device():
     model = TwoPhaseSys(rm_count=3)
     tpu = model.checker().spawn_tpu(capacity=1 << 14, max_frontier=1 << 9).join()
     assert tpu.unique_state_count() == 288
+
+
+def test_checkpoint_resume_matches_straight_run(tmp_path):
+    """A bounded run snapshots its full device state (visited table, store,
+    parents, frontier queue, counters) and resumes to exactly the totals of
+    an uninterrupted run.  The reference has no checker persistence at all
+    (SURVEY §5: its visited set is not persistable)."""
+    model = TwoPhaseSys(rm_count=5)
+    partial = (
+        model.checker()
+        .target_state_count(3000)
+        .spawn_tpu(capacity=1 << 15, max_frontier=1 << 7)
+        .join()
+    )
+    assert partial.unique_state_count() < 8832
+    snap = str(tmp_path / "run.npz")
+    partial.save_snapshot(snap)
+
+    resumed = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 15, max_frontier=1 << 7, resume_from=snap)
+        .join()
+    )
+    straight = (
+        model.checker().spawn_tpu(capacity=1 << 15, max_frontier=1 << 7).join()
+    )
+    assert resumed.unique_state_count() == straight.unique_state_count() == 8832
+    assert resumed.state_count() == straight.state_count()
+    assert resumed.max_depth() == straight.max_depth()
+    assert sorted(resumed.discoveries()) == sorted(straight.discoveries())
+    resumed.assert_properties()
+
+    with pytest.raises(ValueError, match="snapshot does not match"):
+        model.checker().spawn_tpu(
+            capacity=1 << 16, max_frontier=1 << 7, resume_from=snap
+        ).join()
