@@ -106,6 +106,19 @@ class CheckerBuilder:
 
         return TpuChecker(self, **kwargs)
 
+    def spawn_tpu_simulation(self, seed: int, **kwargs) -> "Checker":
+        """Spawn the device Monte-carlo checker: a batch of random trace
+        walks per program call, one walker per vmap lane (the stochastic
+        sibling of ``spawn_tpu``; host engine: core/simulation.py).  Runs
+        until ``finish_when`` / ``target_state_count`` / ``timeout``
+        stops it, like the host simulation engine."""
+        self._require(
+            "stateright_tpu.parallel.simulation_tpu", "TPU simulation checker"
+        )
+        from ..parallel.simulation_tpu import TpuSimulationChecker
+
+        return TpuSimulationChecker(self, seed, **kwargs)
+
     def spawn_tpu_sharded(self, **kwargs) -> "Checker":
         """Spawn the multi-chip wavefront checker: frontier and visited set
         sharded over a ``jax.sharding.Mesh`` by fingerprint ownership, with

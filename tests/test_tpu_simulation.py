@@ -1,0 +1,73 @@
+"""Device Monte-carlo checker: vmapped random trace walks.
+
+The stochastic sibling of spawn_tpu (host engine: core/simulation.py,
+reference src/checker/simulation.rs).  Discoveries are random, so the tests
+assert validity (paths replay on the host model, assert_properties) and
+high-probability coverage rather than exact counts.
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.core.has_discoveries import HasDiscoveries  # noqa: E402
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+
+from .test_tpu_wavefront import TrapCounter  # noqa: E402
+
+
+def test_simulation_finds_sometimes_discoveries():
+    model = TwoPhaseSys(rm_count=3)
+    c = (
+        model.checker()
+        .finish_when(
+            HasDiscoveries.all_of(["abort agreement", "commit agreement"])
+        )
+        .spawn_tpu_simulation(seed=3, walkers=512, max_trace_len=64)
+        .join()
+    )
+    d = c.discoveries()
+    assert sorted(d) == ["abort agreement", "commit agreement"]
+    # No global dedup, matching the host engine.
+    assert c.unique_state_count() == c.state_count() > 0
+    # Discovery traces replay on the host model per expectation semantics.
+    c.assert_properties()
+    final = d["commit agreement"].last_state()
+    assert all(rs == 2 for rs in final.rm_state)  # COMMITTED
+
+
+def test_simulation_finds_eventually_counterexample():
+    """A trace ending in the trap terminal with its eventually-bit still
+    set is a counterexample, exactly like the host engine's trace-end
+    check."""
+    model = TrapCounter(limit=5, trap_at=2)
+    c = (
+        model.checker()
+        .finish_when(HasDiscoveries.any_of(["reaches limit"]))
+        .spawn_tpu_simulation(seed=1, walkers=64, max_trace_len=32)
+        .join()
+    )
+    path = c.discoveries()["reaches limit"]
+    assert path.last_state() == model.trap_state
+
+
+def test_simulation_target_state_count_stops():
+    model = TwoPhaseSys(rm_count=3)
+    c = (
+        model.checker()
+        .target_state_count(2_000)
+        .spawn_tpu_simulation(seed=9, walkers=128, max_trace_len=64)
+        .join()
+    )
+    assert c.state_count() >= 2_000
+    assert c.is_done()
+
+
+def test_simulation_rejects_visitors_and_symmetry():
+    model = TwoPhaseSys(rm_count=3)
+    from stateright_tpu.core.visitor import StateRecorder
+
+    with pytest.raises(ValueError, match="visitors"):
+        model.checker().visitor(StateRecorder()).spawn_tpu_simulation(seed=0)
+    with pytest.raises(ValueError, match="symmetry"):
+        model.checker().symmetry().spawn_tpu_simulation(seed=0)
