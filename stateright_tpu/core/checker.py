@@ -189,6 +189,12 @@ class Checker:
     def discovery(self, name: str) -> Optional[Path]:
         return self.discoveries().get(name)
 
+    def try_discovery(self, name: str) -> Optional[Path]:
+        """Like :meth:`discovery`, but never blocks on a still-running
+        checker (device engines override this; the Explorer's status view
+        polls it mid-run)."""
+        return self.discovery(name)
+
     def discovery_classification(self, name: str) -> str:
         prop = self._model.get_property(name)
         return "example" if prop.expectation is Expectation.SOMETIMES else "counterexample"

@@ -67,7 +67,7 @@ def _properties_view(checker) -> List[List[Any]]:
     model = checker.model()
     out = []
     for p in model.properties():
-        disc = checker.discovery(p.name)
+        disc = checker.try_discovery(p.name)
         out.append(
             [
                 p.expectation.name.capitalize(),
@@ -166,17 +166,31 @@ def _state_views(checker, fp_path: str) -> List[dict]:
     return results
 
 
-def serve(builder, address, block: bool = True):
-    """Serve the Explorer; returns the underlying on-demand checker.
+def serve(builder, address, block: bool = True, engine: str = "on_demand",
+          **engine_kwargs):
+    """Serve the Explorer; returns the underlying checker.
 
     ``address``: ``(host, port)``.  ``block=True`` (reference behavior,
     src/checker/explorer.rs:163-165) serves forever on the calling thread;
     ``block=False`` serves on a background thread and returns immediately
     (the checker gains ``explorer_server`` and ``explorer_address``
     attributes for shutdown and port discovery).
+
+    ``engine``: ``"on_demand"`` (reference behavior — the checker expands
+    only what the user browses, ``check_fingerprint`` following each
+    click) or ``"tpu"`` — an exhaustive TPU wavefront run proceeds in the
+    background while the UI browses its live counts; state views are
+    host-re-executed either way, and discovery paths appear in the status
+    once the device run completes.  Extra kwargs go to the spawn call.
     """
     snapshot = _Snapshot()
-    checker = builder.visitor(snapshot).spawn_on_demand()
+    if engine == "on_demand":
+        checker = builder.visitor(snapshot).spawn_on_demand(**engine_kwargs)
+    elif engine == "tpu":
+        # The wavefront rejects visitors; the recent-path pane stays empty.
+        checker = builder.spawn_tpu(**engine_kwargs)
+    else:
+        raise ValueError(f"unknown explorer engine {engine!r}")
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet
@@ -205,7 +219,10 @@ def serve(builder, address, block: bool = True):
                 }[f.suffix]
                 self._send(200, f.read_bytes(), ctype)
             elif url == "/.status":
-                self._send_json(_status_view(checker, snapshot))
+                try:
+                    self._send_json(_status_view(checker, snapshot))
+                except Exception as e:  # surface, don't reset the connection
+                    self._send(500, str(e).encode(), "text/plain")
             elif url.startswith("/.states"):
                 try:
                     self._send_json(_state_views(checker, url[len("/.states"):]))

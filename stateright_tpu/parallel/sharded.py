@@ -94,6 +94,7 @@ class ShardedTpuChecker(Checker):
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
         self._tables_host: Optional[tuple] = None
+        self._discoveries_cache: Optional[Dict[str, Path]] = None
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -651,9 +652,20 @@ class ShardedTpuChecker(Checker):
 
     def discoveries(self) -> Dict[str, Path]:
         self.join()
-        with self._lock:
-            items = list(self._discovery_gids.items())
-        return {name: self._gid_path(g) for name, g in items}
+        if self._discoveries_cache is None:
+            with self._lock:
+                items = list(self._discovery_gids.items())
+            self._discoveries_cache = {
+                name: self._gid_path(g) for name, g in items
+            }
+        return dict(self._discoveries_cache)
+
+    def try_discovery(self, name: str) -> Optional[Path]:
+        # Non-blocking while the run is live; a failed run surfaces its
+        # error through join(), not here.
+        if not self._done.is_set() or self._errors:
+            return None
+        return self.discoveries().get(name)
 
     def handles(self) -> List[threading.Thread]:
         return [self._thread]

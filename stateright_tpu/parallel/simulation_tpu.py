@@ -86,6 +86,7 @@ class TpuSimulationChecker(Checker):
         self._state_count = 0
         self._max_depth = 0
         self._discovery_fps: Dict[str, List[int]] = {}
+        self._discoveries_cache: Optional[Dict[str, Path]] = None
         self._done = threading.Event()
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
@@ -341,12 +342,21 @@ class TpuSimulationChecker(Checker):
 
     def discoveries(self) -> Dict[str, Path]:
         self.join()
-        with self._lock:
-            items = list(self._discovery_fps.items())
-        return {
-            name: Path.from_fingerprints(self._model, fps)
-            for name, fps in items
-        }
+        if self._discoveries_cache is None:
+            with self._lock:
+                items = list(self._discovery_fps.items())
+            self._discoveries_cache = {
+                name: Path.from_fingerprints(self._model, fps)
+                for name, fps in items
+            }
+        return dict(self._discoveries_cache)
+
+    def try_discovery(self, name: str) -> Optional[Path]:
+        # Non-blocking while the run is live; a failed run surfaces its
+        # error through join(), not here.
+        if not self._done.is_set() or self._errors:
+            return None
+        return self.discoveries().get(name)
 
     def handles(self) -> List[threading.Thread]:
         return [self._thread]

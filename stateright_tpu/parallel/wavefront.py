@@ -109,6 +109,7 @@ class TpuChecker(Checker):
         self._lock = threading.Lock()
         self._resume_from = resume_from
         self._carry_dev: Optional[dict] = None  # full run state at stop
+        self._discoveries_cache: Optional[Dict[str, Path]] = None
         self._tables_host: Optional[tuple] = None  # (parent, states) np arrays
         self._tables_dev: Optional[tuple] = None  # same, still on device
 
@@ -652,9 +653,21 @@ class TpuChecker(Checker):
 
     def discoveries(self) -> Dict[str, Path]:
         self.join()
-        with self._lock:
-            items = list(self._discovery_slots.items())
-        return {name: self._slot_path(slot) for name, slot in items}
+        if self._discoveries_cache is None:
+            with self._lock:
+                items = list(self._discovery_slots.items())
+            self._discoveries_cache = {
+                name: self._slot_path(slot) for name, slot in items
+            }
+        return dict(self._discoveries_cache)
+
+    def try_discovery(self, name: str) -> Optional[Path]:
+        # Non-blocking while the run is live (the Explorer polls status
+        # mid-run); paths resolve once the run completes cleanly (a failed
+        # run surfaces its error through join(), not here).
+        if not self._done.is_set() or self._errors:
+            return None
+        return self.discoveries().get(name)
 
     def handles(self) -> List[threading.Thread]:
         return [self._thread]

@@ -98,3 +98,41 @@ def test_run_to_completion_endpoint():
     finally:
         checker.shutdown()
         checker.explorer_server.shutdown()
+
+
+def test_explorer_backed_by_tpu_run():
+    """SURVEY §7 'done' criterion: the Explorer browsing a TPU-backed run —
+    an exhaustive wavefront proceeds in the background while the UI polls
+    live counts; discovery paths appear in the status once it completes,
+    and state views navigate by host re-execution as usual."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    model = TwoPhaseSys(rm_count=3)
+    checker = model.checker().serve(
+        ("127.0.0.1", 0),
+        block=False,
+        engine="tpu",
+        capacity=1 << 14,
+        max_frontier=1 << 9,
+    )
+    try:
+        host, port = checker.explorer_address
+        base = f"http://{host}:{port}"
+        deadline = time.time() + 120
+        status = _get(base + "/.status")
+        while not status["done"] and time.time() < deadline:
+            time.sleep(0.2)
+            status = _get(base + "/.status")
+        assert status["done"]
+        assert status["unique_state_count"] == 288
+        names = {p[1]: p[2] for p in status["properties"]}
+        assert names["abort agreement"] is not None  # encoded discovery path
+        assert names["commit agreement"] is not None
+        assert names["consistent"] is None  # always-property holds
+        # Browse: root state views, then one successor level deep.
+        roots = _get(base + "/.states/")
+        assert roots and roots[0]["fingerprint"]
+        nxt = _get(base + "/.states/" + roots[0]["fingerprint"])
+        assert any(s["state"] for s in nxt)
+    finally:
+        checker.explorer_server.shutdown()

@@ -52,17 +52,10 @@ def test_sharded_levels_span_multiple_chunks():
     """2pc(5): 8,832 states whose peak level (~2,000 wide globally) spans
     several 64-state chunks per shard — full parity with the host oracle
     through the fused sharded loop."""
-    import jax
-    import numpy as np
-
-    from stateright_tpu.models.twophase import TwoPhaseSys
-
-    devices = jax.devices("cpu")[:8]
-    mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
     model = TwoPhaseSys(rm_count=5)
     tpu = (
         model.checker()
-        .spawn_tpu_sharded(mesh=mesh, capacity=1 << 16, chunk_size=1 << 6)
+        .spawn_tpu_sharded(mesh=_mesh(8), capacity=1 << 16, chunk_size=1 << 6)
         .join()
     )
     host = model.checker().spawn_bfs().join()
@@ -76,19 +69,14 @@ def test_sharded_extreme_skew_tiny_model():
     """11 states spread over 8 shards: most shards run empty chunks most
     levels (hash-random ownership skew at its worst); counts and
     discoveries still match the host."""
-    import jax
-    import numpy as np
-
     from stateright_tpu.models.ping_pong import PingPongCfg
     from stateright_tpu.models.ping_pong_compiled import compiled_ping_pong
 
-    devices = jax.devices("cpu")[:8]
-    mesh = jax.sharding.Mesh(np.array(devices), ("shards",))
     model = PingPongCfg(maintains_history=False, max_nat=5).into_model()
     tpu = (
         model.checker()
         .spawn_tpu_sharded(
-            mesh=mesh,
+            mesh=_mesh(8),
             capacity=1 << 13,
             chunk_size=1 << 5,
             compiled=compiled_ping_pong(model),
@@ -104,4 +92,38 @@ def test_sharded_extreme_skew_tiny_model():
     )
     assert tpu.unique_state_count() == host.unique_state_count() == 11
     assert tpu.state_count() == host.state_count()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def test_sharded_paxos_golden():
+    """The flagship model through the multi-chip engine: paxos check 2 on
+    an 8-device mesh reproduces the reference golden 16,668
+    (examples/paxos.rs:328) with the host oracle's discovery set."""
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(
+        client_count=2,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+    tpu = (
+        model.checker()
+        .spawn_tpu_sharded(mesh=_mesh(8), capacity=1 << 16, chunk_size=1 << 8)
+        .join()
+    )
+    assert tpu.unique_state_count() == 16_668
+    host = (
+        PaxosModelCfg(
+            client_count=2,
+            server_count=3,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
