@@ -211,7 +211,7 @@ class AbdModelCfg:
             RegisterClient(put_count=1, server_count=self.server_count)
             for _ in range(self.client_count)
         )
-        return (
+        model = (
             model.init_network_(self.network)
             .property(
                 Expectation.ALWAYS,
@@ -222,6 +222,14 @@ class AbdModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+
+        def _compiled():
+            from .abd_compiled import AbdCompiled
+
+            return AbdCompiled(model)
+
+        model.compiled = _compiled
+        return model
 
 
 def main(argv=None) -> int:
@@ -256,6 +264,8 @@ def main(argv=None) -> int:
             default_n=2,
             n_meta="CLIENT_COUNT",
             default_network="unordered_nonduplicating",
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 13, max_frontier=1 << 8),
             spawn=spawn_servers,
         ),
         argv,

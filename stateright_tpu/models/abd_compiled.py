@@ -1,0 +1,654 @@
+"""Bit-packed codec + device step kernel for the ABD quorum register.
+
+Second compiled register-harness workload (after paxos), sharing the
+client/tester layout and the exact on-device linearizability DP through
+``register_compiled_common.RegisterClientCodec``.  Host model:
+models/abd.py (reference examples/linearizable-register.rs; golden 544
+unique states at 2 clients / 2 servers on a nonduplicating network).
+
+Word layout (C ≤ 2 clients, S = 2 servers, M = 6 network slots):
+
+- words 0..1: one 29-bit server record each — seq code (4b: clock*S+id,
+  numeric order == lexicographic (clock, id) order), value (2b), phase
+  kind (2b: none/phase1/phase2), request code (2b client + 1b is_get;
+  requester and Phase1.write derive from it), per-server Phase1 responses
+  (presence 1b + seq 4b + value 2b), Phase2 read value (2b), acks bitmap;
+- word 2: client records (4 bits each);
+- words 3..8: network slots — sorted nonzero envelope codes;
+- last C words: per-client tester records.
+
+Differential gates mirror the paxos ones: full reachable-set
+decode(encode(s)) == s and per-lane device-vs-host successor equality at
+C=1 and C=2, then spawn_tpu golden 544 with the host oracle's discovery
+set (tests/test_abd_tpu.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..actor.register import Get, GetOk, Internal, Put, PutOk
+from ..parallel.compiled import CompiledModel
+from ..semantics import LinearizabilityTester, Register
+from .abd import (
+    AbdState,
+    AckQuery,
+    AckRecord,
+    NULL_VALUE,
+    Phase1,
+    Phase2,
+    Query,
+    Record,
+)
+from .register_compiled_common import RegisterClientCodec
+
+S = 2  # servers (the golden configuration; majority = 2 = all)
+MAX_CLOCK = 7  # 4-bit seq code = clock*S + id
+NET_SLOTS = 6  # observed in-flight peak at C=2 is 2
+
+_T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
+_T_QUERY, _T_ACKQUERY, _T_RECORD, _T_ACKRECORD = 4, 5, 6, 7
+
+# server-record field offsets (29 bits in one word)
+_F_SEQ = (0, 4)
+_F_VAL = (4, 2)
+_F_KIND = (6, 2)  # 0 none, 1 phase1, 2 phase2
+_F_RID = (8, 3)  # client (2b) | is_get (1b)
+_RESP0 = 11  # per server: presence 1b, seq 4b, value 2b (7 bits)
+_F_READ = (25, 2)
+_ACKS0 = 27  # +sid, 1 bit each
+
+
+class AbdCompiled(CompiledModel):
+    """Codec + device step kernel for ``AbdModelCfg.into_model()``."""
+
+    step_flags = True
+
+    def __init__(self, model):
+        self.model = model
+        cfg = model.cfg
+        if cfg.server_count != S:
+            raise ValueError("packed ABD fixes server_count=2")
+        if cfg.client_count > 2:
+            raise ValueError("packed ABD supports at most 2 clients")
+        if model.lossy_network or model.max_crashes:
+            raise ValueError(
+                "packed ABD supports lossless, crash-free configurations"
+            )
+        if model.init_network.kind != "unordered_nonduplicating":
+            # The slot encoding models the nonduplicating multiset; other
+            # fabrics would silently encode as an empty network.
+            raise ValueError(
+                "packed ABD supports the unordered_nonduplicating network"
+            )
+        self.c = cfg.client_count
+        self.m = NET_SLOTS
+        self.state_width = S + 1 + self.m + self.c
+        self.max_actions = self.m
+        self.rc = RegisterClientCodec(
+            server_count=S,
+            client_count=self.c,
+            cli_word=S,
+            tst0=S + 1 + self.m,
+        )
+        self.values = self.rc.values
+
+    def cache_key(self):
+        return (type(self).__qualname__, self.c)
+
+    # --- small-code helpers ---------------------------------------------------
+
+    def _seq_code(self, seq: Tuple[int, Id]) -> int:
+        clock, sid = seq
+        if clock > MAX_CLOCK:
+            raise ValueError(f"seq clock {clock} exceeds MAX_CLOCK")
+        return clock * S + int(sid)
+
+    def _seq_of(self, code: int) -> Tuple[int, Id]:
+        return (code // S, Id(code % S))
+
+    def _rid_code(self, request_id: int) -> int:
+        """client (2b) | is_get (1b); Put req = S+ci, Get req = 2*(S+ci)."""
+        for ci in range(self.c):
+            if request_id == S + ci:
+                return ci
+            if request_id == 2 * (S + ci):
+                return ci | 4
+        raise ValueError(f"unknown request id {request_id}")
+
+    def _rid_of(self, code: int) -> Tuple[int, int, bool]:
+        """-> (request_id, client index, is_get)."""
+        ci, is_get = code & 3, bool(code & 4)
+        rid = 2 * (S + ci) if is_get else S + ci
+        return rid, ci, is_get
+
+    # --- server record --------------------------------------------------------
+
+    def _encode_server(self, st: AbdState) -> int:
+        rc = self.rc
+        bits = self._seq_code(st.seq)
+        bits |= rc.value_code(st.val, NULL_VALUE) << _F_VAL[0]
+        ph = st.phase
+        if isinstance(ph, Phase1):
+            bits |= 1 << _F_KIND[0]
+            bits |= self._rid_code(ph.request_id) << _F_RID[0]
+            assert int(ph.requester_id) == S + (self._rid_code(ph.request_id) & 3)
+            expect_write = (
+                None
+                if self._rid_code(ph.request_id) & 4
+                else self.values[self._rid_code(ph.request_id) & 3]
+            )
+            assert ph.write == expect_write
+            for sid, (sq, v) in ph.responses:
+                off = _RESP0 + 7 * int(sid)
+                bits |= 1 << off
+                bits |= self._seq_code(sq) << (off + 1)
+                bits |= rc.value_code(v, NULL_VALUE) << (off + 5)
+        elif isinstance(ph, Phase2):
+            bits |= 2 << _F_KIND[0]
+            code = self._rid_code(ph.request_id)
+            bits |= code << _F_RID[0]
+            assert int(ph.requester_id) == S + (code & 3)
+            if code & 4:
+                bits |= rc.value_code(ph.read, NULL_VALUE) << _F_READ[0]
+            else:
+                assert ph.read is None
+            for sid in ph.acks:
+                bits |= 1 << (_ACKS0 + int(sid))
+        else:
+            assert ph is None
+        return bits
+
+    def _decode_server(self, bits: int) -> AbdState:
+        rc = self.rc
+        seq = self._seq_of(bits & 0xF)
+        val = rc.value_of((bits >> _F_VAL[0]) & 3, NULL_VALUE)
+        kind = (bits >> _F_KIND[0]) & 3
+        if kind == 0:
+            return AbdState(seq=seq, val=val, phase=None)
+        rid, ci, is_get = self._rid_of((bits >> _F_RID[0]) & 7)
+        if kind == 1:
+            responses = []
+            for sid in range(S):
+                off = _RESP0 + 7 * sid
+                if (bits >> off) & 1:
+                    responses.append(
+                        (
+                            Id(sid),
+                            (
+                                self._seq_of((bits >> (off + 1)) & 0xF),
+                                rc.value_of((bits >> (off + 5)) & 3, NULL_VALUE),
+                            ),
+                        )
+                    )
+            phase = Phase1(
+                request_id=rid,
+                requester_id=Id(S + ci),
+                write=None if is_get else self.values[ci],
+                responses=tuple(responses),
+            )
+        else:
+            phase = Phase2(
+                request_id=rid,
+                requester_id=Id(S + ci),
+                read=(
+                    rc.value_of((bits >> _F_READ[0]) & 3, NULL_VALUE)
+                    if is_get
+                    else None
+                ),
+                acks=frozenset(
+                    Id(sid) for sid in range(S) if (bits >> (_ACKS0 + sid)) & 1
+                ),
+            )
+        return AbdState(seq=seq, val=val, phase=phase)
+
+    # --- envelope codes -------------------------------------------------------
+
+    def _env_code(self, env: Envelope) -> int:
+        rc = self.rc
+        msg = env.msg
+        src, dst = int(env.src), int(env.dst)
+        if isinstance(msg, Put):
+            ci = src - S
+            assert msg == Put(S + ci, self.values[ci]) and dst == ci % S
+            code = (_T_PUT, ci, 0)
+        elif isinstance(msg, Get):
+            ci = src - S
+            assert msg.request_id == 2 * (S + ci) and dst == (S + ci + 1) % S
+            code = (_T_GET, ci, 0)
+        elif isinstance(msg, PutOk):
+            ci = dst - S
+            assert msg.request_id == S + ci
+            code = (_T_PUTOK, src * 4 + ci, 0)
+        elif isinstance(msg, GetOk):
+            ci = dst - S
+            assert msg.request_id == 2 * (S + ci)
+            code = (
+                _T_GETOK,
+                src * 4 + ci,
+                rc.value_code(msg.value, NULL_VALUE),
+            )
+        elif isinstance(msg, Internal):
+            inner = msg.msg
+            addr = src * 4 + dst
+            if isinstance(inner, Query):
+                code = (_T_QUERY, addr, self._rid_code(inner.request_id))
+            elif isinstance(inner, AckQuery):
+                code = (
+                    _T_ACKQUERY,
+                    addr,
+                    self._rid_code(inner.request_id)
+                    | (self._seq_code(inner.seq) << 3)
+                    | (rc.value_code(inner.value, NULL_VALUE) << 7),
+                )
+            elif isinstance(inner, Record):
+                code = (
+                    _T_RECORD,
+                    addr,
+                    self._rid_code(inner.request_id)
+                    | (self._seq_code(inner.seq) << 3)
+                    | (rc.value_code(inner.value, NULL_VALUE) << 7),
+                )
+            elif isinstance(inner, AckRecord):
+                code = (_T_ACKRECORD, addr, self._rid_code(inner.request_id))
+            else:
+                raise ValueError(f"unknown internal message {inner!r}")
+        else:
+            raise ValueError(f"unknown message {msg!r}")
+        tag, addr, payload = code
+        assert addr < 16 and payload < (1 << 14), (addr, payload)
+        return 1 + ((tag << 18) | (addr << 14) | payload)
+
+    def _env_of(self, code: int) -> Envelope:
+        rc = self.rc
+        code -= 1
+        tag = code >> 18
+        addr = (code >> 14) & 0xF
+        payload = code & 0x3FFF
+        if tag == _T_PUT:
+            ci = addr
+            return Envelope(Id(S + ci), Id(ci % S), Put(S + ci, self.values[ci]))
+        if tag == _T_GET:
+            ci = addr
+            return Envelope(Id(S + ci), Id((S + ci + 1) % S), Get(2 * (S + ci)))
+        if tag == _T_PUTOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(Id(src), Id(S + ci), PutOk(S + ci))
+        if tag == _T_GETOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(
+                Id(src),
+                Id(S + ci),
+                GetOk(2 * (S + ci), rc.value_of(payload, NULL_VALUE)),
+            )
+        src, dst = addr // 4, addr % 4
+        rid, _ci, _g = self._rid_of(payload & 7)
+        if tag == _T_QUERY:
+            return Envelope(Id(src), Id(dst), Internal(Query(rid)))
+        if tag == _T_ACKQUERY:
+            return Envelope(
+                Id(src),
+                Id(dst),
+                Internal(
+                    AckQuery(
+                        rid,
+                        self._seq_of((payload >> 3) & 0xF),
+                        rc.value_of((payload >> 7) & 3, NULL_VALUE),
+                    )
+                ),
+            )
+        if tag == _T_RECORD:
+            return Envelope(
+                Id(src),
+                Id(dst),
+                Internal(
+                    Record(
+                        rid,
+                        self._seq_of((payload >> 3) & 0xF),
+                        rc.value_of((payload >> 7) & 3, NULL_VALUE),
+                    )
+                ),
+            )
+        if tag == _T_ACKRECORD:
+            return Envelope(Id(src), Id(dst), Internal(AckRecord(rid)))
+        raise ValueError(f"bad envelope code {code}")
+
+    # --- full state -----------------------------------------------------------
+
+    def encode(self, st: ActorModelState) -> np.ndarray:
+        words = np.zeros(self.state_width, dtype=np.uint32)
+        for i in range(S):
+            words[i] = self._encode_server(st.actor_states[i])
+        words[S] = self.rc.encode_clients(st.actor_states)
+        env_codes = []
+        for env, count in sorted(
+            st.network.counts, key=lambda ec: self._env_code(ec[0])
+        ):
+            assert count == 1, f"multiset count {count} for {env!r}"
+            env_codes.append(self._env_code(env))
+        if len(env_codes) > self.m:
+            raise ValueError(
+                f"{len(env_codes)} in-flight envelopes exceed {self.m} slots"
+            )
+        for k, code in enumerate(env_codes):
+            words[S + 1 + k] = code
+        for i in range(self.c):
+            words[S + 1 + self.m + i] = self.rc.encode_tester(
+                st.history, i, NULL_VALUE
+            )
+        return words
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        servers = tuple(self._decode_server(int(words[i])) for i in range(S))
+        clients = self.rc.decode_clients(int(words[S]))
+        envs = []
+        for k in range(self.m):
+            code = int(words[S + 1 + k])
+            if code:
+                envs.append((self._env_of(code), 1))
+        network = Network(kind="unordered_nonduplicating", counts=frozenset(envs))
+        tester = LinearizabilityTester(Register(NULL_VALUE))
+        for i in range(self.c):
+            self.rc.decode_tester_into(
+                tester, int(words[S + 1 + self.m + i]), i, NULL_VALUE
+            )
+        n = S + self.c
+        return ActorModelState(
+            actor_states=tuple(servers) + tuple(clients),
+            network=network,
+            timers_set=(frozenset(),) * n,
+            random_choices=((),) * n,
+            crashed=(False,) * n,
+            history=tester,
+            actor_storages=(None,) * n,
+        )
+
+    # --- device side ----------------------------------------------------------
+
+    def step(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jnp.arange(self.m, dtype=jnp.uint32)
+        nexts, valid, flags = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        return nexts, valid, jnp.any(flags)
+
+    def _deliver_lane(self, state, k):
+        """One Deliver lane, mirroring AbdActor.on_msg (models/abd.py:90-187)
+        and the shared register-client handlers; fully static word
+        construction (no dynamic gather/scatter)."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        c = self.c
+        m = self.m
+        net0 = S + 1
+        tst0 = net0 + m
+
+        lane_sel = jnp.arange(m, dtype=u) == k
+        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
+        occupied = code != u(0)
+        e = code - u(1)
+        tag = e >> u(18)
+        addr = (e >> u(14)) & u(0xF)
+        payload = e & u(0x3FFF)
+        i_src = addr >> u(2)
+        i_dst = addr & u(3)
+
+        # dst server per tag (clients' put to ci % 2, get to (ci+1) % 2).
+        dsrv = jnp.where(
+            tag == u(_T_PUT),
+            addr % u(S),
+            jnp.where(tag == u(_T_GET), (addr + u(1)) % u(S), i_dst),
+        )
+        rec = jnp.where(dsrv == u(0), state[0], state[1])
+
+        def ext(bits, off, width):
+            return (bits >> u(off)) & u((1 << width) - 1)
+
+        def ins(bits, off, width, val):
+            mask = (1 << width) - 1
+            val = val.astype(u) if hasattr(val, "astype") else u(val)
+            return (bits & u(~(mask << off) & 0xFFFFFFFF)) | (val << u(off))
+
+        seq = ext(rec, *_F_SEQ)
+        val = ext(rec, *_F_VAL)
+        kind = ext(rec, *_F_KIND)
+        rid = ext(rec, *_F_RID)
+        resp_p = [ext(rec, _RESP0 + 7 * s, 1) for s in range(S)]
+        resp_seq = [ext(rec, _RESP0 + 7 * s + 1, 4) for s in range(S)]
+        resp_val = [ext(rec, _RESP0 + 7 * s + 5, 2) for s in range(S)]
+        read_v = ext(rec, *_F_READ)
+        ack_b = [ext(rec, _ACKS0 + s, 1) for s in range(S)]
+        me = dsrv
+        peer = (dsrv + u(1)) % u(S)
+
+        def mk(t, a, p):
+            return u(1) + ((u(t) << u(18)) | (a << u(14)) | p)
+
+        # --- Put / Get to an idle server (models/abd.py:91-103) --------------
+        pg_ci = addr
+        pg_is_get = tag == u(_T_GET)
+        pg_guard = kind == u(0)
+        pg_rid = pg_ci | jnp.where(pg_is_get, u(4), u(0))
+        prec = ins(rec, *_F_KIND, u(1))
+        prec = ins(prec, *_F_RID, pg_rid)
+        # responses = {self: (seq, val)}; clear any stale response fields.
+        for s in range(S):
+            mine = me == u(s)
+            prec = ins(prec, _RESP0 + 7 * s, 1, mine)
+            prec = ins(prec, _RESP0 + 7 * s + 1, 4, jnp.where(mine, seq, u(0)))
+            prec = ins(prec, _RESP0 + 7 * s + 5, 2, jnp.where(mine, val, u(0)))
+        prec = ins(prec, *_F_READ, u(0))
+        for s in range(S):
+            prec = ins(prec, _ACKS0 + s, 1, u(0))
+        pg_s0 = mk(_T_QUERY, me * u(4) + peer, pg_rid)
+
+        # --- Query (models/abd.py:105-107): reply, state unchanged -----------
+        q_guard = occupied  # always answered
+        q_s0 = mk(
+            _T_ACKQUERY,
+            i_dst * u(4) + i_src,
+            payload | (seq << u(3)) | (val << u(7)),
+        )
+
+        # --- AckQuery (models/abd.py:109-153) ---------------------------------
+        aq_rid = payload & u(7)
+        aq_seq = (payload >> u(3)) & u(0xF)
+        aq_val = (payload >> u(7)) & u(3)
+        aq_guard = (kind == u(1)) & (aq_rid == rid)
+        # responses[src] = (seq, val); with S=2 the peer's ack always
+        # completes the quorum (majority(2) == 2; self entry present).
+        n_resp = [
+            jnp.where(i_src == u(s), u(1), resp_p[s]) for s in range(S)
+        ]
+        n_rseq = [
+            jnp.where(i_src == u(s), aq_seq, resp_seq[s]) for s in range(S)
+        ]
+        n_rval = [
+            jnp.where(i_src == u(s), aq_val, resp_val[s]) for s in range(S)
+        ]
+        aq_count = sum(n_resp)
+        aq_trigger = aq_count == u(2)  # majority(2) (models/abd.py:118)
+        # max-seq response (sequencers distinct: numeric max is exact).
+        best_is_1 = (n_resp[1] == u(1)) & (
+            (n_resp[0] == u(0)) | (n_rseq[1] > n_rseq[0])
+        )
+        max_seq = jnp.where(best_is_1, n_rseq[1], n_rseq[0])
+        max_val = jnp.where(best_is_1, n_rval[1], n_rval[0])
+        is_write = (rid & u(4)) == u(0)
+        wclock = max_seq // u(S) + u(1)
+        aq_flag = aq_guard & aq_trigger & is_write & (wclock > u(MAX_CLOCK))
+        rec_seq = jnp.where(is_write, wclock * u(S) + me, max_seq)
+        rec_val = jnp.where(is_write, rid + u(1), max_val)  # values[ci] code
+        # Self-record (models/abd.py:130-132).
+        adopt = rec_seq > seq
+        arec = ins(rec, *_F_SEQ, jnp.where(adopt, rec_seq, seq))
+        arec = ins(arec, *_F_VAL, jnp.where(adopt, rec_val, val))
+        arec = ins(arec, *_F_KIND, u(2))
+        arec = ins(arec, *_F_READ, jnp.where(is_write, u(0), max_val))
+        for s in range(S):
+            arec = ins(arec, _ACKS0 + s, 1, (me == u(s)))
+            # phase2 reuses no response fields; clear them for canonicality.
+            arec = ins(arec, _RESP0 + 7 * s, 1, u(0))
+            arec = ins(arec, _RESP0 + 7 * s + 1, 4, u(0))
+            arec = ins(arec, _RESP0 + 7 * s + 5, 2, u(0))
+        # Non-trigger path: just the updated responses.
+        nrec = rec
+        for s in range(S):
+            nrec = ins(nrec, _RESP0 + 7 * s, 1, n_resp[s])
+            nrec = ins(nrec, _RESP0 + 7 * s + 1, 4, n_rseq[s])
+            nrec = ins(nrec, _RESP0 + 7 * s + 5, 2, n_rval[s])
+        aq_rec = jnp.where(aq_trigger, arec, nrec)
+        aq_s0 = jnp.where(
+            aq_trigger,
+            mk(
+                _T_RECORD,
+                me * u(4) + peer,
+                rid | (rec_seq << u(3)) | (rec_val << u(7)),
+            ),
+            u(0),
+        )
+
+        # --- Record (models/abd.py:155-159) -----------------------------------
+        r_seq = (payload >> u(3)) & u(0xF)
+        r_val = (payload >> u(7)) & u(3)
+        r_guard = occupied
+        r_adopt = r_seq > seq
+        rrec = ins(rec, *_F_SEQ, jnp.where(r_adopt, r_seq, seq))
+        rrec = ins(rrec, *_F_VAL, jnp.where(r_adopt, r_val, val))
+        r_s0 = mk(_T_ACKRECORD, i_dst * u(4) + i_src, payload & u(7))
+
+        # --- AckRecord (models/abd.py:161-185) --------------------------------
+        ar_rid = payload & u(7)
+        ar_guard = (
+            (kind == u(2))
+            & (ar_rid == rid)
+            & (
+                jnp.where(i_src == u(0), ack_b[0], ack_b[1]) == u(0)
+            )  # src not in acks
+        )
+        n_acks = [
+            jnp.where(i_src == u(s), u(1), ack_b[s]) for s in range(S)
+        ]
+        ar_trigger = sum(n_acks) == u(2)
+        ar_is_get = (rid & u(4)) != u(0)
+        ar_ci = rid & u(3)
+        # Reply to the requester and clear the phase.
+        crec = ins(rec, *_F_KIND, u(0))
+        crec = ins(crec, *_F_RID, u(0))
+        crec = ins(crec, *_F_READ, u(0))
+        for s in range(S):
+            crec = ins(crec, _ACKS0 + s, 1, u(0))
+        urec = rec
+        for s in range(S):
+            urec = ins(urec, _ACKS0 + s, 1, n_acks[s])
+        ar_rec = jnp.where(ar_trigger, crec, urec)
+        ar_s0 = jnp.where(
+            ar_trigger,
+            jnp.where(
+                ar_is_get,
+                mk(_T_GETOK, me * u(4) + ar_ci, read_v),
+                mk(_T_PUTOK, me * u(4) + ar_ci, u(0)),
+            ),
+            u(0),
+        )
+
+        # --- PutOk / GetOk to a client (shared harness transitions) ----------
+        ci, cli, ckind, _opc = self.rc.client_record(state, i_dst)
+        tw = self.rc.tester_word(state, ci)
+        putok_guard = (ckind == u(1)) & (i_dst < u(c))
+        cli_putok, tw_putok = self.rc.putok_transition(state, ci, cli, tw)
+        putok_s0 = mk(_T_GET, ci, u(0))
+        getok_guard = (ckind == u(2)) & (i_dst < u(c))
+        cli_getok, tw_getok = self.rc.getok_transition(ci, cli, tw, payload)
+
+        # --- select by tag ----------------------------------------------------
+        def sel(pairs, default):
+            out = default
+            for t, v in pairs:
+                out = jnp.where(tag == u(t), v, out)
+            return out
+
+        valid = occupied & sel(
+            [
+                (_T_PUT, pg_guard),
+                (_T_GET, pg_guard),
+                (_T_QUERY, q_guard),
+                (_T_ACKQUERY, aq_guard),
+                (_T_RECORD, r_guard),
+                (_T_ACKRECORD, ar_guard),
+                (_T_PUTOK, putok_guard),
+                (_T_GETOK, getok_guard),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+        srv_new = sel(
+            [
+                (_T_PUT, prec),
+                (_T_GET, prec),
+                (_T_ACKQUERY, aq_rec),
+                (_T_RECORD, rrec),
+                (_T_ACKRECORD, ar_rec),
+            ],
+            rec,
+        )
+        cli_f = sel([(_T_PUTOK, cli_putok), (_T_GETOK, cli_getok)], cli)
+        tw_f = sel([(_T_PUTOK, tw_putok), (_T_GETOK, tw_getok)], tw)
+        s0 = sel(
+            [
+                (_T_PUT, pg_s0),
+                (_T_GET, pg_s0),
+                (_T_QUERY, q_s0),
+                (_T_ACKQUERY, aq_s0),
+                (_T_RECORD, r_s0),
+                (_T_ACKRECORD, ar_s0),
+                (_T_PUTOK, putok_s0),
+            ],
+            u(0),
+        )
+        branch_flag = sel([(_T_ACKQUERY, aq_flag)], jnp.zeros((), jnp.bool_))
+        s0 = jnp.where(valid, s0, u(0))
+
+        # --- re-canonicalize network slots ------------------------------------
+        slots = jnp.where(lane_sel, u(0), state[net0 : net0 + m])
+        cand = jnp.concatenate([slots, s0[None]])
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        slot_overflow = valid & jnp.any(cand[m:] != ones)
+        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
+        flag = (branch_flag & valid) | slot_overflow | dup
+
+        # --- assemble ----------------------------------------------------------
+        head = [
+            jnp.where(dsrv == u(s), srv_new, state[s]) for s in range(S)
+        ]
+        head.append(cli_f)
+        tail = [
+            jnp.where(ci == u(j), tw_f, state[tst0 + j]) for j in range(c)
+        ]
+        ns = jnp.concatenate(
+            [jnp.stack(head), new_slots, jnp.stack(tail)]
+        ).astype(u)
+        return ns, valid, flag
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        lin = self.rc.device_linearizable(state)
+        slots = state[S + 1 : S + 1 + self.m]
+        e = slots - u(1)
+        getok = (slots != u(0)) & ((e >> u(18)) == u(_T_GETOK))
+        chosen = jnp.any(getok & ((e & u(0x3FFF)) != u(0)))
+        return jnp.stack([lin, chosen])
+
+
+def compiled_abd(model) -> AbdCompiled:
+    return AbdCompiled(model)

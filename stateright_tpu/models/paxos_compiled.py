@@ -74,17 +74,29 @@ class PaxosCompiled(CompiledModel):
             raise ValueError(
                 "packed paxos supports lossless, crash-free configurations"
             )
+        if model.init_network.kind != "unordered_nonduplicating":
+            # The slot encoding models the nonduplicating multiset; other
+            # fabrics would silently encode as an empty network.
+            raise ValueError(
+                "packed paxos supports the unordered_nonduplicating network"
+            )
         self.c = cfg.client_count
-        self.values = tuple(
-            chr(ord("A") + i) for i in range(self.c)
-        )  # client i's put value (actor/register.py:126)
+        self.m = NET_SLOTS if self.c <= 2 else 32
+        self.state_width = 2 * S + 1 + self.m + self.c
+        self.max_actions = self.m  # Deliver per slot (lossless, no timers)
+        from .register_compiled_common import RegisterClientCodec
+
+        self.rc = RegisterClientCodec(
+            server_count=S,
+            client_count=self.c,
+            cli_word=2 * S,
+            tst0=2 * S + 1 + self.m,
+        )
+        self.values = self.rc.values  # client i's put value (register.py:126)
         # Proposal space: client i's put is (req_id=S+i, requester=S+i, v_i).
         self.proposals = tuple(
             (S + i, Id(S + i), self.values[i]) for i in range(self.c)
         )
-        self.m = NET_SLOTS if self.c <= 2 else 32
-        self.state_width = 2 * S + 1 + self.m + self.c
-        self.max_actions = self.m  # Deliver per slot (lossless, no timers)
 
     def cache_key(self):
         return (type(self).__qualname__, self.c, self.model.cfg.never_decided)
@@ -92,13 +104,10 @@ class PaxosCompiled(CompiledModel):
     # --- small-code helpers --------------------------------------------------
 
     def _value_code(self, v) -> int:
-        """0 = NULL, 1+i = client i's value."""
-        if v == NULL_VALUE:
-            return 0
-        return 1 + self.values.index(v)
+        return self.rc.value_code(v, NULL_VALUE)
 
     def _value_of(self, code: int):
-        return NULL_VALUE if code == 0 else self.values[code - 1]
+        return self.rc.value_of(code, NULL_VALUE)
 
     def _proposal_code(self, p) -> int:
         """0 = None, else 1+index."""
@@ -317,87 +326,13 @@ class PaxosCompiled(CompiledModel):
             )
         raise ValueError(f"bad envelope code {code}")
 
-    # --- tester record -------------------------------------------------------
-
-    def _lc_code(self, last_completed, me: int) -> int:
-        """Snapshot tuple -> 2 bits per other client (0 absent, else idx+1)."""
-        lc = dict(last_completed)
-        bits = 0
-        slot = 0
-        for j in range(self.c):
-            if j == me:
-                continue
-            v = lc.get(Id(S + j))
-            bits |= (0 if v is None else v + 1) << (2 * slot)
-            slot += 1
-        return bits
-
-    def _lc_of(self, bits: int, me: int):
-        out = []
-        slot = 0
-        for j in range(self.c):
-            if j == me:
-                continue
-            v = (bits >> (2 * slot)) & 0x3
-            if v:
-                out.append((Id(S + j), v - 1))
-            slot += 1
-        return tuple(sorted(out))
+    # --- tester record (shared with all register-harness models) -------------
 
     def _encode_tester(self, h: LinearizabilityTester, me: int) -> int:
-        tid = Id(S + me)
-        hist = h.history_by_thread.get(tid)
-        inflight = h.in_flight_by_thread.get(tid)
-        lc_bits = 2 * (self.c - 1)
-        if hist is None and inflight is None:
-            return 0  # phase 0
-        if inflight is not None and not hist:
-            lc, op = inflight
-            assert op == WriteOp(self.values[me])
-            return 1 | (self._lc_code(lc, me) << 3)
-        assert hist[0][1] == WriteOp(self.values[me]) and hist[0][2] == WRITE_OK
-        lc_w = self._lc_code(hist[0][0], me)
-        if len(hist) == 1 and inflight is None:
-            return 2 | (lc_w << 3)
-        if len(hist) == 1:
-            lc, op = inflight
-            assert op == READ
-            return 3 | (lc_w << 3) | (self._lc_code(lc, me) << (3 + lc_bits))
-        assert len(hist) == 2 and inflight is None and hist[1][1] == READ
-        lc_r = self._lc_code(hist[1][0], me)
-        vcode = self._value_code(hist[1][2].value)
-        return (
-            4
-            | (lc_w << 3)
-            | (lc_r << (3 + lc_bits))
-            | (vcode << (3 + 2 * lc_bits))
-        )
+        return self.rc.encode_tester(h, me, NULL_VALUE)
 
     def _decode_tester_into(self, h: LinearizabilityTester, bits: int, me: int):
-        tid = Id(S + me)
-        phase = bits & 0x7
-        if phase == 0:
-            return
-        lc_bits = 2 * (self.c - 1)
-        lc_w = self._lc_of((bits >> 3) & ((1 << lc_bits) - 1), me)
-        if phase == 1:
-            h.in_flight_by_thread[tid] = (lc_w, WriteOp(self.values[me]))
-            h.history_by_thread[tid] = ()
-            return
-        entry_w = (lc_w, WriteOp(self.values[me]), WRITE_OK)
-        if phase == 2:
-            h.history_by_thread[tid] = (entry_w,)
-            return
-        lc_r = self._lc_of((bits >> (3 + lc_bits)) & ((1 << lc_bits) - 1), me)
-        if phase == 3:
-            h.history_by_thread[tid] = (entry_w,)
-            h.in_flight_by_thread[tid] = (lc_r, READ)
-            return
-        vcode = (bits >> (3 + 2 * lc_bits)) & 0x3
-        h.history_by_thread[tid] = (
-            entry_w,
-            (lc_r, READ, ReadOk(self._value_of(vcode))),
-        )
+        self.rc.decode_tester_into(h, bits, me, NULL_VALUE)
 
     # --- full state ----------------------------------------------------------
 
@@ -407,19 +342,7 @@ class PaxosCompiled(CompiledModel):
             bits = self._encode_server(st.actor_states[i])
             words[2 * i] = bits & 0xFFFFFFFF
             words[2 * i + 1] = bits >> 32
-        cbits = 0
-        for i in range(self.c):
-            cs: ClientState = st.actor_states[S + i]
-            if cs.awaiting is None:
-                kind = 0
-            elif cs.awaiting == S + i:
-                kind = 1  # awaiting the put
-            else:
-                assert cs.awaiting == 2 * (S + i)
-                kind = 2  # awaiting the get
-            assert cs.op_count <= 3
-            cbits |= (kind | (cs.op_count << 2)) << (4 * i)
-        words[2 * S] = cbits
+        words[2 * S] = self.rc.encode_clients(st.actor_states)
         env_codes = []
         for env, count in sorted(
             st.network.counts, key=lambda ec: self._env_code(ec[0])
@@ -443,13 +366,7 @@ class PaxosCompiled(CompiledModel):
             self._decode_server(int(words[2 * i]) | (int(words[2 * i + 1]) << 32))
             for i in range(S)
         )
-        cbits = int(words[2 * S])
-        clients = []
-        for i in range(self.c):
-            nib = (cbits >> (4 * i)) & 0xF
-            kind, op_count = nib & 0x3, nib >> 2
-            awaiting = {0: None, 1: S + i, 2: 2 * (S + i)}[kind]
-            clients.append(ClientState(awaiting=awaiting, op_count=op_count))
+        clients = self.rc.decode_clients(int(words[2 * S]))
         envs = []
         for k in range(self.m):
             code = int(words[2 * S + 1 + k])
@@ -698,47 +615,17 @@ class PaxosCompiled(CompiledModel):
         )
         dlo, dhi = self._ins(dlo, dhi, *self._F_DECIDED, u(1))
 
-        # --- PutOk / GetOk to a client (actor/register.py:130-150) -----------
-        ci = jnp.minimum(i_dst, u(c - 1))  # in-bounds clamp; guard rejects
-        cli = state[self._CLI]
-        nib = (cli >> (u(4) * ci)) & u(0xF)
-        kind = nib & u(3)
-        lcb = 2 * (c - 1)
-        tw = u(0)
-        for j in range(c):
-            tw = jnp.where(ci == u(j), state[tst0 + j], tw)
+        # --- PutOk / GetOk to a client (actor/register.py:130-150;
+        # shared register-harness transitions) ---------------------------------
+        ci, cli, kind, _opc = self.rc.client_record(state, i_dst)
+        tw = self.rc.tester_word(state, ci)
 
         putok_guard = (kind == u(1)) & (i_dst < u(c))
-        cli_putok = (cli & ~(u(0xF) << (u(4) * ci))) | (u(10) << (u(4) * ci))
-        # phase 1 -> 3: record WRITE_OK return, then the Get invocation
-        # snapshots the other clients' completed counts (consistency.py:215).
-        phases = [
-            jnp.take(state, tst0 + j) & u(0x7) for j in range(c)
-        ]
-        counts = [
-            (phases[j] >= u(2)).astype(u) + (phases[j] == u(4)).astype(u)
-            for j in range(c)
-        ]
-        lc_opts = []
-        for me in range(c):
-            bits = u(0)
-            slot = 0
-            for j in range(c):
-                if j == me:
-                    continue
-                bits = bits | (counts[j] << u(2 * slot))
-                slot += 1
-            lc_opts.append(bits)
-        lc_r = u(0)
-        for me in range(c):
-            lc_r = jnp.where(ci == u(me), lc_opts[me], lc_r)
-        lc_w_old = (tw >> u(3)) & u((1 << lcb) - 1)
-        tw_putok = u(3) | (lc_w_old << u(3)) | (lc_r << u(3 + lcb))
+        cli_putok, tw_putok = self.rc.putok_transition(state, ci, cli, tw)
         putok_s0 = mk(_T_GET, ci, u(0))
 
         getok_guard = (kind == u(2)) & (i_dst < u(c))
-        cli_getok = (cli & ~(u(0xF) << (u(4) * ci))) | (u(12) << (u(4) * ci))
-        tw_getok = (tw & ~u(7)) | u(4) | (payload << u(3 + 2 * lcb))
+        cli_getok, tw_getok = self.rc.getok_transition(ci, cli, tw, payload)
 
         # --- select by tag ----------------------------------------------------
         def sel(pairs, default):
@@ -863,99 +750,9 @@ class PaxosCompiled(CompiledModel):
         return jnp.stack(conds)
 
     def _device_linearizable(self, state):
-        """Exact linearizability of the recorded register history.
-
-        The host property runs ``LinearizabilityTester.serialized_history()``
-        — an exponential interleaving search with real-time pruning
-        (semantics/consistency.py:241-295).  On device the same decision is
-        a reachability DP over Wing&Gong-style configurations: subsets of
-        the ≤ 2C register operations crossed with the register value, where
-        an op may be appended to a configuration iff its real-time
-        prerequisites (from the tester's last-completed snapshots) are
-        already in the subset and, for a read, the register holds the value
-        it returned.  The history is linearizable iff a configuration
-        containing every *completed* op is reachable (in-flight writes are
-        optional; in-flight reads are always droppable).  Exactness is
-        pinned by tests/test_paxos_tpu.py against the host tester over both
-        the full reachable state space and an exhaustive synthetic
-        tester-state enumeration (including violations).
-        """
-        import numpy as np
-        import jax.numpy as jnp
-
-        u = jnp.uint32
-        c = self.c
-        n_ops = 2 * c  # op i = W_i (client i's put), op c+i = R_i (its get)
-        nsub = 1 << n_ops
-        nv = c + 1  # register values: 0 = NULL, 1+i = client i's value
-        lcb = 2 * (c - 1)
-        tst0 = self._NET0 + self.m
-
-        tw = [state[tst0 + i] for i in range(c)]
-        phase = [w & u(7) for w in tw]
-        lc_r = [(w >> u(3 + lcb)) & u((1 << lcb) - 1) for w in tw]
-        v_read = [(w >> u(3 + 2 * lcb)) & u(3) for w in tw]
-
-        w_completed = [phase[i] >= u(2) for i in range(c)]
-        w_present = [phase[i] >= u(1) for i in range(c)]
-        r_present = [phase[i] == u(4) for i in range(c)]  # completed reads
-
-        # Real-time prerequisite masks.  A snapshot code about thread j
-        # constrains only j's *completed* ops (consistency.py:252-261).
-        pm = []
-        for i in range(c):
-            pm.append(u(0))  # writes invoke at init: empty snapshot
-        for i in range(c):
-            mask = u(1 << i)  # program order: W_i before R_i
-            slot = 0
-            for j in range(c):
-                if j == i:
-                    continue
-                cj = (lc_r[i] >> u(2 * slot)) & u(3)
-                mask = mask | jnp.where(
-                    (cj >= u(1)) & w_completed[j], u(1 << j), u(0)
-                )
-                mask = mask | jnp.where(
-                    (cj >= u(2)) & r_present[j], u(1 << (c + j)), u(0)
-                )
-                slot += 1
-            pm.append(mask)
-        present = w_present + r_present
-
-        sub = np.arange(nsub, dtype=np.uint32)
-        dp = jnp.zeros((nsub, nv), jnp.bool_)
-        dp = dp.at[0, 0].set(True)
-        col = np.eye(nv, dtype=bool)
-        for _ in range(n_ops):
-            for o in range(n_ops):
-                bit = 1 << o
-                has = (sub & bit) != 0  # static
-                src = np.where(has, sub ^ bit, 0).astype(np.uint32)
-                dp_src = dp[src]
-                predok = ((pm[o] & ~jnp.asarray(src)) == u(0)) & present[o]
-                if o < c:  # write: register becomes 1+o
-                    add = (
-                        jnp.any(dp_src, axis=-1)
-                        & predok
-                        & jnp.asarray(has)
-                    )
-                    dp = dp | (add[:, None] & jnp.asarray(col[1 + o])[None, :])
-                else:  # read: register must equal the returned value
-                    vmatch = jnp.arange(nv, dtype=u) == v_read[o - c]
-                    add = (
-                        dp_src
-                        & vmatch[None, :]
-                        & predok[:, None]
-                        & jnp.asarray(has)[:, None]
-                    )
-                    dp = dp | add
-
-        req = u(0)
-        for i in range(c):
-            req = req | jnp.where(w_completed[i], u(1 << i), u(0))
-            req = req | jnp.where(r_present[i], u(1 << (c + i)), u(0))
-        covers = (req & ~jnp.asarray(sub)) == u(0)
-        return jnp.any(dp & covers[:, None])
+        """Exact linearizability via the shared register-harness subset-
+        reachability DP (register_compiled_common.RegisterClientCodec)."""
+        return self.rc.device_linearizable(state)
 
 
 def compiled_paxos(model) -> PaxosCompiled:
