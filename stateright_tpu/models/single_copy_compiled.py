@@ -1,0 +1,306 @@
+"""Compiled single-copy register — the *violation* workload for the device
+linearizability DP.
+
+Host model: models/single_copy_register.py (reference
+examples/single-copy-register.rs): an unreplicated register that is
+linearizable with one server (golden 93 unique states at 2 clients) and
+demonstrably NOT with two — clients round-robin their Put and Get to
+different servers, and 20 of the 62 reachable states at 2 clients / 2
+servers carry non-linearizable histories.  That makes this the one model
+family whose reachable exploration actually *discovers* the
+"linearizable" counterexample, exercising the shared DP
+(register_compiled_common) on reachable — not just synthetic — violations.
+
+Layout (C ≤ 2 clients, S ≤ 2 servers, M = 4 slots): word 0 packs the
+server values (2 bits each); then the shared client word, network slots,
+and tester words.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..actor.register import Get, GetOk, Put, PutOk
+from ..parallel.compiled import CompiledModel
+from ..semantics import LinearizabilityTester, Register
+from .register_compiled_common import RegisterClientCodec
+from .single_copy_register import NULL_VALUE
+
+NET_SLOTS = 4
+
+_T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
+
+
+class SingleCopyCompiled(CompiledModel):
+    """Codec + device step kernel for ``SingleCopyModelCfg.into_model()``."""
+
+    step_flags = True
+
+    def __init__(self, model):
+        self.model = model
+        cfg = model.cfg
+        if cfg.server_count > 2 or cfg.client_count > 2:
+            raise ValueError(
+                "packed single-copy supports at most 2 servers / 2 clients"
+            )
+        if model.lossy_network or model.max_crashes:
+            raise ValueError(
+                "packed single-copy supports lossless, crash-free "
+                "configurations"
+            )
+        if model.init_network.kind != "unordered_nonduplicating":
+            raise ValueError(
+                "packed single-copy supports the unordered_nonduplicating "
+                "network"
+            )
+        self.s = cfg.server_count
+        self.c = cfg.client_count
+        self.m = NET_SLOTS
+        self.state_width = 1 + 1 + self.m + self.c
+        self.max_actions = self.m
+        self.rc = RegisterClientCodec(
+            server_count=self.s,
+            client_count=self.c,
+            cli_word=1,
+            tst0=2 + self.m,
+        )
+        self.values = self.rc.values
+
+    def cache_key(self):
+        return (type(self).__qualname__, self.s, self.c)
+
+    # --- envelope codes -------------------------------------------------------
+
+    def _env_code(self, env: Envelope) -> int:
+        s, rc = self.s, self.rc
+        msg = env.msg
+        src, dst = int(env.src), int(env.dst)
+        if isinstance(msg, Put):
+            ci = src - s
+            assert msg == Put(s + ci, self.values[ci]) and dst == (s + ci) % s
+            code = (_T_PUT, ci, 0)
+        elif isinstance(msg, Get):
+            ci = src - s
+            assert msg.request_id == 2 * (s + ci) and dst == (s + ci + 1) % s
+            code = (_T_GET, ci, 0)
+        elif isinstance(msg, PutOk):
+            ci = dst - s
+            assert msg.request_id == s + ci
+            code = (_T_PUTOK, src * 4 + ci, 0)
+        elif isinstance(msg, GetOk):
+            ci = dst - s
+            assert msg.request_id == 2 * (s + ci)
+            code = (_T_GETOK, src * 4 + ci, rc.value_code(msg.value, NULL_VALUE))
+        else:
+            raise ValueError(f"unknown message {msg!r}")
+        tag, addr, payload = code
+        assert addr < 16 and payload < (1 << 14)
+        return 1 + ((tag << 18) | (addr << 14) | payload)
+
+    def _env_of(self, code: int) -> Envelope:
+        s, rc = self.s, self.rc
+        code -= 1
+        tag = code >> 18
+        addr = (code >> 14) & 0xF
+        payload = code & 0x3FFF
+        if tag == _T_PUT:
+            ci = addr
+            return Envelope(
+                Id(s + ci), Id((s + ci) % s), Put(s + ci, self.values[ci])
+            )
+        if tag == _T_GET:
+            ci = addr
+            return Envelope(Id(s + ci), Id((s + ci + 1) % s), Get(2 * (s + ci)))
+        if tag == _T_PUTOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(Id(src), Id(s + ci), PutOk(s + ci))
+        if tag == _T_GETOK:
+            src, ci = addr // 4, addr % 4
+            return Envelope(
+                Id(src),
+                Id(s + ci),
+                GetOk(2 * (s + ci), rc.value_of(payload, NULL_VALUE)),
+            )
+        raise ValueError(f"bad envelope code {code}")
+
+    # --- full state -----------------------------------------------------------
+
+    def encode(self, st: ActorModelState) -> np.ndarray:
+        words = np.zeros(self.state_width, dtype=np.uint32)
+        bits = 0
+        for i in range(self.s):
+            bits |= self.rc.value_code(st.actor_states[i], NULL_VALUE) << (2 * i)
+        words[0] = bits
+        words[1] = self.rc.encode_clients(st.actor_states)
+        env_codes = []
+        for env, count in sorted(
+            st.network.counts, key=lambda ec: self._env_code(ec[0])
+        ):
+            assert count == 1, f"multiset count {count} for {env!r}"
+            env_codes.append(self._env_code(env))
+        if len(env_codes) > self.m:
+            raise ValueError(
+                f"{len(env_codes)} in-flight envelopes exceed {self.m} slots"
+            )
+        for k, code in enumerate(env_codes):
+            words[2 + k] = code
+        for i in range(self.c):
+            words[2 + self.m + i] = self.rc.encode_tester(
+                st.history, i, NULL_VALUE
+            )
+        return words
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        bits = int(words[0])
+        servers = tuple(
+            self.rc.value_of((bits >> (2 * i)) & 3, NULL_VALUE)
+            for i in range(self.s)
+        )
+        clients = self.rc.decode_clients(int(words[1]))
+        envs = []
+        for k in range(self.m):
+            code = int(words[2 + k])
+            if code:
+                envs.append((self._env_of(code), 1))
+        network = Network(kind="unordered_nonduplicating", counts=frozenset(envs))
+        tester = LinearizabilityTester(Register(NULL_VALUE))
+        for i in range(self.c):
+            self.rc.decode_tester_into(
+                tester, int(words[2 + self.m + i]), i, NULL_VALUE
+            )
+        n = self.s + self.c
+        return ActorModelState(
+            actor_states=servers + tuple(clients),
+            network=network,
+            timers_set=(frozenset(),) * n,
+            random_choices=((),) * n,
+            crashed=(False,) * n,
+            history=tester,
+            actor_storages=(None,) * n,
+        )
+
+    # --- device side ----------------------------------------------------------
+
+    def step(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jnp.arange(self.m, dtype=jnp.uint32)
+        nexts, valid, flags = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        return nexts, valid, jnp.any(flags)
+
+    def _deliver_lane(self, state, k):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        c = self.c
+        s = self.s
+        m = self.m
+        net0 = 2
+        tst0 = net0 + m
+
+        lane_sel = jnp.arange(m, dtype=u) == k
+        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
+        occupied = code != u(0)
+        e = code - u(1)
+        tag = e >> u(18)
+        addr = (e >> u(14)) & u(0xF)
+        payload = e & u(0x3FFF)
+        i_dst = addr & u(3)
+
+        # Put goes to (s+ci) % s, Get to (s+ci+1) % s (actor/register.py).
+        dsrv = jnp.where(
+            tag == u(_T_PUT),
+            (addr + u(s)) % u(s),
+            (addr + u(s) + u(1)) % u(s),
+        )
+        srv_bits = state[0]
+        sval = (srv_bits >> (u(2) * dsrv)) & u(3)
+
+        def mk(t, a, p):
+            return u(1) + ((u(t) << u(18)) | (a << u(14)) | p)
+
+        # Put: store the value, reply PutOk (models/single_copy_register.py:33-35).
+        put_ci = addr
+        put_bits = (srv_bits & ~(u(3) << (u(2) * dsrv))) | (
+            (put_ci + u(1)) << (u(2) * dsrv)
+        )
+        put_s0 = mk(_T_PUTOK, dsrv * u(4) + put_ci, u(0))
+
+        # Get: reply with the current value, state unchanged (:36-38).
+        get_s0 = mk(_T_GETOK, dsrv * u(4) + addr, sval)
+
+        # PutOk / GetOk to a client (shared harness transitions).
+        ci, cli, ckind, _opc = self.rc.client_record(state, i_dst)
+        tw = self.rc.tester_word(state, ci)
+        putok_guard = (ckind == u(1)) & (i_dst < u(c))
+        cli_putok, tw_putok = self.rc.putok_transition(state, ci, cli, tw)
+        putok_s0 = mk(_T_GET, ci, u(0))
+        getok_guard = (ckind == u(2)) & (i_dst < u(c))
+        cli_getok, tw_getok = self.rc.getok_transition(ci, cli, tw, payload)
+
+        def sel(pairs, default):
+            out = default
+            for t, v in pairs:
+                out = jnp.where(tag == u(t), v, out)
+            return out
+
+        valid = occupied & sel(
+            [
+                (_T_PUT, jnp.ones((), jnp.bool_)),
+                (_T_GET, jnp.ones((), jnp.bool_)),
+                (_T_PUTOK, putok_guard),
+                (_T_GETOK, getok_guard),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+        srv_f = sel([(_T_PUT, put_bits)], srv_bits)
+        cli_f = sel([(_T_PUTOK, cli_putok), (_T_GETOK, cli_getok)], cli)
+        tw_f = sel([(_T_PUTOK, tw_putok), (_T_GETOK, tw_getok)], tw)
+        s0 = sel(
+            [
+                (_T_PUT, put_s0),
+                (_T_GET, get_s0),
+                (_T_PUTOK, putok_s0),
+            ],
+            u(0),
+        )
+        s0 = jnp.where(valid, s0, u(0))
+
+        slots = jnp.where(lane_sel, u(0), state[net0 : net0 + m])
+        cand = jnp.concatenate([slots, s0[None]])
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        slot_overflow = valid & jnp.any(cand[m:] != ones)
+        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
+        flag = slot_overflow | dup
+
+        head = [srv_f, cli_f]
+        tail = [
+            jnp.where(ci == u(j), tw_f, state[tst0 + j]) for j in range(c)
+        ]
+        ns = jnp.concatenate(
+            [jnp.stack(head), new_slots, jnp.stack(tail)]
+        ).astype(u)
+        return ns, valid, flag
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        lin = self.rc.device_linearizable(state)
+        slots = state[2 : 2 + self.m]
+        e = slots - u(1)
+        getok = (slots != u(0)) & ((e >> u(18)) == u(_T_GETOK))
+        chosen = jnp.any(getok & ((e & u(0x3FFF)) != u(0)))
+        return jnp.stack([lin, chosen])
+
+
+def compiled_single_copy(model) -> SingleCopyCompiled:
+    return SingleCopyCompiled(model)

@@ -63,7 +63,7 @@ class SingleCopyModelCfg:
             RegisterClient(put_count=1, server_count=self.server_count)
             for _ in range(self.client_count)
         )
-        return (
+        model = (
             model.init_network_(self.network)
             .property(
                 Expectation.ALWAYS,
@@ -74,6 +74,14 @@ class SingleCopyModelCfg:
             .record_msg_in(record_returns)
             .record_msg_out(record_invocations)
         )
+
+        def _compiled():
+            from .single_copy_compiled import SingleCopyCompiled
+
+            return SingleCopyCompiled(model)
+
+        model.compiled = _compiled
+        return model
 
 
 def main(argv=None) -> int:

@@ -126,6 +126,20 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
     }
 
     conds = jax.vmap(cm.property_conds)(states)  # [F, P]
+    # "Awaiting discoveries": the reference stops expanding a state when
+    # every property already has a discovery and this state contributes
+    # none (src/checker/bfs.rs:231-281) — checked against the discoveries
+    # as of wave start, the parallel analog of the reference's block-order
+    # (and thread-racy) reads.
+    discovered0 = [disc[p] != jnp.uint32(NO_ID) for p in range(n_props)]
+    awaiting = jnp.zeros(active.shape, jnp.bool_)
+    for p in range(n_props):
+        if p in always_idx:
+            awaiting = awaiting | (~discovered0[p] & conds[:, p])
+        elif p in sometimes_idx:
+            awaiting = awaiting | (~discovered0[p] & ~conds[:, p])
+        else:  # EVENTUALLY: discovered only at trace ends — always awaited
+            awaiting = awaiting | ~discovered0[p]
     for p in range(n_props):
         if p in always_idx:
             hit = active & ~conds[:, p]
@@ -150,6 +164,9 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
         nexts, valid = jax.vmap(cm.step)(states)  # [F, A, W], [F, A]
         step_flag = jnp.zeros((), jnp.bool_)
     valid = valid & active[:, None]
+    # With zero properties nothing is ever awaited and the reference
+    # expands nothing at all — the gate reproduces that too.
+    valid = valid & awaiting[:, None]
     if cm.boundary(states[0]) is not None:
         valid = valid & jax.vmap(jax.vmap(cm.boundary))(nexts)
     generated = jnp.sum(valid, dtype=jnp.uint32)

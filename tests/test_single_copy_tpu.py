@@ -1,0 +1,138 @@
+"""Device gates for the single-copy register — the *violation* workload:
+with two servers its reachable space contains genuinely non-linearizable
+histories (reference examples/single-copy-register.rs:111 demonstrates the
+counterexample), so the shared device linearizability DP is exercised on
+reachable violations, not just synthetic ones.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.actor import Network  # noqa: E402
+from stateright_tpu.actor.model import Deliver  # noqa: E402
+from stateright_tpu.core.has_discoveries import HasDiscoveries  # noqa: E402
+from stateright_tpu.models.single_copy_compiled import (  # noqa: E402
+    SingleCopyCompiled,
+)
+from stateright_tpu.models.single_copy_register import (  # noqa: E402
+    SingleCopyModelCfg,
+)
+from stateright_tpu.ops.fingerprint import fingerprint  # noqa: E402
+
+
+def sc_model(client_count: int, server_count: int):
+    return SingleCopyModelCfg(
+        client_count=client_count,
+        server_count=server_count,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+
+
+def enumerate_reachable(model):
+    seen = {}
+    frontier = list(model.init_states())
+    for s in frontier:
+        seen[fingerprint(s)] = s
+    while frontier:
+        nxt = []
+        for s in frontier:
+            acts = []
+            model.actions(s, acts)
+            for a in acts:
+                ns = model.next_state(s, a)
+                if ns is None:
+                    continue
+                fp = fingerprint(ns)
+                if fp not in seen:
+                    seen[fp] = ns
+                    nxt.append(ns)
+        frontier = nxt
+    return list(seen.values())
+
+
+@pytest.mark.parametrize("c,s", [(1, 1), (2, 1), (2, 2)])
+def test_full_reachable_differential(c, s):
+    model = sc_model(c, s)
+    cm = SingleCopyCompiled(model)
+    states = enumerate_reachable(model)
+    enc = np.stack([cm.encode(st) for st in states]).astype(np.uint32)
+    for st in states:
+        assert cm.decode(cm.encode(st)) == st
+    lane_fn = jax.jit(
+        jax.vmap(
+            lambda st: jax.vmap(lambda k: cm._deliver_lane(st, k))(
+                jnp.arange(cm.m, dtype=jnp.uint32)
+            )
+        )
+    )
+    nexts, valid, flags = (np.asarray(x) for x in lane_fn(jnp.asarray(enc)))
+    assert not flags.any()
+    for bi, st in enumerate(states):
+        host_map = {}
+        for env in st.network.iter_deliverable():
+            ns = model.next_state(st, Deliver(env.src, env.dst, env.msg))
+            host_map[cm._env_code(env)] = None if ns is None else cm.encode(ns)
+        for k in range(cm.m):
+            code = int(enc[bi][2 + k])
+            if code == 0:
+                assert not valid[bi, k]
+                continue
+            want = host_map[code]
+            if want is None:
+                assert not valid[bi, k]
+            else:
+                assert valid[bi, k] and np.array_equal(nexts[bi, k], want)
+    conds = np.asarray(jax.jit(jax.vmap(cm.property_conds))(jnp.asarray(enc)))
+    from stateright_tpu.models.single_copy_register import NULL_VALUE
+
+    for bi, st in enumerate(states):
+        assert bool(conds[bi, 0]) == (
+            st.history.serialized_history() is not None
+        )
+        assert bool(conds[bi, 1]) == any(
+            type(e.msg).__name__ == "GetOk" and e.msg.value != NULL_VALUE
+            for e in st.network.iter_deliverable()
+        )
+
+
+def test_one_server_is_linearizable_golden_93():
+    tpu = (
+        sc_model(2, 1)
+        .checker()
+        .spawn_tpu(capacity=1 << 12, max_frontier=1 << 7)
+        .join()
+    )
+    assert tpu.unique_state_count() == 93  # single-copy-register.rs:111
+    assert sorted(tpu.discoveries()) == ["value chosen"]
+    tpu.assert_properties()
+
+
+def test_two_servers_violation_found_on_device():
+    """The device DP discovers the genuine reachable linearizability
+    violation, and the counterexample trace replays on the host model.
+    Once every property has a discovery, expansion winds down (the
+    reference's awaiting-discoveries rule, src/checker/bfs.rs:231-281) —
+    exact counts in that regime are order-dependent, like the reference's
+    racy thread-pool counts, so the assertions are on the discovery set,
+    the trace, and the wind-down itself."""
+    never = HasDiscoveries.all_of(["__not_a_property__"])
+    tpu = (
+        sc_model(2, 2)
+        .checker()
+        .finish_when(never)
+        .spawn_tpu(capacity=1 << 12, max_frontier=1 << 7)
+        .join()
+    )
+    host = sc_model(2, 2).checker().finish_when(never).spawn_bfs().join()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries()) == [
+        "linearizable",
+        "value chosen",
+    ]
+    # Both engines stopped well short of the 62-state full space.
+    assert tpu.unique_state_count() < 62
+    assert host.unique_state_count() < 62
+    path = tpu.discoveries()["linearizable"]
+    assert path.last_state().history.serialized_history() is None
