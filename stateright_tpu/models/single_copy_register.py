@@ -110,6 +110,8 @@ def main(argv=None) -> int:
             default_n=2,
             n_meta="CLIENT_COUNT",
             default_network="unordered_nonduplicating",
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 12, max_frontier=1 << 7),
             spawn=spawn_servers,
         ),
         argv,
