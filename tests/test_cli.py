@@ -120,6 +120,17 @@ def test_spawn_subcommand_real_udp_paxos():
     value (the reference's spawn UX, examples/paxos.rs:488-512)."""
     import socket
 
+    # The spawn subcommand binds fixed localhost ports (the reference UX);
+    # skip rather than fail when the environment already holds them.
+    for port in (3000, 3001, 3002, 3103):
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.bind(("127.0.0.1", port))
+        except OSError:
+            pytest.skip(f"udp port {port} unavailable in this environment")
+        finally:
+            probe.close()
+
     sys.path.insert(0, REPO)
     from stateright_tpu.actor.register import Get, GetOk, Internal, Put, PutOk
     from stateright_tpu.actor.wire import register_wire_types, wire_deserialize, wire_serialize
