@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <vector>
 
 namespace {
@@ -80,11 +81,18 @@ void sr_fp64_batch(const uint32_t* words, uint64_t count, uint64_t width,
 // Open addressing over power-of-two capacity with striped mutexes; the
 // GIL is released during ctypes calls, so checker worker threads contend
 // only per stripe — the moral equivalent of DashMap's shard locks.
+//
+// Growth: like DashMap (and unlike a fixed device table), the set grows
+// automatically — inserts hold a shared resize lock; crossing 3/4 load
+// takes it uniquely, doubles the table, and rehashes.  An uncontended
+// shared lock is tens of nanoseconds against the ~microsecond ctypes call
+// that reaches here, so steady-state cost is noise.
 
 struct FpSet {
   // Atomics: readers probe without stripe locks, so the key store must be
   // a release (after the parent store) and reads acquires — a plain-store
   // scheme would be a data race however the hardware orders it.
+  std::shared_mutex resize_mx;
   std::vector<std::atomic<uint64_t>> keys;     // 0 = empty (fps are nonzero)
   std::vector<std::atomic<uint64_t>> parents;  // 0 = none
   std::vector<std::mutex> locks;
@@ -97,6 +105,15 @@ struct FpSet {
     for (auto& p : parents) p.store(0, std::memory_order_relaxed);
   }
 };
+
+static inline bool needs_grow(const FpSet* s) {
+  // Below 3/4 load a probe sweep practically always finds an empty slot
+  // or the key.  This is only a fast-path heuristic: concurrent inserters
+  // that all passed the check can still fill the table, so the insert
+  // probe loop is BOUNDED and falls back to grow() on exhaustion rather
+  // than spinning while holding the shared resize lock.
+  return s->count.load(std::memory_order_relaxed) * 4 >= (s->mask + 1) * 3;
+}
 
 void* sr_fpset_new(uint64_t capacity_pow2) {
   if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1))) {
@@ -119,35 +136,77 @@ static inline uint64_t home_of(uint64_t fp, uint64_t mask) {
   return (static_cast<uint64_t>(h) ^ (fp >> 17)) & mask;
 }
 
-// Insert fp with parent; returns 1 if newly inserted, 0 if already present,
-// -1 if the table is full.
+// Doubles the table, unless another thread already grew it past the
+// capacity the caller observed (then the caller's reason to grow is gone).
+static void grow(FpSet* s, uint64_t observed_mask) {
+  std::unique_lock<std::shared_mutex> g(s->resize_mx);
+  if (s->mask != observed_mask) return;  // another thread grew first
+  uint64_t new_cap = (s->mask + 1) * 2;
+  std::vector<std::atomic<uint64_t>> nk(new_cap);
+  std::vector<std::atomic<uint64_t>> np(new_cap);
+  for (auto& k : nk) k.store(0, std::memory_order_relaxed);
+  uint64_t new_mask = new_cap - 1;
+  for (uint64_t i = 0; i <= s->mask; ++i) {
+    uint64_t key = s->keys[i].load(std::memory_order_relaxed);
+    if (key == 0) continue;
+    uint64_t idx = home_of(key, new_mask);
+    while (nk[idx].load(std::memory_order_relaxed) != 0) {
+      idx = (idx + 1) & new_mask;
+    }
+    np[idx].store(s->parents[i].load(std::memory_order_relaxed),
+                  std::memory_order_relaxed);
+    nk[idx].store(key, std::memory_order_relaxed);
+  }
+  s->keys.swap(nk);
+  s->parents.swap(np);
+  s->mask = new_mask;
+}
+
+// Insert fp with parent; returns 1 if newly inserted, 0 if already present.
+// (-1 "table full" is retained in the ABI but no longer reachable: the set
+// grows at 3/4 load.)
 int32_t sr_fpset_insert(void* set_ptr, uint64_t fp, uint64_t parent) {
   FpSet* s = static_cast<FpSet*>(set_ptr);
-  uint64_t idx = home_of(fp, s->mask);
-  for (uint64_t probes = 0; probes <= s->mask; ++probes) {
-    std::mutex& m = s->locks[idx & 255];
+  for (;;) {
+    uint64_t observed_mask;
     {
-      std::lock_guard<std::mutex> g(m);
-      uint64_t cur = s->keys[idx].load(std::memory_order_acquire);
-      if (cur == 0) {
-        s->parents[idx].store(parent, std::memory_order_relaxed);
-        // Release: the parent store is visible before the key appears.
-        s->keys[idx].store(fp, std::memory_order_release);
-        s->count.fetch_add(1, std::memory_order_relaxed);
-        return 1;
-      }
-      if (cur == fp) {
-        return 0;
+      std::shared_lock<std::shared_mutex> rg(s->resize_mx);
+      observed_mask = s->mask;
+      if (!needs_grow(s)) {
+        uint64_t idx = home_of(fp, s->mask);
+        // Bounded: a full sweep without an empty slot or a match means
+        // concurrent inserters filled the table after the load check —
+        // fall through to grow() instead of spinning under the shared
+        // lock (which would block the grower forever).  Slots never
+        // empty, so a clean sweep is conclusive.
+        for (uint64_t probes = 0; probes <= s->mask; ++probes) {
+          std::mutex& m = s->locks[idx & 255];
+          {
+            std::lock_guard<std::mutex> g(m);
+            uint64_t cur = s->keys[idx].load(std::memory_order_acquire);
+            if (cur == 0) {
+              s->parents[idx].store(parent, std::memory_order_relaxed);
+              // Release: the parent store is visible before the key appears.
+              s->keys[idx].store(fp, std::memory_order_release);
+              s->count.fetch_add(1, std::memory_order_relaxed);
+              return 1;
+            }
+            if (cur == fp) {
+              return 0;
+            }
+          }
+          idx = (idx + 1) & s->mask;
+        }
       }
     }
-    idx = (idx + 1) & s->mask;
+    grow(s, observed_mask);
   }
-  return -1;
 }
 
 // Returns 1 and writes *parent_out if present; 0 otherwise.
 int32_t sr_fpset_get_parent(void* set_ptr, uint64_t fp, uint64_t* parent_out) {
   FpSet* s = static_cast<FpSet*>(set_ptr);
+  std::shared_lock<std::shared_mutex> rg(s->resize_mx);
   uint64_t idx = home_of(fp, s->mask);
   for (uint64_t probes = 0; probes <= s->mask; ++probes) {
     uint64_t cur = s->keys[idx].load(std::memory_order_acquire);

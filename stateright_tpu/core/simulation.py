@@ -242,6 +242,12 @@ class SimulationChecker(Checker):
     def handles(self) -> List[threading.Thread]:
         return self._handles
 
+    def shutdown(self) -> None:
+        """Stop every worker after its in-flight trace (the only exit for
+        runs whose ``finish_when`` never matches and that set neither
+        ``timeout`` nor ``target_state_count``)."""
+        self._shutdown.set()
+
     def is_done(self) -> bool:
         return all(not h.is_alive() for h in self._handles)
 

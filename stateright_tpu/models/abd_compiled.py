@@ -4,9 +4,17 @@ Second compiled register-harness workload (after paxos), sharing the
 client/tester layout and the exact on-device linearizability DP through
 ``register_compiled_common.RegisterClientCodec``.  Host model:
 models/abd.py (reference examples/linearizable-register.rs; golden 544
-unique states at 2 clients / 2 servers on a nonduplicating network).
+unique states at 2 clients / 2 servers on a nonduplicating network, 620
+at 2 clients ordered, 46,516 at 3 clients ordered — the reference's
+`linearizable-register check 3 ordered` bench workload, bench.sh:33).
 
-Word layout (C ≤ 2 clients, S = 2 servers, M = 6 network slots):
+Supports BOTH reference fabrics: the unordered nonduplicating multiset
+(sorted slot section) and the ordered per-(src,dst) FIFO fabric
+(src/actor/network.rs:60-68) as fixed per-pair queue lanes with head-only
+delivery — see ``_deliver_lane_ordered``.
+
+Word layout (C ≤ 3 clients, S = 2 servers; M = 6 sorted slots unordered,
+or one word per FIFO queue position ordered):
 
 - words 0..1: one 29-bit server record each — seq code (4b: clock*S+id,
   numeric order == lexicographic (clock, id) order), value (2b), phase
@@ -73,32 +81,72 @@ class AbdCompiled(CompiledModel):
         cfg = model.cfg
         if cfg.server_count != S:
             raise ValueError("packed ABD fixes server_count=2")
-        if cfg.client_count > 2:
-            raise ValueError("packed ABD supports at most 2 clients")
+        if cfg.client_count > 3:
+            # 3 clients is the widest the 29-bit server record carries
+            # (2-bit value codes, 2-bit client index in the request code);
+            # covers both reference bench configs (check 2 / check 3
+            # ordered, bench.sh:30-34).
+            raise ValueError("packed ABD supports at most 3 clients")
         if model.lossy_network or model.max_crashes:
             raise ValueError(
                 "packed ABD supports lossless, crash-free configurations"
             )
-        if model.init_network.kind != "unordered_nonduplicating":
-            # The slot encoding models the nonduplicating multiset; other
-            # fabrics would silently encode as an empty network.
+        if model.init_network.kind not in (
+            "unordered_nonduplicating",
+            "ordered",
+        ):
             raise ValueError(
-                "packed ABD supports the unordered_nonduplicating network"
+                "packed ABD supports the unordered_nonduplicating and "
+                "ordered networks"
             )
         self.c = cfg.client_count
-        self.m = NET_SLOTS
-        self.state_width = S + 1 + self.m + self.c
-        self.max_actions = self.m
+        self.ordered = model.init_network.kind == "ordered"
         self.rc = RegisterClientCodec(
             server_count=S,
             client_count=self.c,
             cli_word=S,
-            tst0=S + 1 + self.m,
+            tst0=0,  # patched below once the net section width is known
         )
+        if self.ordered:
+            # Per-(src,dst) FIFO lanes (src/actor/network.rs:60-68,
+            # 212-218: Ordered is a VecDeque per directed pair; only heads
+            # deliver).  Pairs that can carry traffic: each client's put
+            # channel (to ci % S) and get channel (to (ci+1) % S), every
+            # server->client reply channel, and the server peer channels.
+            # Client-adjacent channels hold at most one message (clients
+            # have one op outstanding); peer channels can stack a reply
+            # behind an own-phase message — depth 3 gives margin, and the
+            # step kernel flags overflow loudly.
+            pairs = []
+            for ci in range(self.c):
+                pairs.append((S + ci, ci % S, 1))  # put channel
+                pairs.append((S + ci, (S + ci + 1) % S, 1))  # get channel
+            for s in range(S):
+                for ci in range(self.c):
+                    pairs.append((s, S + ci, 1))  # replies
+            for s in range(S):
+                pairs.append((s, (s + 1) % S, 3))  # peer channel
+            offs = []
+            off = 0
+            for _src, _dst, depth in pairs:
+                offs.append(off)
+                off += depth
+            self.pairs = [
+                (src, dst, depth, o)
+                for (src, dst, depth), o in zip(pairs, offs)
+            ]
+            self.m = off  # total net words
+            self.max_actions = len(self.pairs)
+        else:
+            self.pairs = None
+            self.m = NET_SLOTS
+            self.max_actions = self.m
+        self.state_width = S + 1 + self.m + self.c
+        self.rc.tst0 = S + 1 + self.m
         self.values = self.rc.values
 
     def cache_key(self):
-        return (type(self).__qualname__, self.c)
+        return (type(self).__qualname__, self.c, self.ordered)
 
     # --- small-code helpers ---------------------------------------------------
 
@@ -324,18 +372,40 @@ class AbdCompiled(CompiledModel):
         for i in range(S):
             words[i] = self._encode_server(st.actor_states[i])
         words[S] = self.rc.encode_clients(st.actor_states)
-        env_codes = []
-        for env, count in sorted(
-            st.network.counts, key=lambda ec: self._env_code(ec[0])
-        ):
-            assert count == 1, f"multiset count {count} for {env!r}"
-            env_codes.append(self._env_code(env))
-        if len(env_codes) > self.m:
-            raise ValueError(
-                f"{len(env_codes)} in-flight envelopes exceed {self.m} slots"
-            )
-        for k, code in enumerate(env_codes):
-            words[S + 1 + k] = code
+        if self.ordered:
+            index = {
+                (src, dst): (depth, off)
+                for src, dst, depth, off in self.pairs
+            }
+            for (src, dst), msgs in st.network.flows:
+                key = (int(src), int(dst))
+                if key not in index:
+                    raise ValueError(f"no FIFO lane for flow {key}")
+                depth, off = index[key]
+                if len(msgs) > depth:
+                    raise ValueError(
+                        f"flow {key} holds {len(msgs)} messages; lane "
+                        f"depth is {depth}"
+                    )
+                for j, msg in enumerate(msgs):
+                    # src/dst come from the host flow key and are Ids.
+                    words[S + 1 + off + j] = self._env_code(
+                        Envelope(src, dst, msg)
+                    )
+        else:
+            env_codes = []
+            for env, count in sorted(
+                st.network.counts, key=lambda ec: self._env_code(ec[0])
+            ):
+                assert count == 1, f"multiset count {count} for {env!r}"
+                env_codes.append(self._env_code(env))
+            if len(env_codes) > self.m:
+                raise ValueError(
+                    f"{len(env_codes)} in-flight envelopes exceed "
+                    f"{self.m} slots"
+                )
+            for k, code in enumerate(env_codes):
+                words[S + 1 + k] = code
         for i in range(self.c):
             words[S + 1 + self.m + i] = self.rc.encode_tester(
                 st.history, i, NULL_VALUE
@@ -345,12 +415,28 @@ class AbdCompiled(CompiledModel):
     def decode(self, words: Sequence[int]) -> ActorModelState:
         servers = tuple(self._decode_server(int(words[i])) for i in range(S))
         clients = self.rc.decode_clients(int(words[S]))
-        envs = []
-        for k in range(self.m):
-            code = int(words[S + 1 + k])
-            if code:
-                envs.append((self._env_of(code), 1))
-        network = Network(kind="unordered_nonduplicating", counts=frozenset(envs))
+        if self.ordered:
+            flows = []
+            for src, dst, depth, off in self.pairs:
+                msgs = []
+                for j in range(depth):
+                    code = int(words[S + 1 + off + j])
+                    if code:
+                        env = self._env_of(code)
+                        assert (int(env.src), int(env.dst)) == (src, dst)
+                        msgs.append(env.msg)
+                if msgs:
+                    flows.append(((Id(src), Id(dst)), tuple(msgs)))
+            network = Network(kind="ordered", flows=tuple(sorted(flows)))
+        else:
+            envs = []
+            for k in range(self.m):
+                code = int(words[S + 1 + k])
+                if code:
+                    envs.append((self._env_of(code), 1))
+            network = Network(
+                kind="unordered_nonduplicating", counts=frozenset(envs)
+            )
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
             self.rc.decode_tester_into(
@@ -373,25 +459,143 @@ class AbdCompiled(CompiledModel):
         import jax
         import jax.numpy as jnp
 
-        ks = jnp.arange(self.m, dtype=jnp.uint32)
-        nexts, valid, flags = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        n_lanes = len(self.pairs) if self.ordered else self.m
+        ks = jnp.arange(n_lanes, dtype=jnp.uint32)
+        fn = self._deliver_lane_ordered if self.ordered else self._deliver_lane
+        nexts, valid, flags = jax.vmap(lambda k: fn(state, k))(ks)
         return nexts, valid, jnp.any(flags)
 
     def _deliver_lane(self, state, k):
-        """One Deliver lane, mirroring AbdActor.on_msg (models/abd.py:90-187)
-        and the shared register-client handlers; fully static word
-        construction (no dynamic gather/scatter)."""
+        """One unordered Deliver lane: slot ``k``'s envelope through the
+        shared handler, multiset slots re-canonicalized by sort."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        m = self.m
+        net0 = S + 1
+        lane_sel = jnp.arange(m, dtype=u) == k
+        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
+        occupied = code != u(0)
+        (
+            valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci,
+        ) = self._handle(state, code, occupied)
+
+        slots = jnp.where(lane_sel, u(0), state[net0 : net0 + m])
+        cand = jnp.concatenate([slots, s0[None]])
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        slot_overflow = valid & jnp.any(cand[m:] != ones)
+        # Duplicate send = host multiset count 2, unrepresentable in the
+        # slot codec — flag loudly (see paxos_compiled.py).
+        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
+        flag = (branch_flag & valid) | slot_overflow | dup
+        ns = self._assemble(state, dsrv, srv_new, cli_f, ci, tw_f, new_slots)
+        return ns, valid, flag
+
+    def _deliver_lane_ordered(self, state, k):
+        """One ordered Deliver lane: the head of FIFO pair ``k`` through
+        the shared handler; delivery shifts that pair's queue and the
+        (single) send appends at its target pair's tail — the packed form
+        of the reference's per-(src,dst) VecDeque fabric
+        (src/actor/network.rs:60-68,212-218,244-267)."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        net0 = S + 1
+        code = u(0)
+        for idx, (_src, _dst, _depth, off) in enumerate(self.pairs):
+            code = jnp.where(k == u(idx), state[net0 + off], code)
+        occupied = code != u(0)
+        (
+            handler_valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci,
+        ) = self._handle(state, code, occupied)
+        # Ordered fabric: a no-op delivery still consumes the head and IS a
+        # successor (actor/model.py:299, mirroring src/actor/model.rs) — so
+        # every occupied head is a valid lane, with the handler's effects
+        # masked out when its guard failed.  (The record hooks fire only
+        # for PutOk/GetOk, which are never no-op deliveries in this
+        # protocol — a client always awaits the one reply in flight.)
+        valid = occupied
+        orig_srv = jnp.where(dsrv == u(0), state[0], state[1])
+        srv_new = jnp.where(handler_valid, srv_new, orig_srv)
+        cli_f = jnp.where(handler_valid, cli_f, state[S])
+        tw_f = jnp.where(handler_valid, tw_f, self.rc.tester_word(state, ci))
+
+        # Target pair of the send (s0 is zeroed on invalid lanes).  The
+        # handler emits at most one message per transition; its (src, dst)
+        # derive from the envelope code's tag + addr.
+        es = s0 - u(1)
+        t_tag = es >> u(18)
+        t_addr = (es >> u(14)) & u(0xF)
+        srv_src = t_addr >> u(2)
+        srv_dst = t_addr & u(3)
+        is_reply = (t_tag == u(_T_PUTOK)) | (t_tag == u(_T_GETOK))
+        is_get = t_tag == u(_T_GET)
+        t_src = jnp.where(is_get, u(S) + t_addr, srv_src)
+        t_dst = jnp.where(
+            is_reply,
+            u(S) + srv_dst,
+            jnp.where(is_get, (t_addr + u(S) + u(1)) % u(S), srv_dst),
+        )
+        has_send = s0 != u(0)
+        t_pair = u(len(self.pairs))  # sentinel: no matching lane
+        for idx, (src, dst, _depth, _off) in enumerate(self.pairs):
+            t_pair = jnp.where(
+                (t_src == u(src)) & (t_dst == u(dst)), u(idx), t_pair
+            )
+
+        new_words = []
+        overflow = jnp.zeros((), jnp.bool_)
+        unroutable = has_send & (t_pair == u(len(self.pairs)))
+        for idx, (_src, _dst, depth, off) in enumerate(self.pairs):
+            delivered = k == u(idx)
+            shifted = []
+            for j in range(depth):
+                nxt = state[net0 + off + j + 1] if j + 1 < depth else u(0)
+                shifted.append(
+                    jnp.where(delivered, nxt, state[net0 + off + j])
+                )
+            target = has_send & (t_pair == u(idx))
+            ln = sum((w != u(0)).astype(u) for w in shifted)
+            for j in range(depth):
+                shifted[j] = jnp.where(target & (ln == u(j)), s0, shifted[j])
+            overflow = overflow | (target & (ln == u(depth)))
+            new_words.extend(shifted)
+        flag = (branch_flag & handler_valid) | overflow | unroutable
+        ns = self._assemble(
+            state, dsrv, srv_new, cli_f, ci, tw_f, jnp.stack(new_words)
+        )
+        return ns, valid, flag
+
+    def _assemble(self, state, dsrv, srv_new, cli_f, ci, tw_f, net_words):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        tst0 = S + 1 + self.m
+        head = [
+            jnp.where(dsrv == u(s), srv_new, state[s]) for s in range(S)
+        ]
+        head.append(cli_f)
+        tail = [
+            jnp.where(ci == u(j), tw_f, state[tst0 + j])
+            for j in range(self.c)
+        ]
+        return jnp.concatenate(
+            [jnp.stack(head), net_words, jnp.stack(tail)]
+        ).astype(u)
+
+    def _handle(self, state, code, occupied):
+        """The message handler, mirroring AbdActor.on_msg
+        (models/abd.py:90-187) and the shared register-client handlers;
+        fully static word construction (no dynamic gather/scatter).
+        Fabric-independent: both the multiset and FIFO lanes feed it one
+        envelope code."""
         import jax.numpy as jnp
 
         u = jnp.uint32
         c = self.c
-        m = self.m
-        net0 = S + 1
-        tst0 = net0 + m
-
-        lane_sel = jnp.arange(m, dtype=u) == k
-        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        occupied = code != u(0)
         e = code - u(1)
         tag = e >> u(18)
         addr = (e >> u(14)) & u(0xF)
@@ -613,30 +817,7 @@ class AbdCompiled(CompiledModel):
         )
         branch_flag = sel([(_T_ACKQUERY, aq_flag)], jnp.zeros((), jnp.bool_))
         s0 = jnp.where(valid, s0, u(0))
-
-        # --- re-canonicalize network slots ------------------------------------
-        slots = jnp.where(lane_sel, u(0), state[net0 : net0 + m])
-        cand = jnp.concatenate([slots, s0[None]])
-        ones = u(0xFFFFFFFF)
-        cand = jnp.where(cand == u(0), ones, cand)
-        cand = jnp.sort(cand)
-        slot_overflow = valid & jnp.any(cand[m:] != ones)
-        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
-        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
-        flag = (branch_flag & valid) | slot_overflow | dup
-
-        # --- assemble ----------------------------------------------------------
-        head = [
-            jnp.where(dsrv == u(s), srv_new, state[s]) for s in range(S)
-        ]
-        head.append(cli_f)
-        tail = [
-            jnp.where(ci == u(j), tw_f, state[tst0 + j]) for j in range(c)
-        ]
-        ns = jnp.concatenate(
-            [jnp.stack(head), new_slots, jnp.stack(tail)]
-        ).astype(u)
-        return ns, valid, flag
+        return valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci
 
     def property_conds(self, state):
         import jax.numpy as jnp

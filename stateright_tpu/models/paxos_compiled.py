@@ -48,7 +48,6 @@ from .paxos import (
 
 S = 3  # servers (the golden configurations fix three)
 MAX_ROUND = 15  # 4 bits; validated by the differential reachability test
-NET_SLOTS = 16  # c <= 2 (observed in-flight peak 10); widened for c == 3
 
 # Message tags for envelope codes.
 _T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
@@ -65,8 +64,10 @@ class PaxosCompiled(CompiledModel):
         cfg = model.cfg
         if cfg.server_count != S:
             raise ValueError("packed paxos fixes server_count=3")
-        if cfg.client_count > 3:
-            raise ValueError("packed paxos supports at most 3 clients")
+        if cfg.client_count > 7:
+            # The harness caps: 4-bit client nibbles and the tester word
+            # (register_compiled_common.py); paxos adds no tighter bound.
+            raise ValueError("packed paxos supports at most 7 clients")
         if model.lossy_network or model.max_crashes:
             # The step kernel expands Deliver lanes only; a lossy or crashy
             # configuration has Drop/Crash/Recover action families the
@@ -81,9 +82,22 @@ class PaxosCompiled(CompiledModel):
                 "packed paxos supports the unordered_nonduplicating network"
             )
         self.c = cfg.client_count
-        self.m = NET_SLOTS if self.c <= 2 else 32
+        # In-flight envelope budget: observed peaks are 10 (c=2) and < 32
+        # (c=3); larger bench configs (check 4/6, bench.sh:28) get 64 slots
+        # — undersizing fails loudly (encode raises; the step kernel's
+        # slot_overflow flag aborts the engine), never silently.
+        self.m = 16 if self.c <= 2 else (32 if self.c == 3 else 64)
         self.state_width = 2 * S + 1 + self.m + self.c
         self.max_actions = self.m  # Deliver per slot (lossless, no timers)
+        # Proposal codes 0..c -> width derived from the client count; the
+        # server-record fields after it shift accordingly (49 + pb bits
+        # total, <= 64 for c <= 7).
+        self.pb = max(2, self.c.bit_length())
+        self._F_PROP = (6, self.pb)
+        self._PREP0 = 6 + self.pb
+        self._F_ACCEPTS = self._PREP0 + S * (1 + self._ACC_BITS)
+        self._F_ACCEPTED = (self._F_ACCEPTS + S, self._ACC_BITS)
+        self._F_DECIDED = (self._F_ACCEPTED[0] + self._ACC_BITS, 1)
         from .register_compiled_common import RegisterClientCodec
 
         self.rc = RegisterClientCodec(
@@ -130,9 +144,11 @@ class PaxosCompiled(CompiledModel):
         if acc is None:
             return 0
         ballot, proposal = acc
-        return 1 + self._ballot_code(ballot) * self.c + self.proposals.index(
+        code = 1 + self._ballot_code(ballot) * self.c + self.proposals.index(
             tuple(proposal)
         )
+        assert code < (1 << self._ACC_BITS), code
+        return code
 
     def _accepted_of(self, code: int):
         if code == 0:
@@ -145,14 +161,19 @@ class PaxosCompiled(CompiledModel):
 
     # --- server record (47 bits in a u64 chunk) ------------------------------
 
-    _ACC_BITS = 9  # 1 + 15*3*3 = 136 accepted codes fit
+    # Accepted codes are 1 + ballot_code*C + proposal_idx; at the caps
+    # (MAX_ROUND=15 -> ballot codes <= 47, C <= 7) the max is
+    # 1 + 47*7 + 6 = 336 < 512.  _accepted_code asserts the bound so a
+    # future MAX_ROUND/client bump fails loudly instead of corrupting the
+    # adjacent server-record fields.
+    _ACC_BITS = 9
 
     def _encode_server(self, s: PaxosState) -> int:
         bits = self._ballot_code(s.ballot)  # 6 bits (rounds 0..15 * 3)
         assert bits < 64
         off = 6
         bits |= self._proposal_code(s.proposal) << off
-        off += 2
+        off += self.pb
         prepares = dict(s.prepares)
         for sid in range(S):
             if Id(sid) in prepares:
@@ -173,8 +194,8 @@ class PaxosCompiled(CompiledModel):
     def _decode_server(self, bits: int) -> PaxosState:
         ballot = self._ballot_of(bits & 0x3F)
         off = 6
-        proposal = self._proposal_of((bits >> off) & 0x3)
-        off += 2
+        proposal = self._proposal_of((bits >> off) & ((1 << self.pb) - 1))
+        off += self.pb
         prepares = []
         for sid in range(S):
             if (bits >> off) & 1:
@@ -202,8 +223,10 @@ class PaxosCompiled(CompiledModel):
     # --- envelope codes ------------------------------------------------------
 
     def _env_code(self, env: Envelope) -> int:
-        """tag(4) | src(2) upper or client idx | fields; nonzero overall
-        (slot value 0 means empty, so add 1 at the end)."""
+        """tag(4 at bit 19) | addr(5 at bit 14, base-8 src/dst or client
+        idx) | payload(14); nonzero overall (slot value 0 means empty, so
+        add 1 at the end).  Base-8 addressing and the 512 multiplier in
+        Prepared cover client counts up to the harness cap of 7."""
         msg = env.msg
         src, dst = int(env.src), int(env.dst)
         if isinstance(msg, Put):
@@ -217,43 +240,43 @@ class PaxosCompiled(CompiledModel):
         elif isinstance(msg, PutOk):
             ci = dst - S
             assert msg.request_id == S + ci
-            code = (_T_PUTOK, src * 4 + ci, 0)
+            code = (_T_PUTOK, src * 8 + ci, 0)
         elif isinstance(msg, GetOk):
             ci = dst - S
             assert msg.request_id == 2 * (S + ci)
-            code = (_T_GETOK, src * 4 + ci, self._value_code(msg.value))
+            code = (_T_GETOK, src * 8 + ci, self._value_code(msg.value))
         elif isinstance(msg, Internal):
             inner = msg.msg
             if isinstance(inner, Prepare):
                 assert int(inner.ballot[1]) == src
                 self._ballot_code(inner.ballot)  # round bounds check
-                code = (_T_PREPARE, src * 4 + dst, inner.ballot[0])
+                code = (_T_PREPARE, src * 8 + dst, inner.ballot[0])
             elif isinstance(inner, Prepared):
                 assert int(inner.ballot[1]) == dst
                 self._ballot_code(inner.ballot)
                 code = (
                     _T_PREPARED,
-                    src * 4 + dst,
-                    inner.ballot[0] * 256 + self._accepted_code(inner.last_accepted),
+                    src * 8 + dst,
+                    inner.ballot[0] * 512 + self._accepted_code(inner.last_accepted),
                 )
             elif isinstance(inner, Accept):
                 assert int(inner.ballot[1]) == src
                 self._ballot_code(inner.ballot)
                 code = (
                     _T_ACCEPT,
-                    src * 4 + dst,
-                    inner.ballot[0] * 4
+                    src * 8 + dst,
+                    inner.ballot[0] * 8
                     + (self._proposal_code(inner.proposal) - 1),
                 )
             elif isinstance(inner, Accepted):
                 assert int(inner.ballot[1]) == dst
                 self._ballot_code(inner.ballot)
-                code = (_T_ACCEPTED, src * 4 + dst, inner.ballot[0])
+                code = (_T_ACCEPTED, src * 8 + dst, inner.ballot[0])
             elif isinstance(inner, Decided):
                 code = (
                     _T_DECIDED,
-                    src * 4 + dst,
-                    (self._ballot_code(inner.ballot) * 4)
+                    src * 8 + dst,
+                    (self._ballot_code(inner.ballot) * 8)
                     + (self._proposal_code(inner.proposal) - 1),
                 )
             else:
@@ -261,13 +284,13 @@ class PaxosCompiled(CompiledModel):
         else:
             raise ValueError(f"unknown message {msg!r}")
         tag, addr, payload = code
-        assert addr < 16 and payload < (1 << 14), (addr, payload)
-        return 1 + ((tag << 18) | (addr << 14) | payload)
+        assert addr < 32 and payload < (1 << 14), (addr, payload)
+        return 1 + ((tag << 19) | (addr << 14) | payload)
 
     def _env_of(self, code: int) -> Envelope:
         code -= 1
-        tag = code >> 18
-        addr = (code >> 14) & 0xF
+        tag = code >> 19
+        addr = (code >> 14) & 0x1F
         payload = code & 0x3FFF
         if tag == _T_PUT:
             ci = addr
@@ -278,14 +301,14 @@ class PaxosCompiled(CompiledModel):
             ci = addr
             return Envelope(Id(S + ci), Id((S + ci + 1) % S), Get(2 * (S + ci)))
         if tag == _T_PUTOK:
-            src, ci = addr // 4, addr % 4
+            src, ci = addr // 8, addr % 8
             return Envelope(Id(src), Id(S + ci), PutOk(S + ci))
         if tag == _T_GETOK:
-            src, ci = addr // 4, addr % 4
+            src, ci = addr // 8, addr % 8
             return Envelope(
                 Id(src), Id(S + ci), GetOk(2 * (S + ci), self._value_of(payload))
             )
-        src, dst = addr // 4, addr % 4
+        src, dst = addr // 8, addr % 8
         if tag == _T_PREPARE:
             return Envelope(
                 Id(src), Id(dst), Internal(Prepare((payload, Id(src))))
@@ -295,7 +318,7 @@ class PaxosCompiled(CompiledModel):
                 Id(src),
                 Id(dst),
                 Internal(
-                    Prepared((payload // 256, Id(dst)), self._accepted_of(payload % 256))
+                    Prepared((payload // 512, Id(dst)), self._accepted_of(payload % 512))
                 ),
             )
         if tag == _T_ACCEPT:
@@ -304,8 +327,8 @@ class PaxosCompiled(CompiledModel):
                 Id(dst),
                 Internal(
                     Accept(
-                        (payload // 4, Id(src)),
-                        self.proposals[payload % 4],
+                        (payload // 8, Id(src)),
+                        self.proposals[payload % 8],
                     )
                 ),
             )
@@ -319,8 +342,8 @@ class PaxosCompiled(CompiledModel):
                 Id(dst),
                 Internal(
                     Decided(
-                        self._ballot_of(payload // 4),
-                        self.proposals[payload % 4],
+                        self._ballot_of(payload // 8),
+                        self.proposals[payload % 8],
                     )
                 ),
             )
@@ -406,12 +429,11 @@ class PaxosCompiled(CompiledModel):
     _NET0 = 2 * S + 1
     _CLI = 2 * S
 
-    # server-record field offsets (51 bits over a lo/hi u32 pair)
+    # Server-record field offsets ((49 + pb) bits over a lo/hi u32 pair):
+    # ballot(6) | proposal(pb) | 3x prepare entries (1 + _ACC_BITS each,
+    # from _PREP0) | 3 accept bits (_F_ACCEPTS) | accepted (_ACC_BITS) |
+    # decided(1).  pb-dependent offsets are instance attrs set in __init__.
     _F_BALLOT = (0, 6)
-    _F_PROP = (6, 2)
-    _F_ACCEPTS = 38  # +sid, 1 bit each
-    _F_ACCEPTED = (41, 9)
-    _F_DECIDED = (50, 1)
 
     @staticmethod
     def _ext(lo, hi, off: int, width: int):
@@ -473,11 +495,11 @@ class PaxosCompiled(CompiledModel):
         code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
         occupied = code != u(0)
         e = code - u(1)
-        tag = e >> u(18)
-        addr = (e >> u(14)) & u(0xF)
+        tag = e >> u(19)
+        addr = (e >> u(14)) & u(0x1F)
         payload = e & u(0x3FFF)
-        i_src = addr >> u(2)
-        i_dst = addr & u(3)
+        i_src = addr >> u(3)
+        i_dst = addr & u(7)
 
         # dst server index per tag (clients' put goes to ci % 3, their get to
         # (ci+1) % 3 — actor/register.py:127,138-146; internal msgs carry it).
@@ -492,10 +514,15 @@ class PaxosCompiled(CompiledModel):
             lo = jnp.where(dsrv == u(s), state[2 * s], lo)
             hi = jnp.where(dsrv == u(s), state[2 * s + 1], hi)
 
+        p0 = self._PREP0
+        pw = 1 + self._ACC_BITS
         ballot = self._ext(lo, hi, *self._F_BALLOT)
         prop = self._ext(lo, hi, *self._F_PROP)
-        prep_p = [self._ext(lo, hi, 8 + 10 * s, 1) for s in range(S)]
-        prep_a = [self._ext(lo, hi, 9 + 10 * s, 9) for s in range(S)]
+        prep_p = [self._ext(lo, hi, p0 + pw * s, 1) for s in range(S)]
+        prep_a = [
+            self._ext(lo, hi, p0 + 1 + pw * s, self._ACC_BITS)
+            for s in range(S)
+        ]
         acc_bit = [self._ext(lo, hi, self._F_ACCEPTS + s, 1) for s in range(S)]
         accepted = self._ext(lo, hi, *self._F_ACCEPTED)
         decided = self._ext(lo, hi, *self._F_DECIDED)
@@ -505,7 +532,7 @@ class PaxosCompiled(CompiledModel):
         p2 = (dsrv + u(2)) % u(3)
 
         def mk(t, a, p):
-            return u(1) + ((u(t) << u(18)) | (a << u(14)) | p)
+            return u(1) + ((u(t) << u(19)) | (a << u(14)) | p)
 
         # --- Put (models/paxos.py:104-114) -----------------------------------
         put_ci = addr
@@ -516,29 +543,30 @@ class PaxosCompiled(CompiledModel):
         plo, phi = self._ins(plo, phi, *self._F_PROP, put_ci + u(1))
         for s in range(S):
             self_entry = dsrv == u(s)
-            plo, phi = self._ins(plo, phi, 8 + 10 * s, 1, self_entry)
+            plo, phi = self._ins(plo, phi, p0 + pw * s, 1, self_entry)
             plo, phi = self._ins(
-                plo, phi, 9 + 10 * s, 9, jnp.where(self_entry, accepted, u(0))
+                plo, phi, p0 + 1 + pw * s, self._ACC_BITS,
+                jnp.where(self_entry, accepted, u(0)),
             )
             plo, phi = self._ins(plo, phi, self._F_ACCEPTS + s, 1, u(0))
-        put_s0 = mk(_T_PREPARE, dsrv * u(4) + p1, r_new)
-        put_s1 = mk(_T_PREPARE, dsrv * u(4) + p2, r_new)
+        put_s0 = mk(_T_PREPARE, dsrv * u(8) + p1, r_new)
+        put_s1 = mk(_T_PREPARE, dsrv * u(8) + p2, r_new)
 
         # --- Get on a decided server (models/paxos.py:98-101) ----------------
         get_guard = decided == u(1)
         get_flag = get_guard & (accepted == u(0))
         get_v = u(1) + (accepted - u(1)) % u(c)
-        get_s0 = mk(_T_GETOK, dsrv * u(4) + addr, get_v)
+        get_s0 = mk(_T_GETOK, dsrv * u(8) + addr, get_v)
 
         # --- Prepare (models/paxos.py:116-123) -------------------------------
         prep_mb = payload * u(3) + i_src
         prepare_guard = not_dec & (ballot < prep_mb)
         qlo, qhi = self._ins(lo, hi, *self._F_BALLOT, prep_mb)
-        prepare_s0 = mk(_T_PREPARED, i_dst * u(4) + i_src, payload * u(256) + accepted)
+        prepare_s0 = mk(_T_PREPARED, i_dst * u(8) + i_src, payload * u(512) + accepted)
 
         # --- Prepared (models/paxos.py:125-143) ------------------------------
-        pd_mb = (payload // u(256)) * u(3) + i_dst
-        pd_acc = payload % u(256)
+        pd_mb = (payload // u(512)) * u(3) + i_dst
+        pd_acc = payload % u(512)
         prepared_guard = not_dec & (pd_mb == ballot)
         pd_p = [prep_p[s] | (i_src == u(s)).astype(u) for s in range(S)]
         pd_a = [
@@ -553,8 +581,10 @@ class PaxosCompiled(CompiledModel):
         pd_flag = prepared_guard & pd_trigger & (pd_prop == u(0))
         rlo, rhi = lo, hi
         for s in range(S):
-            rlo, rhi = self._ins(rlo, rhi, 8 + 10 * s, 1, pd_p[s])
-            rlo, rhi = self._ins(rlo, rhi, 9 + 10 * s, 9, pd_a[s])
+            rlo, rhi = self._ins(rlo, rhi, p0 + pw * s, 1, pd_p[s])
+            rlo, rhi = self._ins(
+                rlo, rhi, p0 + 1 + pw * s, self._ACC_BITS, pd_a[s]
+            )
         # Majority: adopt proposal, self-accept, broadcast Accept.
         tlo, thi = self._ins(rlo, rhi, *self._F_PROP, pd_prop)
         tlo, thi = self._ins(
@@ -566,22 +596,22 @@ class PaxosCompiled(CompiledModel):
             )
         rlo = jnp.where(pd_trigger, tlo, rlo)
         rhi = jnp.where(pd_trigger, thi, rhi)
-        pd_payload = (ballot // u(3)) * u(4) + (pd_prop - u(1))
+        pd_payload = (ballot // u(3)) * u(8) + (pd_prop - u(1))
         pd_s0 = jnp.where(
-            pd_trigger, mk(_T_ACCEPT, i_dst * u(4) + p1, pd_payload), u(0)
+            pd_trigger, mk(_T_ACCEPT, i_dst * u(8) + p1, pd_payload), u(0)
         )
         pd_s1 = jnp.where(
-            pd_trigger, mk(_T_ACCEPT, i_dst * u(4) + p2, pd_payload), u(0)
+            pd_trigger, mk(_T_ACCEPT, i_dst * u(8) + p2, pd_payload), u(0)
         )
 
         # --- Accept (models/paxos.py:145-153) --------------------------------
-        ac_mb = (payload // u(4)) * u(3) + i_src
+        ac_mb = (payload // u(8)) * u(3) + i_src
         accept_guard = not_dec & (ballot <= ac_mb)
         alo, ahi = self._ins(lo, hi, *self._F_BALLOT, ac_mb)
         alo, ahi = self._ins(
-            alo, ahi, *self._F_ACCEPTED, u(1) + ac_mb * u(c) + payload % u(4)
+            alo, ahi, *self._F_ACCEPTED, u(1) + ac_mb * u(c) + payload % u(8)
         )
-        accept_s0 = mk(_T_ACCEPTED, i_dst * u(4) + i_src, payload // u(4))
+        accept_s0 = mk(_T_ACCEPTED, i_dst * u(8) + i_src, payload // u(8))
 
         # --- Accepted (models/paxos.py:155-167) ------------------------------
         ad_mb = payload * u(3) + i_dst
@@ -596,22 +626,22 @@ class PaxosCompiled(CompiledModel):
         blo, bhi = self._ins(
             blo, bhi, *self._F_DECIDED, jnp.where(ad_trigger, u(1), u(0))
         )
-        ad_payload = ballot * u(4) + (prop - u(1))
+        ad_payload = ballot * u(8) + (prop - u(1))
         ad_s0 = jnp.where(
-            ad_trigger, mk(_T_DECIDED, i_dst * u(4) + p1, ad_payload), u(0)
+            ad_trigger, mk(_T_DECIDED, i_dst * u(8) + p1, ad_payload), u(0)
         )
         ad_s1 = jnp.where(
-            ad_trigger, mk(_T_DECIDED, i_dst * u(4) + p2, ad_payload), u(0)
+            ad_trigger, mk(_T_DECIDED, i_dst * u(8) + p2, ad_payload), u(0)
         )
         ad_s2 = jnp.where(
-            ad_trigger, mk(_T_PUTOK, i_dst * u(4) + (prop - u(1)), u(0)), u(0)
+            ad_trigger, mk(_T_PUTOK, i_dst * u(8) + (prop - u(1)), u(0)), u(0)
         )
 
         # --- Decided (models/paxos.py:169-175) -------------------------------
         decided_guard = not_dec
-        dlo, dhi = self._ins(lo, hi, *self._F_BALLOT, payload // u(4))
+        dlo, dhi = self._ins(lo, hi, *self._F_BALLOT, payload // u(8))
         dlo, dhi = self._ins(
-            dlo, dhi, *self._F_ACCEPTED, u(1) + (payload // u(4)) * u(c) + payload % u(4)
+            dlo, dhi, *self._F_ACCEPTED, u(1) + (payload // u(8)) * u(c) + payload % u(8)
         )
         dlo, dhi = self._ins(dlo, dhi, *self._F_DECIDED, u(1))
 
@@ -708,6 +738,11 @@ class PaxosCompiled(CompiledModel):
         cand = jnp.where(cand == u(0), ones, cand)
         cand = jnp.sort(cand)
         slot_overflow = valid & jnp.any(cand[m:] != ones)
+        # A duplicate send would make the host multiset count hit 2
+        # (send() INCREMENTS, src/actor/network.rs:209-211) — a legal host
+        # successor the one-copy-per-slot codec cannot represent, so it
+        # must flag as an engine error, never silently dedup.  The step
+        # differentials prove no reachable dup for this protocol.
         dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
         new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
 
@@ -736,7 +771,7 @@ class PaxosCompiled(CompiledModel):
         # (models/paxos.py:193-197).
         slots = state[self._NET0 : self._NET0 + self.m]
         e = slots - u(1)
-        getok = (slots != u(0)) & ((e >> u(18)) == u(_T_GETOK))
+        getok = (slots != u(0)) & ((e >> u(19)) == u(_T_GETOK))
         chosen = jnp.any(getok & ((e & u(0x3FFF)) != u(0)))
         conds = [lin, chosen]
         if self.model.cfg.never_decided:

@@ -50,6 +50,18 @@ class RegisterClientCodec:
         self.cli_word = cli_word
         self.tst0 = tst0
         self.lcb = 2 * (client_count - 1)
+        # Value codes 0..C (0 = NULL): width derived, not hard-coded, so
+        # bench-scale configs (paxos check 6, single-copy check 4 —
+        # reference bench.sh:27-34) pack correctly.
+        self.vb = max(2, client_count.bit_length())
+        # Tester word: phase(3) + two snapshots(lcb each) + value(vb); the
+        # client word holds 4 bits per client.  Both must fit one u32.
+        if 3 + 2 * self.lcb + self.vb > 32 or 4 * client_count > 32:
+            raise ValueError(
+                f"register harness supports at most 7 clients "
+                f"(got {client_count}: tester word needs "
+                f"{3 + 2 * self.lcb + self.vb} bits)"
+            )
         self.values = tuple(
             chr(ord("A") + i) for i in range(client_count)
         )
@@ -158,7 +170,7 @@ class RegisterClientCodec:
             h.history_by_thread[tid] = (entry_w,)
             h.in_flight_by_thread[tid] = (lc_r, READ)
             return
-        vcode = (bits >> (3 + 2 * lcb)) & 0x3
+        vcode = (bits >> (3 + 2 * lcb)) & ((1 << self.vb) - 1)
         h.history_by_thread[tid] = (
             entry_w,
             (lc_r, READ, ReadOk(self.value_of(vcode, null_value))),
@@ -257,7 +269,9 @@ class RegisterClientCodec:
         tw = [state[tst0 + i] for i in range(c)]
         phase = [w & u(7) for w in tw]
         lc_r = [(w >> u(3 + lcb)) & u((1 << lcb) - 1) for w in tw]
-        v_read = [(w >> u(3 + 2 * lcb)) & u(3) for w in tw]
+        v_read = [
+            (w >> u(3 + 2 * lcb)) & u((1 << self.vb) - 1) for w in tw
+        ]
 
         w_completed = [phase[i] >= u(2) for i in range(c)]
         w_present = [phase[i] >= u(1) for i in range(c)]

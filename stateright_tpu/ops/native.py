@@ -148,12 +148,16 @@ class NativeFpSet:
     """Concurrent fingerprint -> parent-fingerprint map.
 
     Parent 0 encodes "root / none" (fingerprints themselves are nonzero).
-    Raises RuntimeError when the fixed-capacity table fills.
+    Grows automatically at 3/4 load (DashMap-style), so ``capacity_pow2``
+    is only the initial table size.  This is the multi-thread visited set
+    of the host graph engines (core/engine.py, ``threads > 1``): inserts
+    release the GIL and contend per C++ stripe lock instead of serializing
+    on a Python-level lock.
     """
 
     __slots__ = ("_lib", "_ptr", "_capacity")
 
-    def __init__(self, capacity_pow2: int = 1 << 22):
+    def __init__(self, capacity_pow2: int = 1 << 16):
         lib = load()
         if lib is None:
             raise RuntimeError("native core unavailable")
@@ -166,10 +170,8 @@ class NativeFpSet:
     def insert(self, fp: int, parent: int = 0) -> bool:
         """Insert-if-absent; True iff newly inserted."""
         r = self._lib.sr_fpset_insert(self._ptr, fp, parent)
-        if r < 0:
-            raise RuntimeError(
-                f"native fingerprint set overfull (capacity {self._capacity})"
-            )
+        if r < 0:  # unreachable since the table grows; kept as a backstop
+            raise RuntimeError("native fingerprint set insert failed")
         return bool(r)
 
     def __contains__(self, fp: int) -> bool:

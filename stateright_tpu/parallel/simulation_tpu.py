@@ -87,6 +87,7 @@ class TpuSimulationChecker(Checker):
         self._max_depth = 0
         self._discovery_fps: Dict[str, List[int]] = {}
         self._discoveries_cache: Optional[Dict[str, Path]] = None
+        self._shutdown = threading.Event()
         self._done = threading.Event()
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
@@ -275,7 +276,7 @@ class TpuSimulationChecker(Checker):
             batch = self._build_batch()
             base = jax.random.PRNGKey(self._seed)
             round_idx = 0
-            while True:
+            while not self._shutdown.is_set():
                 keys = jax.vmap(
                     lambda w: jax.random.fold_in(
                         jax.random.fold_in(base, round_idx), w
@@ -363,6 +364,13 @@ class TpuSimulationChecker(Checker):
 
     def is_done(self) -> bool:
         return self._done.is_set()
+
+    def shutdown(self) -> None:
+        """Stop after the in-flight batch: without this, a run whose
+        ``finish_when`` never matches and that has neither ``timeout`` nor
+        ``target_state_count`` would walk forever (the host engine's
+        ``_shutdown`` event, core/simulation.py)."""
+        self._shutdown.set()
 
     def join(self) -> "TpuSimulationChecker":
         self._thread.join()

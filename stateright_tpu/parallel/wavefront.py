@@ -142,7 +142,7 @@ class TpuChecker(Checker):
         import jax.numpy as jnp
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, insert_batch
+        from .hashset import HashSet, insert_batch, insert_batch_compact
         from .wave_common import wave_eval
 
         cm = self._compiled
@@ -206,28 +206,43 @@ class TpuChecker(Checker):
             sc_hi = sc_hi + (new_lo < sc_lo).astype(jnp.uint32)
             sc_lo = new_lo
 
-            # Dedup + insert.
+            # Dedup + insert, in compact form: results come back U-sized
+            # (one lane per distinct key, U = B/dedup_factor), so the
+            # row/parent/ebits/queue scatters below cost O(distinct keys)
+            # instead of O(candidate lanes).  Profiling on the chip showed
+            # the B-indexed 42-word row scatter alone was ~2/3 of the
+            # 69 ms chunk — ~95% of candidate lanes are invalid or
+            # duplicates and paid full scatter price anyway.
             flat = nexts.reshape(f * a, w)
             flat_valid = valid.reshape(f * a)
-            par = jnp.repeat(safe_slots, a)
-            child_eb = jnp.repeat(eb, a)
             hi, lo = device_fp64(flat)
-            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+            (
+                table, u_slot, u_new, u_origin, _u_active, probe_ok,
+                dd_overflow,
+            ) = insert_batch_compact(
                 HashSet(key_hi, key_lo), hi, lo, flat_valid,
                 dedup_factor=dedup_factor,
             )
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
-            store = store.at[sslot].set(flat, mode="drop")
-            parent = parent.at[sslot].set(par, mode="drop")
-            ebits = ebits.at[sslot].set(child_eb, mode="drop")
-            n_new = jnp.sum(is_new, dtype=jnp.uint32)
+            # Representative row + its parent/ebits, gathered at the
+            # compact lanes (u_origin is the rep's original flat lane; the
+            # rep is the lowest lane of each key run, so first-inserter
+            # ebits semantics are unchanged).
+            rows = flat[u_origin]
+            src_state = u_origin // jnp.uint32(a)
+            par_u = safe_slots[src_state]
+            eb_u = eb[src_state]
+            sslot = jnp.where(u_new, u_slot, jnp.uint32(cap))
+            store = store.at[sslot].set(rows, mode="drop")
+            parent = parent.at[sslot].set(par_u, mode="drop")
+            ebits = ebits.at[sslot].set(eb_u, mode="drop")
+            n_new = jnp.sum(u_new, dtype=jnp.uint32)
             unique_count = unique_count + n_new
 
-            # Append new slots at the queue tail in lane order (cumsum
-            # positions keep discovery order deterministic).
-            qpos = tail + jnp.cumsum(is_new.astype(jnp.uint32)) - 1
-            qidx = jnp.where(is_new, qpos, jnp.uint32(qcap + f))
-            queue = queue.at[qidx].set(slot, mode="drop")
+            # Append new slots at the queue tail (sorted-key order within
+            # the chunk — deterministic, like the old lane order).
+            qpos = tail + jnp.cumsum(u_new.astype(jnp.uint32)) - 1
+            qidx = jnp.where(u_new, qpos, jnp.uint32(qcap + f))
+            queue = queue.at[qidx].set(u_slot, mode="drop")
             tail = tail + n_new
 
             # Advance within the level; roll the level boundary when drained.
@@ -600,6 +615,7 @@ class TpuChecker(Checker):
                 cm.max_actions,
                 self._capacity,
                 self._max_frontier,
+                self._dedup_factor,
                 tuple(p.name for p in self._properties),
                 init_digest,
             )

@@ -125,3 +125,104 @@ def test_spawn_tpu_abd_matches_host_oracle():
     assert tpu.max_depth() == host.max_depth()
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
     tpu.assert_properties()
+
+
+def abd_ordered_model(client_count: int):
+    return AbdModelCfg(
+        client_count=client_count,
+        server_count=2,
+        network=Network.new_ordered(),
+    ).into_model()
+
+
+def test_ordered_step_differential_full_reachable():
+    """FIFO-lane kernel vs host on the whole c=2 ordered space (620
+    states; reference bench fabric, src/actor/network.rs:60-68).  Ordered
+    no-op deliveries still consume the channel head and ARE successors
+    (actor/model.py:299), unlike the unordered fabrics."""
+    model = abd_ordered_model(2)
+    cm = AbdCompiled(model)
+    assert cm.ordered
+    seen = {}
+    frontier = list(model.init_states())
+    for s in frontier:
+        seen[fingerprint(s)] = s
+    step = jax.jit(cm.step)
+    while frontier:
+        nxt = []
+        for s in frontier:
+            enc = cm.encode(s)
+            assert cm.decode(enc) == s
+            host_succ = set()
+            acts = []
+            model.actions(s, acts)
+            for a in acts:
+                ns = model.next_state(s, a)
+                if ns is None:
+                    continue
+                host_succ.add(tuple(cm.encode(ns).tolist()))
+                fp = fingerprint(ns)
+                if fp not in seen:
+                    seen[fp] = ns
+                    nxt.append(ns)
+            nexts, valid, flag = step(jnp.asarray(enc))
+            assert not bool(flag), s
+            dev_succ = {
+                tuple(np.asarray(nexts[i]).tolist())
+                for i in range(nexts.shape[0])
+                if bool(valid[i])
+            }
+            assert dev_succ == host_succ, s
+        frontier = nxt
+    assert len(seen) == 620
+
+
+def test_spawn_tpu_abd_ordered_matches_host():
+    """`linearizable-register check 2` on the ordered fabric, end to end
+    on the device engine."""
+    tpu = (
+        abd_ordered_model(2)
+        .checker()
+        .spawn_tpu(capacity=1 << 13, max_frontier=1 << 8)
+        .join()
+    )
+    host = abd_ordered_model(2).checker().spawn_bfs().join()
+    assert host.unique_state_count() == 620
+    assert tpu.unique_state_count() == 620
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth() == 25
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_properties()
+
+
+@pytest.mark.slow
+def test_spawn_tpu_abd_ordered_check3_matches_host():
+    """The reference's long bench workload `linearizable-register check 3
+    ordered` (bench.sh:33): full golden parity host vs device."""
+    tpu = (
+        abd_ordered_model(3)
+        .checker()
+        .spawn_tpu(capacity=1 << 17, max_frontier=1 << 9)
+        .join()
+    )
+    host = abd_ordered_model(3).checker().spawn_bfs().join()
+    assert host.unique_state_count() == 46_516
+    assert tpu.unique_state_count() == 46_516
+    assert tpu.max_depth() == host.max_depth() == 37
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.slow
+def test_spawn_tpu_abd_unordered_check3_matches_host():
+    """3 clients on the nonduplicating fabric (cap was 2 in round 2)."""
+    tpu = (
+        abd_model(3)
+        .checker()
+        .spawn_tpu(capacity=1 << 17, max_frontier=1 << 9)
+        .join()
+    )
+    host = abd_model(3).checker().spawn_bfs().join()
+    assert host.unique_state_count() == 35_009
+    assert tpu.unique_state_count() == 35_009
+    assert tpu.max_depth() == host.max_depth() == 37
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())

@@ -95,9 +95,50 @@ def test_fpset_concurrent_inserts():
     assert all((i + 1) in s for i in range(0, 5000, 97))
 
 
-def test_fpset_overfull_raises():
+def test_fpset_grows_past_initial_capacity():
+    # DashMap-style: 3/4 load doubles the table, so a tiny initial
+    # capacity accepts arbitrarily many keys and keeps every parent.
     s = NativeFpSet(1 << 4)
-    for i in range(16):
-        s.insert(i + 1)
-    with pytest.raises(RuntimeError):
-        s.insert(99999)
+    for i in range(5000):
+        assert s.insert(i + 1, i + 100)
+    assert len(s) == 5000
+    for i in range(0, 5000, 113):
+        assert (i + 1) in s
+        assert s.parent(i + 1) == i + 100
+    assert 999999 not in s
+
+
+def test_fpset_concurrent_inserts_across_growth():
+    s = NativeFpSet(1 << 4)  # forces many growths under contention
+
+    def worker(tag):
+        for i in range(4000):
+            s.insert(i + 1, tag + 1)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(s) == 4000
+    assert all((i + 1) in s for i in range(0, 4000, 59))
+
+
+def test_graph_engine_uses_native_set_when_threaded():
+    # threads > 1 routes the visited set through the C++ facade; counts,
+    # discovery sets, and reconstructed paths must match the dict engine.
+    from stateright_tpu.core.engine import _NativeGenerated
+    from stateright_tpu.models.ping_pong import PingPongCfg
+
+    model = PingPongCfg(maintains_history=True, max_nat=5).into_model()
+    threaded = model.checker().threads(2).spawn_bfs().join()
+    assert isinstance(threaded._generated, _NativeGenerated)
+    single = model.checker().spawn_bfs().join()
+    assert not isinstance(single._generated, _NativeGenerated)
+    assert threaded.unique_state_count() == single.unique_state_count()
+    assert set(threaded.discoveries()) == set(single.discoveries())
+    for name, path in threaded.discoveries().items():
+        assert path.last_state() is not None
+
+    dfs = model.checker().threads(2).spawn_dfs().join()
+    assert dfs.unique_state_count() == single.unique_state_count()

@@ -355,3 +355,49 @@ def reachable_c1():
 @pytest.fixture(scope="module")
 def reachable_c2():
     return enumerate_reachable(paxos_model(2))
+
+
+@pytest.mark.slow
+def test_spawn_tpu_paxos_c4_depth_bounded_differential():
+    """4 clients — past the round-2 client cap, exercising the widened
+    proposal/value fields and base-8 envelope addressing.  Depth-bounded:
+    the full c=4 space exceeds suite runtime (the full-scale anchor is
+    bench.py's fatal golden on real hardware)."""
+    host = (
+        paxos_model(4)
+        .checker()
+        .target_max_depth(9)
+        .spawn_bfs()
+        .join()
+    )
+    tpu = (
+        paxos_model(4)
+        .checker()
+        .target_max_depth(9)
+        .spawn_tpu(capacity=1 << 20, max_frontier=1 << 10)
+        .join()
+    )
+    assert host.unique_state_count() == 8_352
+    assert tpu.unique_state_count() == 8_352
+    assert tpu.max_depth() == host.max_depth() == 9
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def test_paxos_check6_codec_compiles():
+    """`paxos check 6` (the reference bench workload, bench.sh:28) must at
+    least construct, round-trip its init states, and lower the step kernel
+    + property predicates to HLO.  (Full checking at c=6 is bounded by the
+    linearizability DP's 2^(2C) subset space — see the cost-curve note in
+    docs/TPU_PAXOS_DESIGN.md.)"""
+    import jax
+    import jax.numpy as jnp
+
+    model = paxos_model(6)
+    cm = PaxosCompiled(model)
+    assert cm.c == 6 and cm.m == 64
+    for s in model.init_states():
+        enc = cm.encode(s)
+        assert cm.decode(enc) == s
+    enc0 = jnp.asarray(cm.encode(next(iter(model.init_states()))))
+    jax.jit(cm.step).lower(enc0)
+    jax.jit(cm.property_conds).lower(enc0)

@@ -136,3 +136,56 @@ def test_two_servers_violation_found_on_device():
     assert host.unique_state_count() < 62
     path = tpu.discoveries()["linearizable"]
     assert path.last_state().history.serialized_history() is None
+
+
+def test_spawn_tpu_single_copy_c3_matches_host():
+    """3 clients / 1 server — first config past the round-2 client cap."""
+    model = sc_model(3, 1)
+    tpu = (
+        model.checker().spawn_tpu(capacity=1 << 14, max_frontier=1 << 8).join()
+    )
+    host = sc_model(3, 1).checker().spawn_bfs().join()
+    assert host.unique_state_count() == 4_243
+    assert tpu.unique_state_count() == 4_243
+    assert tpu.max_depth() == host.max_depth() == 13
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.slow
+def test_spawn_tpu_single_copy_check4_depth_bounded():
+    """The reference bench workload `single-copy-register check 4`
+    (bench.sh:29: 4 clients, 1 server), depth-bounded for suite runtime;
+    the full-space parity (400,233 unique / depth 17, host-measured) runs
+    on real hardware via the tpu-marked test below."""
+    host = (
+        sc_model(4, 1)
+        .checker()
+        .target_max_depth(11)
+        .spawn_bfs()
+        .join()
+    )
+    tpu = (
+        sc_model(4, 1)
+        .checker()
+        .target_max_depth(11)
+        .spawn_tpu(capacity=1 << 19, max_frontier=1 << 10)
+        .join()
+    )
+    assert host.unique_state_count() == 33_849
+    assert tpu.unique_state_count() == 33_849
+    assert tpu.max_depth() == host.max_depth() == 11
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.tpu
+def test_spawn_tpu_single_copy_check4_full_device():
+    """Full `single-copy-register check 4` on real hardware, against the
+    host-measured golden (400,233 unique / depth 17)."""
+    tpu = (
+        sc_model(4, 1)
+        .checker()
+        .spawn_tpu(capacity=1 << 21, max_frontier=1 << 11)
+        .join()
+    )
+    assert tpu.unique_state_count() == 400_233
+    assert tpu.max_depth() == 17
