@@ -61,6 +61,7 @@ class CliSpec:
         spawn: Optional[Callable[[], Any]] = None,
         default_address: str = "localhost:3017",
         target_max_depth: Optional[int] = None,
+        tpu_target_max_depth: Optional[int] = None,
     ):
         self.name = name
         self.build = build
@@ -73,6 +74,11 @@ class CliSpec:
         self.spawn = spawn
         self.default_address = default_address
         self.target_max_depth = target_max_depth
+        # Device-run depth override: raft's reference default (12) needs
+        # ~4x10^7 stored states — beyond one chip's HBM at its state
+        # width — so its check-tpu bounds depth where a single chip can
+        # hold the store (models/raft_compiled.py documents the math).
+        self.tpu_target_max_depth = tpu_target_max_depth
 
 
 def _parse_n(args, default):
@@ -127,7 +133,9 @@ def example_main(spec: CliSpec, argv=None) -> int:
         print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
               + (f", network={network.kind}" if network is not None else ""))
         builder = model.checker().threads(threads)
-        if spec.target_max_depth is not None:
+        if sub == "check-tpu" and spec.tpu_target_max_depth is not None:
+            builder = builder.target_max_depth(spec.tpu_target_max_depth)
+        elif spec.target_max_depth is not None:
             # Some examples bound their default check (e.g. raft's
             # target_max_depth(12), examples/raft.rs:520-535).
             builder = builder.target_max_depth(spec.target_max_depth)

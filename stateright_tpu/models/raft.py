@@ -401,7 +401,14 @@ class RaftModelCfg:
         model.add_actors(
             RaftActor(self.server_count) for _ in range(self.server_count)
         )
-        return (
+
+        def _compiled():
+            from .raft_compiled import RaftCompiled
+
+            return RaftCompiled(model)
+
+        model.compiled = _compiled
+        model = (
             model.init_network_(network)
             .max_crashes_((self.server_count - 1) // 2)
             .property(
@@ -421,6 +428,7 @@ class RaftModelCfg:
                 Expectation.ALWAYS, "State Machine Safety", state_machine_safety
             )
         )
+        return model
 
 
 def main(argv=None) -> int:
@@ -437,6 +445,9 @@ def main(argv=None) -> int:
             n_meta="SERVER_COUNT",
             default_network="unordered_nonduplicating",
             target_max_depth=12,
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 10),
+            tpu_target_max_depth=9,
         ),
         argv,
     )

@@ -1,0 +1,1144 @@
+"""Bit-packed codec + device step kernel for the raft workload.
+
+This is the proof that the compiled path generalizes beyond the register
+harness: raft (models/raft.py, reference examples/raft.rs) exercises every
+action family the reference enumerates (src/actor/model.rs:269-333) except
+SelectRandom — **Deliver** with heterogeneous message kinds and multiset
+counts > 1 (replication-timeout resends duplicate in-flight LogRequests),
+**Timeout** with two timers per node, and **Crash/Recover** with
+``max_crashes(1)`` — plus log truncation/repair, quorum commits, and
+buffered client broadcasts.
+
+Layout (3 servers, packed into ``state_width`` uint32 words):
+
+- words 0..5: three node records, 2 words (56 bits) each — term(3),
+  voted_for(2), role(2), leader(2), votes bitmap(3), commit(3), log_len(3),
+  4 log entries of term(3)+payload(2), sent_length 3x3, acked_length 3x3;
+- word 6: timer bitmap (2 bits per node: ELECTION, REPLICATION) +
+  crashed bitmap (3 bits);
+- words 7..7+2M: M sorted 2-word envelope codes — the nonduplicating
+  *multiset*, duplicates represented as repeated codes (counts up to 5 are
+  reachable, so unlike the register models a duplicate is data, not an
+  error);
+- last 3 words (EXCLUDED from state identity via ``fp_words``): per-node
+  delivered_messages + buffer.  The reference's manual ``Hash`` impl
+  excludes exactly these (examples/raft.rs:39-56), so two states differing
+  only here merge to the first-inserted representative — on device exactly
+  as in the host engines.
+
+The reference's default check is ``target_max_depth(12)`` BFS
+(examples/raft.rs:520-535).  The full depth-12 space is ~4x10^7 states
+(host-measured growth of ~3.6x per level from 225,379 at depth 9) — weeks
+of host BFS and beyond a single chip's HBM at this state width — so the
+device gates pin exact host parity at depth 8 (61,702) on the CPU backend
+and depth 9 (225,379) on real hardware, with crash/recover lanes reachable
+from depth 2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..parallel.compiled import CompiledModel
+from .raft import (
+    Broadcast,
+    CANDIDATE,
+    ELECTION_TIMEOUT,
+    FOLLOWER,
+    LEADER,
+    LogEntry,
+    LogRequest,
+    LogResponse,
+    NodeState,
+    REPLICATION_TIMEOUT,
+    VoteRequest,
+    VoteResponse,
+)
+
+N = 3  # servers (the reference's default check config)
+TERM_CAP = 7  # 3 bits; depth-9 max observed is 4 — encode flags overflow
+LOG_CAP = 4  # entries; depth-9 max observed is 2
+BUF_CAP = 3
+DELIV_CAP = 5
+NET_SLOTS = 24  # depth-9 in-flight peak is 14; overflow flags loudly
+SENDS = 5  # max messages one transition emits (leader election drain)
+
+_T_VOTE_REQ, _T_VOTE_RESP, _T_LOG_REQ, _T_LOG_RESP, _T_BCAST = 1, 2, 3, 4, 5
+
+# node-record field offsets (56 bits over a lo/hi u32 pair)
+_F_TERM = (0, 3)
+_F_VOTED = (3, 2)  # 0 none, 1+i
+_F_ROLE = (5, 2)
+_F_LEADER = (7, 2)  # 0 none, 1+i
+_F_VOTES = 9  # +i, 1 bit each
+_F_COMMIT = (12, 3)
+_F_LOGLEN = (15, 3)
+_LOG0 = 18  # + 5*e: term(3) + payload(2)
+_F_SENT0 = 18 + 5 * LOG_CAP  # + 3*i
+_F_ACKED0 = _F_SENT0 + 9  # + 3*i
+
+
+class RaftCompiled(CompiledModel):
+    """Codec + device step kernel for ``RaftModelCfg.into_model()``."""
+
+    step_flags = True
+
+    def __init__(self, model):
+        self.model = model
+        cfg = model.cfg
+        if cfg.server_count != N:
+            raise ValueError("packed raft fixes server_count=3")
+        if model.lossy_network:
+            raise ValueError("packed raft supports lossless networks")
+        if model.max_crashes > 1:
+            raise ValueError(
+                "packed raft supports max_crashes <= 1 (the reference "
+                "default, (n-1)//2 for n=3)"
+            )
+        if model.init_network.kind != "unordered_nonduplicating":
+            raise ValueError(
+                "packed raft supports the unordered_nonduplicating network"
+            )
+        self.max_crashes = model.max_crashes
+        self.m = NET_SLOTS
+        self._NET0 = 2 * N + 1
+        self._NONFP0 = self._NET0 + 2 * self.m
+        self.state_width = self._NONFP0 + N
+        self.fp_words = self._NONFP0
+        # m deliver lanes + per-node election timeout, replication
+        # timeout, crash, recover.
+        self.max_actions = self.m + 4 * N
+
+    def cache_key(self):
+        return (type(self).__qualname__, self.max_crashes)
+
+    # --- node record ----------------------------------------------------------
+
+    def _encode_node(self, s: NodeState) -> int:
+        if s.current_term > TERM_CAP:
+            raise ValueError(f"term {s.current_term} exceeds TERM_CAP")
+        if len(s.log) > LOG_CAP:
+            raise ValueError(f"log length {len(s.log)} exceeds LOG_CAP")
+        bits = s.current_term
+        bits |= (0 if s.voted_for is None else 1 + s.voted_for) << _F_VOTED[0]
+        bits |= s.current_role << _F_ROLE[0]
+        bits |= (
+            0 if s.current_leader is None else 1 + s.current_leader
+        ) << _F_LEADER[0]
+        for v in s.votes_received:
+            bits |= 1 << (_F_VOTES + v)
+        bits |= s.commit_length << _F_COMMIT[0]
+        bits |= len(s.log) << _F_LOGLEN[0]
+        for e, entry in enumerate(s.log):
+            if entry.term > TERM_CAP:
+                raise ValueError("log entry term exceeds TERM_CAP")
+            payload = int(entry.payload)
+            bits |= (entry.term | (payload << 3)) << (_LOG0 + 5 * e)
+        for i in range(N):
+            if s.sent_length[i] > LOG_CAP or s.acked_length[i] > LOG_CAP:
+                raise ValueError("sent/acked length exceeds LOG_CAP")
+            bits |= s.sent_length[i] << (_F_SENT0 + 3 * i)
+            bits |= s.acked_length[i] << (_F_ACKED0 + 3 * i)
+        return bits
+
+    def _decode_node(self, bits: int, idx: int, nonfp: int) -> NodeState:
+        log_len = (bits >> _F_LOGLEN[0]) & 7
+        log = []
+        for e in range(log_len):
+            ent = (bits >> (_LOG0 + 5 * e)) & 0x1F
+            log.append(LogEntry(ent & 7, str(ent >> 3).encode()))
+        voted = (bits >> _F_VOTED[0]) & 3
+        leader = (bits >> _F_LEADER[0]) & 3
+        dlen = nonfp & 7
+        delivered = tuple(
+            str((nonfp >> (3 + 2 * j)) & 3).encode() for j in range(dlen)
+        )
+        blen = (nonfp >> 13) & 3
+        buffer = tuple(
+            str((nonfp >> (15 + 2 * j)) & 3).encode() for j in range(blen)
+        )
+        return NodeState(
+            id=idx,
+            current_term=bits & 7,
+            voted_for=None if voted == 0 else voted - 1,
+            log=tuple(log),
+            commit_length=(bits >> _F_COMMIT[0]) & 7,
+            current_role=(bits >> _F_ROLE[0]) & 3,
+            current_leader=None if leader == 0 else leader - 1,
+            votes_received=frozenset(
+                v for v in range(N) if (bits >> (_F_VOTES + v)) & 1
+            ),
+            sent_length=tuple(
+                (bits >> (_F_SENT0 + 3 * i)) & 7 for i in range(N)
+            ),
+            acked_length=tuple(
+                (bits >> (_F_ACKED0 + 3 * i)) & 7 for i in range(N)
+            ),
+            delivered_messages=delivered,
+            buffer=buffer,
+        )
+
+    def _encode_nonfp(self, s: NodeState) -> int:
+        if len(s.delivered_messages) > DELIV_CAP:
+            raise ValueError("delivered_messages exceeds DELIV_CAP")
+        if len(s.buffer) > BUF_CAP:
+            raise ValueError("buffer exceeds BUF_CAP")
+        bits = len(s.delivered_messages)
+        for j, p in enumerate(s.delivered_messages):
+            bits |= int(p) << (3 + 2 * j)
+        bits |= len(s.buffer) << 13
+        for j, p in enumerate(s.buffer):
+            bits |= int(p) << (15 + 2 * j)
+        return bits
+
+    # --- envelope codes (2 words) ---------------------------------------------
+
+    def _env_code64(self, env: Envelope) -> Tuple[int, int]:
+        """w0: tag(3) | src(2) | dst(2) | fields a/b/c/d/e (3 bits each at
+        7/10/13/16/19); w1: LogRequest suffix entries (5 bits each)."""
+        msg = env.msg
+        src, dst = int(env.src), int(env.dst)
+        w0 = src << 3 | dst << 5
+        w1 = 0
+        if isinstance(msg, VoteRequest):
+            assert msg.cid == src
+            if msg.cterm > TERM_CAP or msg.clog_term > TERM_CAP:
+                raise ValueError("VoteRequest term exceeds TERM_CAP")
+            w0 |= _T_VOTE_REQ | msg.cterm << 7 | msg.clog_length << 10
+            w0 |= msg.clog_term << 13
+        elif isinstance(msg, VoteResponse):
+            assert msg.voter_id == src
+            if msg.term > TERM_CAP:
+                raise ValueError("VoteResponse term exceeds TERM_CAP")
+            w0 |= _T_VOTE_RESP | msg.term << 7 | int(msg.granted) << 10
+        elif isinstance(msg, LogRequest):
+            assert msg.leader_id == src
+            if msg.term > TERM_CAP or msg.prefix_term > TERM_CAP:
+                raise ValueError("LogRequest term exceeds TERM_CAP")
+            if len(msg.suffix) > LOG_CAP:
+                raise ValueError("LogRequest suffix exceeds LOG_CAP")
+            w0 |= _T_LOG_REQ | msg.term << 7 | msg.prefix_len << 10
+            w0 |= msg.prefix_term << 13 | msg.leader_commit << 16
+            w0 |= len(msg.suffix) << 19
+            for e, entry in enumerate(msg.suffix):
+                w1 |= (entry.term | (int(entry.payload) << 3)) << (5 * e)
+        elif isinstance(msg, LogResponse):
+            assert msg.follower == src
+            if msg.term > TERM_CAP:
+                raise ValueError("LogResponse term exceeds TERM_CAP")
+            w0 |= _T_LOG_RESP | msg.term << 7 | msg.ack << 10
+            w0 |= int(msg.success) << 13
+        elif isinstance(msg, Broadcast):
+            w0 |= _T_BCAST | int(msg.payload) << 7
+        else:
+            raise ValueError(f"unknown message {msg!r}")
+        return w0, w1
+
+    def _env_of64(self, w0: int, w1: int) -> Envelope:
+        tag = w0 & 7
+        src = (w0 >> 3) & 3
+        dst = (w0 >> 5) & 3
+        a = (w0 >> 7) & 7
+        b = (w0 >> 10) & 7
+        c = (w0 >> 13) & 7
+        d = (w0 >> 16) & 7
+        e = (w0 >> 19) & 7
+        if tag == _T_VOTE_REQ:
+            msg: Any = VoteRequest(src, a, b, c)
+        elif tag == _T_VOTE_RESP:
+            msg = VoteResponse(src, a, bool(b))
+        elif tag == _T_LOG_REQ:
+            suffix = tuple(
+                LogEntry(
+                    (w1 >> (5 * j)) & 7, str((w1 >> (5 * j + 3)) & 3).encode()
+                )
+                for j in range(e)
+            )
+            msg = LogRequest(src, a, b, c, d, suffix)
+        elif tag == _T_LOG_RESP:
+            msg = LogResponse(src, a, b, bool(c & 1))
+        elif tag == _T_BCAST:
+            msg = Broadcast(str(a & 3).encode())
+        else:
+            raise ValueError(f"bad envelope tag {tag}")
+        return Envelope(Id(src), Id(dst), msg)
+
+    # --- full state -----------------------------------------------------------
+
+    def encode(self, st: ActorModelState) -> np.ndarray:
+        words = np.zeros(self.state_width, dtype=np.uint32)
+        for i in range(N):
+            bits = self._encode_node(st.actor_states[i])
+            words[2 * i] = bits & 0xFFFFFFFF
+            words[2 * i + 1] = bits >> 32
+        tbits = 0
+        for i in range(N):
+            if ELECTION_TIMEOUT in st.timers_set[i]:
+                tbits |= 1 << (2 * i)
+            if REPLICATION_TIMEOUT in st.timers_set[i]:
+                tbits |= 1 << (2 * i + 1)
+            if st.crashed[i]:
+                tbits |= 1 << (2 * N + i)
+        words[2 * N] = tbits
+        codes: List[Tuple[int, int]] = []
+        for env, count in st.network.counts:
+            codes.extend([self._env_code64(env)] * count)
+        if len(codes) > self.m:
+            raise ValueError(
+                f"{len(codes)} in-flight messages exceed {self.m} slots"
+            )
+        codes.sort()
+        for k, (w0, w1) in enumerate(codes):
+            words[self._NET0 + 2 * k] = w0
+            words[self._NET0 + 2 * k + 1] = w1
+        for i in range(N):
+            words[self._NONFP0 + i] = self._encode_nonfp(st.actor_states[i])
+        return words
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        nodes = tuple(
+            self._decode_node(
+                int(words[2 * i]) | (int(words[2 * i + 1]) << 32),
+                i,
+                int(words[self._NONFP0 + i]),
+            )
+            for i in range(N)
+        )
+        tbits = int(words[2 * N])
+        timers = tuple(
+            frozenset(
+                ([ELECTION_TIMEOUT] if (tbits >> (2 * i)) & 1 else [])
+                + ([REPLICATION_TIMEOUT] if (tbits >> (2 * i + 1)) & 1 else [])
+            )
+            for i in range(N)
+        )
+        crashed = tuple(bool((tbits >> (2 * N + i)) & 1) for i in range(N))
+        counts: dict = {}
+        for k in range(self.m):
+            w0 = int(words[self._NET0 + 2 * k])
+            w1 = int(words[self._NET0 + 2 * k + 1])
+            if w0:
+                env = self._env_of64(w0, w1)
+                counts[env] = counts.get(env, 0) + 1
+        network = Network(
+            kind="unordered_nonduplicating", counts=frozenset(counts.items())
+        )
+        return ActorModelState(
+            actor_states=nodes,
+            network=network,
+            timers_set=timers,
+            random_choices=((),) * N,
+            crashed=crashed,
+            history=self.model.init_history,
+            actor_storages=(None,) * N,
+        )
+
+    # --- device side ----------------------------------------------------------
+
+    @staticmethod
+    def _ext(lo, hi, off: int, width: int):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        mask = u((1 << width) - 1)
+        if off + width <= 32:
+            return (lo >> u(off)) & mask
+        if off >= 32:
+            return (hi >> u(off - 32)) & mask
+        return ((lo >> u(off)) | (hi << u(32 - off))) & mask
+
+    @staticmethod
+    def _ins(lo, hi, off: int, width: int, val):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        m = (1 << width) - 1
+        val = val.astype(jnp.uint32) if hasattr(val, "astype") else u(val)
+        if off + width <= 32:
+            lo = (lo & u(~(m << off) & 0xFFFFFFFF)) | (val << u(off))
+        elif off >= 32:
+            o = off - 32
+            hi = (hi & u(~(m << o) & 0xFFFFFFFF)) | (val << u(o))
+        else:
+            nlo = 32 - off
+            lo = (lo & u(~((m & ((1 << nlo) - 1)) << off) & 0xFFFFFFFF)) | (
+                (val & u((1 << nlo) - 1)) << u(off)
+            )
+            hi = (hi & u(~(m >> nlo) & 0xFFFFFFFF)) | (val >> u(nlo))
+        return lo, hi
+
+    def step(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jnp.arange(self.m, dtype=jnp.uint32)
+        dn, dv, df = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        outs = [(dn, dv, df)]
+        for i in range(N):
+            for fn in (
+                self._election_lane,
+                self._replication_lane,
+                self._crash_lane,
+                self._recover_lane,
+            ):
+                ns, valid, flag = fn(state, i)
+                outs.append((ns[None], valid[None], flag[None]))
+        nexts = jnp.concatenate([o[0] for o in outs])
+        valid = jnp.concatenate([o[1] for o in outs])
+        flags = jnp.concatenate([o[2] for o in outs])
+        return nexts, valid, jnp.any(flags & valid)
+
+    # --- shared kernel helpers -----------------------------------------------
+
+    def _node(self, state, i_dyn):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        lo = u(0)
+        hi = u(0)
+        for i in range(N):
+            sel = i_dyn == u(i)
+            lo = jnp.where(sel, state[2 * i], lo)
+            hi = jnp.where(sel, state[2 * i + 1], hi)
+        return lo, hi
+
+    def _fields(self, lo, hi):
+        ext = self._ext
+        return dict(
+            term=ext(lo, hi, *_F_TERM),
+            voted=ext(lo, hi, *_F_VOTED),
+            role=ext(lo, hi, *_F_ROLE),
+            leader=ext(lo, hi, *_F_LEADER),
+            votes=[ext(lo, hi, _F_VOTES + v, 1) for v in range(N)],
+            commit=ext(lo, hi, *_F_COMMIT),
+            loglen=ext(lo, hi, *_F_LOGLEN),
+            log=[ext(lo, hi, _LOG0 + 5 * e, 5) for e in range(LOG_CAP)],
+            sent=[ext(lo, hi, _F_SENT0 + 3 * i, 3) for i in range(N)],
+            acked=[ext(lo, hi, _F_ACKED0 + 3 * i, 3) for i in range(N)],
+        )
+
+    @staticmethod
+    def _sel_entry(entries, idx):
+        """entries[idx] via where-chain (idx dynamic, entries static list)."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        out = u(0)
+        for e, w in enumerate(entries):
+            out = jnp.where(idx == u(e), w, out)
+        return out
+
+    def _last_term(self, f):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        lt = self._sel_entry(f["log"], f["loglen"] - jnp.uint32(1)) & u(7)
+        return jnp.where(f["loglen"] == u(0), u(0), lt)
+
+    def _mk_logreq(self, me, peer, f):
+        """The reference's replicate_log send (models/raft.py:308-322):
+        LogRequest(me, term, sent[peer], term-of-entry-before, commit,
+        log[sent[peer]:]) as a (w0, w1) pair."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        plen = self._sel_entry(f["sent"], peer)
+        pterm = jnp.where(
+            plen == u(0),
+            u(0),
+            self._sel_entry(f["log"], plen - u(1)) & u(7),
+        )
+        slen = f["loglen"] - plen
+        w0 = (
+            u(_T_LOG_REQ)
+            | (me << u(3))
+            | (peer << u(5))
+            | (f["term"] << u(7))
+            | (plen << u(10))
+            | (pterm << u(13))
+            | (f["commit"] << u(16))
+            | (slen << u(19))
+        )
+        w1 = u(0)
+        for j in range(LOG_CAP):
+            src_entry = self._sel_entry(f["log"], plen + u(j))
+            w1 = w1 | jnp.where(
+                u(j) < slen, src_entry << u(5 * j), u(0)
+            )
+        return w0, w1
+
+    # --- lanes ----------------------------------------------------------------
+
+    def _deliver_lane(self, state, k):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        net0 = self._NET0
+        m = self.m
+
+        w0s = [state[net0 + 2 * j] for j in range(m)]
+        w1s = [state[net0 + 2 * j + 1] for j in range(m)]
+        w0 = self._sel_entry(w0s, k)
+        w1 = self._sel_entry(w1s, k)
+        occupied = w0 != u(0)
+        # One Deliver action per DISTINCT envelope (the host enumerates
+        # iter_deliverable over distinct multiset keys): only the first of
+        # an equal run of sorted codes is a valid lane.
+        prev0 = self._sel_entry([u(0)] + w0s[:-1], k)
+        prev1 = self._sel_entry([u(0)] + w1s[:-1], k)
+        first = (k == u(0)) | (prev0 != w0) | (prev1 != w1)
+
+        tag = w0 & u(7)
+        src = (w0 >> u(3)) & u(3)
+        dst = (w0 >> u(5)) & u(3)
+        a = (w0 >> u(7)) & u(7)
+        b = (w0 >> u(10)) & u(7)
+        c = (w0 >> u(13)) & u(7)
+        d = (w0 >> u(16)) & u(7)
+        e = (w0 >> u(19)) & u(7)
+
+        tbits = state[2 * N]
+        dst_crashed = (tbits >> (u(2 * N) + dst)) & u(1)
+
+        lo, hi = self._node(state, dst)
+        f = self._fields(lo, hi)
+        nonfp = self._sel_entry(
+            [state[self._NONFP0 + i] for i in range(N)], dst
+        )
+        flag = jnp.zeros((), jnp.bool_)
+
+        # ---- VoteRequest (models/raft.py:143-167) ----
+        vr_newer = a > f["term"]
+        vr_term = jnp.where(vr_newer, a, f["term"])
+        vr_role = jnp.where(vr_newer, u(FOLLOWER), f["role"])
+        vr_voted = jnp.where(vr_newer, u(0), f["voted"])
+        last_term = self._last_term(f)
+        vr_log_ok = (c > last_term) | (
+            (c == last_term) & (b >= f["loglen"])
+        )
+        vr_granted = (
+            (a == vr_term)
+            & vr_log_ok
+            & ((vr_voted == u(0)) | (vr_voted == src + u(1)))
+        )
+        vr_voted2 = jnp.where(vr_granted, src + u(1), vr_voted)
+        vr_lo, vr_hi = self._ins(lo, hi, *_F_TERM, vr_term)
+        vr_lo, vr_hi = self._ins(vr_lo, vr_hi, *_F_ROLE, vr_role)
+        vr_lo, vr_hi = self._ins(vr_lo, vr_hi, *_F_VOTED, vr_voted2)
+        vr_send0 = (
+            u(_T_VOTE_RESP)
+            | (dst << u(3))
+            | (src << u(5))
+            | (vr_term << u(7))
+            | (vr_granted.astype(u) << u(10))
+        )
+
+        # ---- VoteResponse (models/raft.py:169-204) ----
+        resp_granted = b == u(1)
+        grant_path = (
+            (f["role"] == u(CANDIDATE)) & (a == f["term"]) & resp_granted
+        )
+        votes2 = [
+            jnp.where(src == u(v), u(1), f["votes"][v]) for v in range(N)
+        ]
+        vcount = sum(votes2)
+        win = grant_path & (vcount >= u(2))
+        # Drain buffer on becoming leader (models/raft.py:183,358-363):
+        # each buffered payload is re-broadcast to self.
+        blen = (nonfp >> u(13)) & u(3)
+        resp_sends0 = [u(0)] * SENDS
+        resp_sends1 = [u(0)] * SENDS
+        for j in range(BUF_CAP):
+            payload = (nonfp >> u(15 + 2 * j)) & u(3)
+            resp_sends0[j] = jnp.where(
+                win & (u(j) < blen),
+                u(_T_BCAST)
+                | (dst << u(3))
+                | (dst << u(5))
+                | (payload << u(7)),
+                u(0),
+            )
+        nonfp_resp = jnp.where(win, nonfp & u((1 << 13) - 1), nonfp)
+        # sent[i!=me] = len(log); acked[i!=me] = 0; then replicate.
+        rs_lo, rs_hi = lo, hi
+        for v in range(N):
+            rs_lo, rs_hi = self._ins(
+                rs_lo, rs_hi, _F_VOTES + v, 1, votes2[v]
+            )
+        w_lo, w_hi = self._ins(rs_lo, rs_hi, *_F_ROLE, u(LEADER))
+        w_lo, w_hi = self._ins(w_lo, w_hi, *_F_LEADER, dst + u(1))
+        for i in range(N):
+            is_peer = dst != u(i)
+            cur_sent = self._ext(w_lo, w_hi, _F_SENT0 + 3 * i, 3)
+            cur_acked = self._ext(w_lo, w_hi, _F_ACKED0 + 3 * i, 3)
+            w_lo, w_hi = self._ins(
+                w_lo, w_hi, _F_SENT0 + 3 * i, 3,
+                jnp.where(is_peer, f["loglen"], cur_sent),
+            )
+            w_lo, w_hi = self._ins(
+                w_lo, w_hi, _F_ACKED0 + 3 * i, 3,
+                jnp.where(is_peer, u(0), cur_acked),
+            )
+        wf = self._fields(w_lo, w_hi)
+        # The two peers of dst (dynamic): {0,1,2} minus dst.
+        p1 = jnp.where(dst == u(0), u(1), u(0))
+        p2 = jnp.where(dst == u(2), u(1), u(2))
+        lr1_w0, lr1_w1 = self._mk_logreq(dst, p1, wf)
+        lr2_w0, lr2_w1 = self._mk_logreq(dst, p2, wf)
+        resp_sends0[BUF_CAP] = jnp.where(win, lr1_w0, u(0))
+        resp_sends1[BUF_CAP] = jnp.where(win, lr1_w1, u(0))
+        resp_sends0[BUF_CAP + 1] = jnp.where(win, lr2_w0, u(0))
+        resp_sends1[BUF_CAP + 1] = jnp.where(win, lr2_w1, u(0))
+        vresp_lo = jnp.where(win, w_lo, rs_lo)
+        vresp_hi = jnp.where(win, w_hi, rs_hi)
+        # stale-term path: step down, renew election timer.
+        vresp_stale = ~grant_path & (a > f["term"])
+        st_lo, st_hi = self._ins(lo, hi, *_F_TERM, a)
+        st_lo, st_hi = self._ins(st_lo, st_hi, *_F_ROLE, u(FOLLOWER))
+        st_lo, st_hi = self._ins(st_lo, st_hi, *_F_VOTED, u(0))
+        vresp_lo = jnp.where(vresp_stale, st_lo, vresp_lo)
+        vresp_hi = jnp.where(vresp_stale, st_hi, vresp_hi)
+        vresp_valid = grant_path | vresp_stale
+        vresp_set_e = vresp_stale
+
+        # ---- LogRequest (models/raft.py:206-232) ----
+        lr_newer = a > f["term"]
+        lr_term = jnp.where(lr_newer, a, f["term"])
+        lr_voted = jnp.where(lr_newer, u(0), f["voted"])
+        lr_eq = a == lr_term
+        lr_role = jnp.where(lr_eq, u(FOLLOWER), f["role"])
+        lr_leader = jnp.where(lr_eq, src + u(1), f["leader"])
+        lr_set_e = lr_newer | lr_eq
+        prefix_ok = (b == u(0)) | (
+            (self._sel_entry(f["log"], b - u(1)) & u(7)) == c
+        )
+        lr_log_ok = (f["loglen"] >= b) & prefix_ok
+        do_append = lr_eq & lr_log_ok
+        # _append_entries (models/raft.py:324-341)
+        suffix = [(w1 >> u(5 * j)) & u(0x1F) for j in range(LOG_CAP)]
+        idx = jnp.minimum(f["loglen"], b + e) - u(1)
+        log_t = self._sel_entry(f["log"], idx) & u(7)
+        suf_t = self._sel_entry(suffix, idx - b) & u(7)
+        truncate = (e > u(0)) & (f["loglen"] > b) & (log_t != suf_t)
+        base_len = jnp.where(truncate, b, f["loglen"])
+        new_len = jnp.maximum(base_len, b + e)
+        flag = flag | (do_append & (new_len > u(LOG_CAP)))
+        new_log = []
+        for p in range(LOG_CAP):
+            keep = u(p) < base_len
+            from_suffix = (u(p) >= base_len) & (u(p) < new_len)
+            sval = self._sel_entry(suffix, u(p) - b)
+            new_log.append(
+                jnp.where(
+                    keep, f["log"][p], jnp.where(from_suffix, sval, u(0))
+                )
+            )
+        # deliver commits (leader_commit d > commit)
+        adv = do_append & (d > f["commit"])
+        new_commit = jnp.where(adv, d, f["commit"])
+        dlen = nonfp & u(7)
+        new_dlen = dlen + jnp.where(adv, d - f["commit"], u(0))
+        flag = flag | (new_dlen > u(DELIV_CAP))
+        lr_nonfp = nonfp
+        for j in range(DELIV_CAP):
+            src_idx = f["commit"] + (u(j) - dlen)
+            pay = (self._sel_entry(new_log, src_idx) >> u(3)) & u(3)
+            put = adv & (u(j) >= dlen) & (u(j) < new_dlen)
+            lr_nonfp = jnp.where(
+                put,
+                (lr_nonfp & ~(u(3) << u(3 + 2 * j))) | (pay << u(3 + 2 * j)),
+                lr_nonfp,
+            )
+        lr_nonfp = jnp.where(
+            adv, (lr_nonfp & ~u(7)) | new_dlen, lr_nonfp
+        )
+        lrq_lo, lrq_hi = self._ins(lo, hi, *_F_TERM, lr_term)
+        lrq_lo, lrq_hi = self._ins(lrq_lo, lrq_hi, *_F_VOTED, lr_voted)
+        lrq_lo, lrq_hi = self._ins(lrq_lo, lrq_hi, *_F_ROLE, lr_role)
+        lrq_lo, lrq_hi = self._ins(lrq_lo, lrq_hi, *_F_LEADER, lr_leader)
+        app_lo, app_hi = self._ins(lrq_lo, lrq_hi, *_F_LOGLEN, new_len)
+        for p in range(LOG_CAP):
+            app_lo, app_hi = self._ins(
+                app_lo, app_hi, _LOG0 + 5 * p, 5, new_log[p]
+            )
+        app_lo, app_hi = self._ins(app_lo, app_hi, *_F_COMMIT, new_commit)
+        lrq_lo = jnp.where(do_append, app_lo, lrq_lo)
+        lrq_hi = jnp.where(do_append, app_hi, lrq_hi)
+        lrq_nonfp = jnp.where(do_append, lr_nonfp, nonfp)
+        lr_ack = jnp.where(do_append, b + e, u(0))
+        lr_send0 = (
+            u(_T_LOG_RESP)
+            | (dst << u(3))
+            | (src << u(5))
+            | (lr_term << u(7))
+            | (lr_ack << u(10))
+            | (do_append.astype(u) << u(13))
+        )
+
+        # ---- LogResponse (models/raft.py:234-259) ----
+        lead_path = (a == f["term"]) & (f["role"] == u(LEADER))
+        acked_src = self._sel_entry(f["acked"], src)
+        sent_src = self._sel_entry(f["sent"], src)
+        success = c == u(1)
+        upd = success & (b >= acked_src)
+        # success path: sent[src] = acked[src] = ack, then commit scan
+        # (models/raft.py:343-356).
+        up_lo, up_hi = lo, hi
+        for i in range(N):
+            sel = src == u(i)
+            up_lo, up_hi = self._ins(
+                up_lo, up_hi, _F_SENT0 + 3 * i, 3,
+                jnp.where(sel, b, f["sent"][i]),
+            )
+            up_lo, up_hi = self._ins(
+                up_lo, up_hi, _F_ACKED0 + 3 * i, 3,
+                jnp.where(sel, b, f["acked"][i]),
+            )
+        upf = self._fields(up_lo, up_hi)
+        ready_max = u(0)
+        for i in range(1, LOG_CAP + 1):
+            cnt = sum(
+                (upf["acked"][j] >= u(i)).astype(u) for j in range(N)
+            )
+            ok = (u(i) > f["commit"]) & (u(i) <= f["loglen"]) & (cnt >= u(2))
+            ready_max = jnp.where(ok, u(i), ready_max)
+        rm_term = self._sel_entry(f["log"], ready_max - u(1)) & u(7)
+        do_commit = (ready_max > u(0)) & (rm_term == f["term"])
+        dlen2 = nonfp & u(7)
+        new_dlen2 = dlen2 + jnp.where(
+            do_commit, ready_max - f["commit"], u(0)
+        )
+        flag = flag | (upd & (new_dlen2 > u(DELIV_CAP)))
+        lresp_nonfp = nonfp
+        for j in range(DELIV_CAP):
+            src_idx = f["commit"] + (u(j) - dlen2)
+            pay = (self._sel_entry(f["log"], src_idx) >> u(3)) & u(3)
+            put = do_commit & (u(j) >= dlen2) & (u(j) < new_dlen2)
+            lresp_nonfp = jnp.where(
+                put,
+                (lresp_nonfp & ~(u(3) << u(3 + 2 * j)))
+                | (pay << u(3 + 2 * j)),
+                lresp_nonfp,
+            )
+        lresp_nonfp = jnp.where(
+            do_commit, (lresp_nonfp & ~u(7)) | new_dlen2, lresp_nonfp
+        )
+        up_lo2, up_hi2 = self._ins(
+            up_lo, up_hi, *_F_COMMIT,
+            jnp.where(do_commit, ready_max, f["commit"]),
+        )
+        # retry path: sent[src] -= 1, resend (models/raft.py:245-249).
+        retry = ~upd & (sent_src > u(0))
+        rt_lo, rt_hi = lo, hi
+        for i in range(N):
+            sel = src == u(i)
+            rt_lo, rt_hi = self._ins(
+                rt_lo, rt_hi, _F_SENT0 + 3 * i, 3,
+                jnp.where(sel, sent_src - u(1), f["sent"][i]),
+            )
+        rtf = self._fields(rt_lo, rt_hi)
+        rt_w0, rt_w1 = self._mk_logreq(dst, src, rtf)
+        lresp_lo = jnp.where(
+            lead_path & upd, up_lo2,
+            jnp.where(lead_path & retry, rt_lo, lo),
+        )
+        lresp_hi = jnp.where(
+            lead_path & upd, up_hi2,
+            jnp.where(lead_path & retry, rt_hi, hi),
+        )
+        lresp_nonfp = jnp.where(lead_path & upd, lresp_nonfp, nonfp)
+        lresp_send0 = jnp.where(lead_path & retry, rt_w0, u(0))
+        lresp_send1 = jnp.where(lead_path & retry, rt_w1, u(0))
+        # stale-term path
+        lresp_stale = ~lead_path & (a > f["term"])
+        lresp_lo = jnp.where(lresp_stale, st_lo, lresp_lo)
+        lresp_hi = jnp.where(lresp_stale, st_hi, lresp_hi)
+        lresp_valid = lead_path | lresp_stale
+        lresp_set_e = lresp_stale
+
+        # ---- Broadcast (models/raft.py:261-273) ----
+        bc_payload = a & u(3)
+        is_leader = f["role"] == u(LEADER)
+        # leader: append entry, acked[me] = len, replicate.
+        bc_len = f["loglen"] + u(1)
+        flag = flag | (
+            occupied & (tag == u(_T_BCAST)) & is_leader
+            & (f["loglen"] >= u(LOG_CAP))
+        )
+        bl_lo, bl_hi = self._ins(lo, hi, *_F_LOGLEN, bc_len)
+        new_entry = f["term"] | (bc_payload << u(3))
+        for p in range(LOG_CAP):
+            cur = f["log"][p]
+            bl_lo, bl_hi = self._ins(
+                bl_lo, bl_hi, _LOG0 + 5 * p, 5,
+                jnp.where(u(p) == f["loglen"], new_entry, cur),
+            )
+        for i in range(N):
+            sel = dst == u(i)
+            bl_lo, bl_hi = self._ins(
+                bl_lo, bl_hi, _F_ACKED0 + 3 * i, 3,
+                jnp.where(sel, bc_len, f["acked"][i]),
+            )
+        blf = self._fields(bl_lo, bl_hi)
+        bl1_w0, bl1_w1 = self._mk_logreq(dst, p1, blf)
+        bl2_w0, bl2_w1 = self._mk_logreq(dst, p2, blf)
+        # no leader known: buffer.
+        no_leader = f["leader"] == u(0)
+        blen_b = (nonfp >> u(13)) & u(3)
+        flag = flag | (
+            occupied & (tag == u(_T_BCAST)) & ~is_leader & no_leader
+            & (blen_b >= u(BUF_CAP))
+        )
+        buf_nonfp = nonfp
+        for j in range(BUF_CAP):
+            put = u(j) == blen_b
+            buf_nonfp = jnp.where(
+                put,
+                (buf_nonfp & ~(u(3) << u(15 + 2 * j)))
+                | (bc_payload << u(15 + 2 * j)),
+                buf_nonfp,
+            )
+        buf_nonfp = (buf_nonfp & ~(u(3) << u(13))) | (
+            jnp.minimum(blen_b + u(1), u(3)) << u(13)
+        )
+        # known leader: forward.
+        fwd_w0 = (
+            u(_T_BCAST)
+            | (dst << u(3))
+            | ((f["leader"] - u(1)) << u(5))
+            | (bc_payload << u(7))
+        )
+        bc_lo = jnp.where(is_leader, bl_lo, lo)
+        bc_hi = jnp.where(is_leader, bl_hi, hi)
+        bc_nonfp = jnp.where(
+            is_leader, nonfp, jnp.where(no_leader, buf_nonfp, nonfp)
+        )
+
+        # ---- select by tag ----
+        def sel_tag(pairs, default):
+            out = default
+            for t, v in pairs:
+                out = jnp.where(tag == u(t), v, out)
+            return out
+
+        new_lo = sel_tag(
+            [
+                (_T_VOTE_REQ, vr_lo),
+                (_T_VOTE_RESP, vresp_lo),
+                (_T_LOG_REQ, lrq_lo),
+                (_T_LOG_RESP, lresp_lo),
+                (_T_BCAST, bc_lo),
+            ],
+            lo,
+        )
+        new_hi = sel_tag(
+            [
+                (_T_VOTE_REQ, vr_hi),
+                (_T_VOTE_RESP, vresp_hi),
+                (_T_LOG_REQ, lrq_hi),
+                (_T_LOG_RESP, lresp_hi),
+                (_T_BCAST, bc_hi),
+            ],
+            hi,
+        )
+        new_nonfp = sel_tag(
+            [
+                (_T_LOG_REQ, lrq_nonfp),
+                (_T_LOG_RESP, lresp_nonfp),
+                (_T_VOTE_RESP, nonfp_resp),
+                (_T_BCAST, bc_nonfp),
+            ],
+            nonfp,
+        )
+        valid = occupied & first & (dst_crashed == u(0)) & sel_tag(
+            [
+                (_T_VOTE_REQ, jnp.ones((), jnp.bool_)),
+                (_T_VOTE_RESP, vresp_valid),
+                (_T_LOG_REQ, jnp.ones((), jnp.bool_)),
+                (_T_LOG_RESP, lresp_valid),
+                (_T_BCAST, jnp.ones((), jnp.bool_)),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+        set_e = sel_tag(
+            [
+                (_T_VOTE_RESP, vresp_set_e),
+                (_T_LOG_REQ, lr_set_e),
+                (_T_LOG_RESP, lresp_set_e),
+            ],
+            jnp.zeros((), jnp.bool_),
+        )
+        # Per-tag send lists (5 slots each), selected element-wise.
+        bc_sends0 = [
+            jnp.where(
+                is_leader, bl1_w0, jnp.where(no_leader, u(0), fwd_w0)
+            ),
+            jnp.where(is_leader, bl2_w0, u(0)),
+            u(0), u(0), u(0),
+        ]
+        bc_sends1 = [
+            jnp.where(is_leader, bl1_w1, u(0)),
+            jnp.where(is_leader, bl2_w1, u(0)),
+            u(0), u(0), u(0),
+        ]
+        tag_sends0 = {
+            _T_VOTE_REQ: [vr_send0] + [u(0)] * (SENDS - 1),
+            _T_VOTE_RESP: resp_sends0,
+            _T_LOG_REQ: [lr_send0] + [u(0)] * (SENDS - 1),
+            _T_LOG_RESP: [lresp_send0] + [u(0)] * (SENDS - 1),
+            _T_BCAST: bc_sends0,
+        }
+        tag_sends1 = {
+            _T_VOTE_REQ: [u(0)] * SENDS,
+            _T_VOTE_RESP: resp_sends1,
+            _T_LOG_REQ: [u(0)] * SENDS,
+            _T_LOG_RESP: [lresp_send1] + [u(0)] * (SENDS - 1),
+            _T_BCAST: bc_sends1,
+        }
+        sends0 = [
+            sel_tag([(t, tag_sends0[t][j]) for t in tag_sends0], u(0))
+            for j in range(SENDS)
+        ]
+        sends1 = [
+            sel_tag([(t, tag_sends1[t][j]) for t in tag_sends1], u(0))
+            for j in range(SENDS)
+        ]
+
+        # timers: set ELECTION for dst where the handler did.
+        new_t = jnp.where(
+            set_e, tbits | (u(1) << (u(2) * dst)), tbits
+        )
+
+        ns, net_flag = self._assemble(
+            state, dst, new_lo, new_hi, new_nonfp, new_t,
+            remove_k=k, sends0=sends0, sends1=sends1,
+        )
+        return ns, valid, flag | net_flag
+
+    def _election_lane(self, state, i: int):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        tbits = state[2 * N]
+        lo, hi = state[2 * i], state[2 * i + 1]
+        f = self._fields(lo, hi)
+        timer_set = (tbits >> u(2 * i)) & u(1)
+        # A fired timer is always consumed: a handler that does nothing
+        # still yields a successor with the timer removed — only
+        # "re-set the same timer and nothing else" is a no-op
+        # (actor/base.py:is_no_op_with_timer).  A LEADER ignores election
+        # timeouts (models/raft.py:279-280) but still consumes the timer.
+        valid = timer_set == u(1)
+        campaign = f["role"] != u(LEADER)
+        term2 = f["term"] + u(1)
+        flag = valid & campaign & (term2 > u(TERM_CAP))
+        n_lo, n_hi = self._ins(lo, hi, *_F_TERM, term2)
+        n_lo, n_hi = self._ins(n_lo, n_hi, *_F_VOTED, u(i + 1))
+        n_lo, n_hi = self._ins(n_lo, n_hi, *_F_ROLE, u(CANDIDATE))
+        for v in range(N):
+            n_lo, n_hi = self._ins(
+                n_lo, n_hi, _F_VOTES + v, 1, u(1 if v == i else 0)
+            )
+        n_lo = jnp.where(campaign, n_lo, lo)
+        n_hi = jnp.where(campaign, n_hi, hi)
+        last_term = self._last_term(f)
+        sends0 = []
+        for p in range(N):
+            if p == i:
+                continue
+            sends0.append(
+                jnp.where(
+                    campaign,
+                    u(_T_VOTE_REQ)
+                    | (u(i) << u(3))
+                    | (u(p) << u(5))
+                    | (term2 << u(7))
+                    | (f["loglen"] << u(10))
+                    | (last_term << u(13)),
+                    u(0),
+                )
+            )
+        sends0 += [u(0)] * (SENDS - len(sends0))
+        new_t = tbits & ~(u(1) << u(2 * i))  # fired timer is consumed
+        ns, net_flag = self._assemble(
+            state, jnp.uint32(i), n_lo, n_hi,
+            state[self._NONFP0 + i], new_t,
+            remove_k=None, sends0=sends0, sends1=[u(0)] * SENDS,
+        )
+        return ns, valid, flag | net_flag
+
+    def _replication_lane(self, state, i: int):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        tbits = state[2 * N]
+        lo, hi = state[2 * i], state[2 * i + 1]
+        f = self._fields(lo, hi)
+        timer_set = (tbits >> u(2 * i + 1)) & u(1)
+        # Consumed even when not leader (see _election_lane note).
+        valid = timer_set == u(1)
+        is_leader = f["role"] == u(LEADER)
+        sends0 = [u(0)] * SENDS
+        sends1 = [u(0)] * SENDS
+        j = 0
+        for p in range(N):
+            if p == i:
+                continue
+            w0, w1 = self._mk_logreq(u(i), u(p), f)
+            sends0[j] = jnp.where(is_leader, w0, u(0))
+            sends1[j] = jnp.where(is_leader, w1, u(0))
+            j += 1
+        new_t = tbits & ~(u(1) << u(2 * i + 1))
+        ns, net_flag = self._assemble(
+            state, jnp.uint32(i), lo, hi, state[self._NONFP0 + i], new_t,
+            remove_k=None, sends0=sends0, sends1=sends1,
+        )
+        return ns, valid, net_flag
+
+    def _crash_lane(self, state, i: int):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        tbits = state[2 * N]
+        n_crashed = sum(
+            (tbits >> u(2 * N + j)) & u(1) for j in range(N)
+        )
+        my_crashed = (tbits >> u(2 * N + i)) & u(1)
+        if self.max_crashes == 0:
+            valid = jnp.zeros((), jnp.bool_)
+        else:
+            # Crash budget counts SIMULTANEOUSLY crashed nodes
+            # (actor/model.py:264-268): recovery frees it.
+            valid = (my_crashed == u(0)) & (
+                n_crashed < u(self.max_crashes)
+            )
+        new_t = tbits & ~(u(3) << u(2 * i))  # clear both timers
+        new_t = new_t | (u(1) << u(2 * N + i))
+        ns, net_flag = self._assemble(
+            state, jnp.uint32(i), state[2 * i], state[2 * i + 1],
+            state[self._NONFP0 + i], new_t,
+            remove_k=None, sends0=[u(0)] * SENDS, sends1=[u(0)] * SENDS,
+        )
+        return ns, valid, net_flag & jnp.zeros((), jnp.bool_)
+
+    def _recover_lane(self, state, i: int):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        tbits = state[2 * N]
+        my_crashed = (tbits >> u(2 * N + i)) & u(1)
+        valid = my_crashed == u(1)
+        # on_start: fresh NodeState, both timers, Broadcast(own id) to self
+        # (models/raft.py:133-138).
+        fresh = self._encode_node(NodeState.new(i, N))
+        new_t = tbits & ~(u(1) << u(2 * N + i))
+        new_t = new_t | (u(3) << u(2 * i))
+        send0 = (
+            u(_T_BCAST) | (u(i) << u(3)) | (u(i) << u(5)) | (u(i) << u(7))
+        )
+        ns, net_flag = self._assemble(
+            state, jnp.uint32(i), u(fresh & 0xFFFFFFFF), u(fresh >> 32),
+            u(0), new_t,
+            remove_k=None,
+            sends0=[send0] + [u(0)] * (SENDS - 1),
+            sends1=[u(0)] * SENDS,
+        )
+        return ns, valid, net_flag & jnp.zeros((), jnp.bool_)
+
+    # --- successor assembly ---------------------------------------------------
+
+    def _assemble(self, state, node_idx, n_lo, n_hi, n_nonfp, tbits,
+                  remove_k, sends0, sends1):
+        """Build the packed successor: node/timers/nonfp words replaced,
+        one copy of slot ``remove_k`` (if not None) removed from the
+        multiset, sends appended, slots re-sorted (duplicates preserved —
+        the multiset counts them, src/actor/network.rs:209-211)."""
+        import jax
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        net0 = self._NET0
+        m = self.m
+
+        w0s = [state[net0 + 2 * j] for j in range(m)]
+        w1s = [state[net0 + 2 * j + 1] for j in range(m)]
+        if remove_k is not None:
+            w0s = [
+                jnp.where(u(j) == remove_k, u(0), w0s[j]) for j in range(m)
+            ]
+            w1s = [
+                jnp.where(u(j) == remove_k, u(0), w1s[j]) for j in range(m)
+            ]
+        cand0 = jnp.stack(w0s + list(sends0))
+        cand1 = jnp.stack(w1s + list(sends1))
+        ones = u(0xFFFFFFFF)
+        empty = cand0 == u(0)
+        cand0 = jnp.where(empty, ones, cand0)
+        cand1 = jnp.where(empty, ones, cand1)
+        s0, s1 = jax.lax.sort([cand0, cand1], num_keys=2, is_stable=True)
+        overflow = jnp.any(s0[m:] != ones)
+        new0 = jnp.where(s0[:m] == ones, u(0), s0[:m])
+        new1 = jnp.where(s0[:m] == ones, u(0), s1[:m])
+
+        head = []
+        for i in range(N):
+            sel = node_idx == u(i)
+            head.append(jnp.where(sel, n_lo, state[2 * i]))
+            head.append(jnp.where(sel, n_hi, state[2 * i + 1]))
+        head.append(tbits)
+        net = jnp.stack(
+            [new0[j // 2] if j % 2 == 0 else new1[j // 2]
+             for j in range(2 * m)]
+        )
+        tail = [
+            jnp.where(node_idx == u(i), n_nonfp, state[self._NONFP0 + i])
+            for i in range(N)
+        ]
+        ns = jnp.concatenate(
+            [jnp.stack(head), net, jnp.stack(tail)]
+        ).astype(u)
+        return ns, overflow
+
+    # --- properties -----------------------------------------------------------
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        fs = [
+            self._fields(state[2 * i], state[2 * i + 1]) for i in range(N)
+        ]
+        any_leader = jnp.zeros((), jnp.bool_)
+        any_commit = jnp.zeros((), jnp.bool_)
+        election_safe = jnp.ones((), jnp.bool_)
+        for i in range(N):
+            any_leader = any_leader | (fs[i]["role"] == u(LEADER))
+            any_commit = any_commit | (fs[i]["commit"] > u(0))
+            for j in range(i + 1, N):
+                both = (fs[i]["role"] == u(LEADER)) & (
+                    fs[j]["role"] == u(LEADER)
+                )
+                election_safe = election_safe & ~(
+                    both & (fs[i]["term"] == fs[j]["term"])
+                )
+        sm_safe = jnp.ones((), jnp.bool_)
+        nonfp = [state[self._NONFP0 + i] for i in range(N)]
+        for i in range(N):
+            for j in range(i + 1, N):
+                di = nonfp[i] & u(7)
+                dj = nonfp[j] & u(7)
+                for p in range(DELIV_CAP):
+                    in_both = (u(p) < di) & (u(p) < dj)
+                    pi = (nonfp[i] >> u(3 + 2 * p)) & u(3)
+                    pj = (nonfp[j] >> u(3 + 2 * p)) & u(3)
+                    sm_safe = sm_safe & ~(in_both & (pi != pj))
+        # order matches RaftModelCfg.into_model (models/raft.py:404-423)
+        return jnp.stack([any_leader, any_commit, election_safe, sm_safe])
+
+
+def compiled_raft(model) -> RaftCompiled:
+    return RaftCompiled(model)

@@ -57,6 +57,15 @@ class CompiledModel:
     # the host-side ``encode``.
     step_flags: bool = False
 
+    # How many leading words of the packed row participate in state
+    # identity.  The engines fingerprint ``row[:fp_words]`` (None = the
+    # whole row), so trailing words carry per-state data that the host
+    # model excludes from its hash — e.g. raft's delivered_messages/buffer
+    # (examples/raft.rs:39-56 excludes them from the manual Hash impl).
+    # States equal on the fingerprinted prefix dedup to the first-inserted
+    # representative, exactly the host's first-writer-wins join.
+    fp_words: Optional[int] = None
+
     # --- host side -----------------------------------------------------------
 
     def init_packed(self) -> np.ndarray:

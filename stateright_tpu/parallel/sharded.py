@@ -36,6 +36,15 @@ from .compiled import CompiledModel, compiled_model_for
 
 NO_GID = 0xFFFFFFFF
 
+# Compiled shard_map programs shared across checker instances, exactly like
+# the single-chip engine's cache (wavefront.py): without it every
+# spawn_tpu_sharded() pays tens of seconds of re-trace + re-lower +
+# program load even when XLA's persistent cache already has the binary —
+# profiling the 1-device-mesh smoke on hardware showed the "run" was
+# almost entirely this host-side work.
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 16
+
 
 def _owner_mix(hi, lo):
     import jax.numpy as jnp
@@ -94,6 +103,7 @@ class ShardedTpuChecker(Checker):
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
         self._tables_host: Optional[tuple] = None
+        self._tables_dev: Optional[tuple] = None
         self._discoveries_cache: Optional[Dict[str, Path]] = None
 
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -116,21 +126,25 @@ class ShardedTpuChecker(Checker):
         depth gating) derive from psum reductions, so every shard takes the
         same branch — a requirement for collectives inside the loop body.
 
-        Exchange-buffer memory: the all_to_all operates on
-        ``[n, chunk*max_actions, W+3]`` uint32 per shard — e.g. n=8,
-        chunk=2^11, A=32, W=42: ~95 MB per shard.  Size ``chunk_size``
-        accordingly.
+        Exchange-buffer memory: candidates are locally pre-deduped before
+        bucketing (hashset.prededup), so the all_to_all operates on
+        ``[n, U, W+3]`` uint32 per shard with
+        ``U = max(min(chunk*max_actions, 16K), chunk*max_actions /
+        dedup_factor)`` — e.g. n=8, chunk=2^11, A=32, W=42,
+        dedup_factor=4: ~24 MB per shard (4x smaller than shipping the
+        raw candidate batch).  Size ``chunk_size`` accordingly.
         """
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, insert_batch
+        from .hashset import HashSet, insert_batch_compact, prededup
         from .wave_common import make_finish_when_device, wave_eval
 
         cm = self._compiled
         w = cm.state_width
+        fpw = cm.fp_words or w  # identity = leading words (compiled.py)
         a = cm.max_actions
         f = self._chunk
         n = self._n
@@ -199,32 +213,52 @@ class ShardedTpuChecker(Checker):
             sc_hi = sc_hi + (new_lo < sc_lo).astype(u)
             sc_lo = new_lo
 
-            # Bucket candidates by owner shard and exchange over ICI.
+            # Local pre-dedup BEFORE the exchange: one stable sort elects a
+            # representative per distinct local key, so only distinct keys
+            # (U = B/dedup_factor lanes, not B) pay for the owner bucketing
+            # scatters, the four all_to_alls, and the owner-side row
+            # scatters.  Candidate batches are ~95% invalid/duplicate
+            # lanes; profiling the single-chip engine showed exactly these
+            # B-indexed row operations dominating the chunk.
             flat = nexts.reshape(b, w)
             flat_valid = valid.reshape(b)
-            par_gid = jnp.repeat(my_gids, a)
-            child_eb = jnp.repeat(eb, a)
-            hi, lo = device_fp64(flat)
-            owner = _owner_mix(hi, lo) % u(n)
-            key = jnp.where(flat_valid, owner, u(n))
+            hi, lo = device_fp64(flat[:, :fpw])
+            u_hi, u_lo, u_origin, u_valid, local_overflow = prededup(
+                hi, lo, flat_valid, dedup_factor
+            )
+            u_sz = u_hi.shape[0]
+            rows_u = flat[u_origin]
+            gid_u = my_gids[u_origin // u(a)]
+            eb_u = eb[u_origin // u(a)]
+
+            # Bucket the representatives by owner shard; exchange over ICI.
+            owner = _owner_mix(u_hi, u_lo) % u(n)
+            key = jnp.where(u_valid, owner, u(n))
             order = jnp.argsort(key, stable=True)
             key_s = key[order]
-            counts = jnp.zeros((n + 1,), u).at[key].add(1)
+            # Bucket sizes as n+1 dense reductions — NOT a scatter-add:
+            # every lane collides into one of n+1 cells, and TPU scatter
+            # serializes colliding updates (profiled at seconds per chunk).
+            counts = jnp.stack(
+                [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
+            )
             offsets = jnp.concatenate(
                 [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
             )
-            pos = jnp.arange(b, dtype=u) - offsets[key_s]
+            pos = jnp.arange(u_sz, dtype=u) - offsets[key_s]
             dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
 
-            send_words = jnp.zeros((n, b, w), u)
-            send_words = send_words.at[dst, pos].set(flat[order], mode="drop")
-            send_gid = jnp.full((n, b), NO_GID, u)
-            send_gid = send_gid.at[dst, pos].set(par_gid[order], mode="drop")
-            send_eb = jnp.zeros((n, b), u)
-            send_eb = send_eb.at[dst, pos].set(child_eb[order], mode="drop")
-            send_valid = jnp.zeros((n, b), jnp.bool_)
+            send_words = jnp.zeros((n, u_sz, w), u)
+            send_words = send_words.at[dst, pos].set(
+                rows_u[order], mode="drop"
+            )
+            send_gid = jnp.full((n, u_sz), NO_GID, u)
+            send_gid = send_gid.at[dst, pos].set(gid_u[order], mode="drop")
+            send_eb = jnp.zeros((n, u_sz), u)
+            send_eb = send_eb.at[dst, pos].set(eb_u[order], mode="drop")
+            send_valid = jnp.zeros((n, u_sz), jnp.bool_)
             send_valid = send_valid.at[dst, pos].set(
-                flat_valid[order], mode="drop"
+                u_valid[order], mode="drop"
             )
 
             recv_words = jax.lax.all_to_all(
@@ -240,28 +274,37 @@ class ShardedTpuChecker(Checker):
                 send_valid, "shards", split_axis=0, concat_axis=0, tiled=False
             )
 
-            # Local insert — the owner's insert IS the global dedup.
-            rw = recv_words.reshape(n * b, w)
-            rv = recv_valid.reshape(n * b)
-            rg = recv_gid.reshape(n * b)
-            reb = recv_eb.reshape(n * b)
-            rhi, rlo = device_fp64(rw)
-            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
-                HashSet(key_hi, key_lo), rhi, rlo, rv,
-                dedup_factor=dedup_factor,
+            # Local insert — the owner's insert IS the global dedup; the
+            # compact form keeps the store/parent/queue scatters
+            # proportional to distinct received keys.
+            rw = recv_words.reshape(n * u_sz, w)
+            rv = recv_valid.reshape(n * u_sz)
+            rg = recv_gid.reshape(n * u_sz)
+            reb = recv_eb.reshape(n * u_sz)
+            rhi, rlo = device_fp64(rw[:, :fpw])
+            # dedup_factor=1: the receive batch is already per-sender
+            # deduped, so its distinct-key count can approach the full
+            # batch (disjoint keys per shard) — a divided buffer here
+            # would spuriously overflow on waves the old code handled.
+            (
+                table, r_slot, r_new, r_origin, _r_active, probe_ok,
+                dd_overflow,
+            ) = insert_batch_compact(
+                HashSet(key_hi, key_lo), rhi, rlo, rv, dedup_factor=1
             )
-            sslot = jnp.where(is_new, slot, u(cap_s))
-            store = store.at[sslot].set(rw, mode="drop")
-            parent = parent.at[sslot].set(rg, mode="drop")
-            ebits = ebits.at[sslot].set(reb, mode="drop")
-            n_new = jnp.sum(is_new, dtype=u)
+            rows_r = rw[r_origin]
+            sslot = jnp.where(r_new, r_slot, u(cap_s))
+            store = store.at[sslot].set(rows_r, mode="drop")
+            parent = parent.at[sslot].set(rg[r_origin], mode="drop")
+            ebits = ebits.at[sslot].set(reb[r_origin], mode="drop")
+            n_new = jnp.sum(r_new, dtype=u)
             unique_l = unique_l + n_new
             unique_g = unique_g + jax.lax.psum(n_new, "shards")
 
             # Append new slots at this shard's queue tail.
-            qpos = tail + jnp.cumsum(is_new.astype(u)) - 1
-            qidx = jnp.where(is_new, qpos, u(qcap + f))
-            queue = queue.at[qidx].set(slot, mode="drop")
+            qpos = tail + jnp.cumsum(r_new.astype(u)) - 1
+            qidx = jnp.where(r_new, qpos, u(qcap + f))
+            queue = queue.at[qidx].set(r_slot, mode="drop")
             tail = tail + n_new
 
             # Advance within the level; the boundary is global.
@@ -279,7 +322,9 @@ class ShardedTpuChecker(Checker):
                 any_shard(unique_l * u(2) > u(cap_s)), 1, 0
             ).astype(u)
             flags = flags | jnp.where(any_shard(tail > u(qcap)), 2, 0).astype(u)
-            flags = flags | jnp.where(any_shard(dd_overflow), 4, 0).astype(u)
+            flags = flags | jnp.where(
+                any_shard(dd_overflow | local_overflow), 4, 0
+            ).astype(u)
             flags = flags | jnp.where(any_shard(step_flag), 8, 0).astype(u)
 
             waves_left = waves_left - 1
@@ -373,6 +418,97 @@ class ShardedTpuChecker(Checker):
         )
         return run
 
+    def _programs(self):
+        key = (
+            self._compiled.cache_key(),
+            self._cap_s,
+            self._chunk,
+            self._dedup_factor,
+            tuple((d.platform, d.id) for d in self._mesh.devices.flat),
+            tuple(p.expectation for p in self._properties),
+            (
+                self._options._finish_when._kind,
+                tuple(sorted(self._options._finish_when._names)),
+                tuple(p.name for p in self._properties),
+            ),
+            self._options._target_max_depth or 0,
+        )
+        from .wave_common import cached_program
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run
+        )
+
+    def _seed_program(self, seed_w: int):
+        """Init-state seeding program, cached like the run program (the
+        trace + lower alone costs seconds per checker otherwise)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import HashSet, insert_batch
+
+        cm = self._compiled
+        cap_s = self._cap_s
+        f = self._chunk
+        qcap = cap_s
+        fpw = cm.fp_words or cm.state_width
+        eb0 = (1 << len(self._ev_indices)) - 1
+        key = (
+            "seed",
+            cm.cache_key(),
+            cap_s,
+            f,
+            seed_w,
+            eb0,
+            tuple((d.platform, d.id) for d in self._mesh.devices.flat),
+        )
+
+        def seed_shard(key_hi, key_lo, store, ebits, states, valid):
+            from .wave_common import compact
+
+            sts = states[0]
+            val = valid[0]
+            hi, lo = device_fp64(sts[:, :fpw])
+            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+                HashSet(key_hi, key_lo), hi, lo, val
+            )
+            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
+            store = store.at[sslot].set(sts, mode="drop")
+            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
+            n_new = jnp.sum(is_new, dtype=jnp.uint32)
+            queue = jnp.zeros((qcap + f,), jnp.uint32)
+            queue = queue.at[: is_new.shape[0]].set(
+                compact(is_new, slot, is_new.shape[0])
+            )
+            ok = probe_ok & ~dd_overflow
+            return (
+                table.key_hi,
+                table.key_lo,
+                store,
+                ebits,
+                queue,
+                n_new[None],
+                ok[None],
+            )
+
+        def build():
+            sp = P("shards")
+            return jax.jit(
+                jax.shard_map(
+                    seed_shard,
+                    mesh=self._mesh,
+                    in_specs=(sp, sp, sp, sp, sp, sp),
+                    out_specs=(sp, sp, sp, sp, sp, sp, sp),
+                ),
+                donate_argnums=(0, 1, 2, 3),
+            )
+
+        from .wave_common import cached_program
+
+        return cached_program(_PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build)
+
     # --- host loop -----------------------------------------------------------
 
     def _run(self) -> None:
@@ -422,7 +558,10 @@ class ShardedTpuChecker(Checker):
         # place each init state in its owner's slice of a seeding program.
         init = cm.init_packed()
         n_init = init.shape[0]
-        ih, il = (np.asarray(x) for x in device_fp64(jnp.asarray(init)))
+        fpw = cm.fp_words or cm.state_width
+        ih, il = (
+            np.asarray(x) for x in device_fp64(jnp.asarray(init[:, :fpw]))
+        )
         owner = np.asarray(
             _owner_mix(jnp.asarray(ih), jnp.asarray(il))
         ) % np.uint32(n)
@@ -437,48 +576,7 @@ class ShardedTpuChecker(Checker):
             seed_states[d, : len(idx)] = init[idx]
             seed_valid[d, : len(idx)] = True
 
-        from .hashset import HashSet
-
-        qcap = cap_s
-
-        def seed_shard(key_hi, key_lo, store, ebits, states, valid):
-            from .wave_common import compact
-
-            sts = states[0]
-            val = valid[0]
-            hi, lo = device_fp64(sts)
-            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
-                HashSet(key_hi, key_lo), hi, lo, val
-            )
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
-            store = store.at[sslot].set(sts, mode="drop")
-            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            n_new = jnp.sum(is_new, dtype=jnp.uint32)
-            queue = jnp.zeros((qcap + f,), jnp.uint32)
-            queue = queue.at[: is_new.shape[0]].set(
-                compact(is_new, slot, is_new.shape[0])
-            )
-            ok = probe_ok & ~dd_overflow
-            return (
-                table.key_hi,
-                table.key_lo,
-                store,
-                ebits,
-                queue,
-                n_new[None],
-                ok[None],
-            )
-
-        sp = P("shards")
-        seed = jax.jit(
-            jax.shard_map(
-                seed_shard,
-                mesh=self._mesh,
-                in_specs=(sp, sp, sp, sp, sp, sp),
-                out_specs=(sp, sp, sp, sp, sp, sp, sp),
-            ),
-            donate_argnums=(0, 1, 2, 3),
-        )
+        seed = self._seed_program(int(seed_w))
         key_hi, key_lo, store, ebits, queue, seed_counts, seed_ok = seed(
             key_hi,
             key_lo,
@@ -501,7 +599,7 @@ class ShardedTpuChecker(Checker):
 
         waves_per_call = default_waves_per_call(opts)
 
-        run = self._build_run()
+        run = self._programs()
 
         def shard_scalars(values):
             return jax.device_put(
@@ -616,10 +714,11 @@ class ShardedTpuChecker(Checker):
             if deadline is not None and _time.monotonic() >= deadline:
                 break
 
-        self._tables_host = (
-            np.asarray(parent).reshape(n, cap_s),
-            np.asarray(store).reshape(n, cap_s, cm.state_width),
-        )
+        # Keep the device arrays; path reconstruction pulls them lazily —
+        # an eager pull is ~10 s of tunnel bandwidth for a 2^20-slot store
+        # and most runs never reconstruct a path (same policy as the
+        # single-chip engine).
+        self._tables_dev = (parent, store)
 
     # --- Checker surface -----------------------------------------------------
 
@@ -633,6 +732,13 @@ class ShardedTpuChecker(Checker):
         return self._max_depth
 
     def _gid_path(self, gid: int) -> Path:
+        if self._tables_host is None:
+            parent_dev, store_dev = self._tables_dev
+            n, cap_s, w = self._n, self._cap_s, self._compiled.state_width
+            self._tables_host = (
+                np.asarray(parent_dev).reshape(n, cap_s),
+                np.asarray(store_dev).reshape(n, cap_s, w),
+            )
         parent, store = self._tables_host
         chain: List[int] = []
         g = gid

@@ -150,7 +150,7 @@ class TpuSimulationChecker(Checker):
                     in_bound = jnp.ones((), jnp.bool_)
                 end_boundary = active & ~in_bound
 
-                hi, lo = device_fp64(state)
+                hi, lo = device_fp64(state[: cm.fp_words or cm.state_width])
                 seen = jnp.any(
                     (fps_hi == hi)
                     & (fps_lo == lo)

@@ -93,6 +93,20 @@ def make_finish_when_device(fw, props):
     return matched
 
 
+def cached_program(cache: dict, max_size: int, key, build):
+    """Bounded-FIFO memo for compiled engine programs, shared by the
+    single-chip and sharded engines so the key-tuple + eviction idiom
+    exists once.  The KEY must cover everything the built closure traces
+    over — a stale hit is a silent wrong-program bug."""
+    prog = cache.get(key)
+    if prog is None:
+        prog = build()
+        while len(cache) >= max_size:
+            cache.pop(next(iter(cache)))
+        cache[key] = prog
+    return prog
+
+
 def compact(mask, values, size: int):
     """Stream-compact ``values[mask]`` into a ``size``-wide buffer (excess
     dropped; caller checks counts).  One shared definition of the

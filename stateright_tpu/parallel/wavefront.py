@@ -147,6 +147,9 @@ class TpuChecker(Checker):
 
         cm = self._compiled
         w = cm.state_width
+        # State identity = the leading fp_words of a row (compiled.py);
+        # trailing words ride along with the first-inserted representative.
+        fpw = cm.fp_words or w
         a = cm.max_actions
         f = self._max_frontier  # chunk size
         cap = self._capacity
@@ -215,7 +218,7 @@ class TpuChecker(Checker):
             # duplicates and paid full scatter price anyway.
             flat = nexts.reshape(f * a, w)
             flat_valid = valid.reshape(f * a)
-            hi, lo = device_fp64(flat)
+            hi, lo = device_fp64(flat[:, :fpw])
             (
                 table, u_slot, u_new, u_origin, _u_active, probe_ok,
                 dd_overflow,
@@ -326,7 +329,7 @@ class TpuChecker(Checker):
         def seed(key_hi, key_lo, store, ebits, init_padded, n_init):
             from .wave_common import compact
 
-            hi, lo = device_fp64(init_padded)
+            hi, lo = device_fp64(init_padded[:, :fpw])
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
             table, slot, is_new, probe_ok, dd_overflow = insert_batch(
                 HashSet(key_hi, key_lo), hi, lo, seed_active
@@ -366,13 +369,11 @@ class TpuChecker(Checker):
             ),
             self._options._target_max_depth or 0,
         )
-        progs = _PROGRAM_CACHE.get(key)
-        if progs is None:
-            progs = self._build_run()
-            while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-                _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-            _PROGRAM_CACHE[key] = progs
-        return progs
+        from .wave_common import cached_program
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run
+        )
 
     # --- host loop -----------------------------------------------------------
 
@@ -615,7 +616,6 @@ class TpuChecker(Checker):
                 cm.max_actions,
                 self._capacity,
                 self._max_frontier,
-                self._dedup_factor,
                 tuple(p.name for p in self._properties),
                 init_digest,
             )
@@ -633,7 +633,13 @@ class TpuChecker(Checker):
         Note: to stay snapshot-ready, a finished checker keeps its key
         planes, ebits, and queue (16 bytes × capacity) on device alongside
         the store/parent arrays that path reconstruction already retains;
-        dropping the checker object frees all of it."""
+        dropping the checker object frees all of it.
+
+        Engine tuning knobs that do not shape the persisted arrays —
+        ``dedup_factor`` in particular — are deliberately NOT part of the
+        snapshot key: a resume may use different tuning, in which case
+        overflow-failure behavior (not correctness) can differ from the
+        original run."""
         self.join()
         if self._carry_dev is None:
             raise RuntimeError("no run state to snapshot")

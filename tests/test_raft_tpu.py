@@ -1,0 +1,151 @@
+"""Device-kernel gates for the compiled raft workload.
+
+Raft is the generalization proof for the compiled path: every reference
+action family except SelectRandom (src/actor/model.rs:269-333) — Deliver
+over five message kinds with multiset counts > 1, two Timeout timers per
+node, and Crash/Recover under ``max_crashes(1)`` — plus log truncation,
+quorum commits, and buffered broadcasts.
+
+Gate structure mirrors the paxos/ABD ones:
+
+1. per-state differential: device successor sets, full successor rows
+   (including the non-identity delivered/buffer words), validity, flags,
+   and property predicates against the host model over the reachable
+   space to a fixed depth;
+2. engine golden: ``spawn_tpu`` reproduces the host BFS at
+   ``target_max_depth(6)`` exactly (4,933 states, the host suite's pin);
+3. deeper runs pin BOTH engine counts separately: states that merge under
+   the reference's state identity (examples/raft.rs:39-56 excludes
+   delivered_messages and buffer from Hash) can have buffer-dependent
+   successors, so which representative expands decides a handful of
+   deep states — host FIFO order and device sorted-key order first
+   diverge at depth 8 (61,702 vs 61,697 of which all discoveries agree).
+   The reference has the same nondeterminism across checker threads; at
+   ``threads > 1`` its own counts vary run to run.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.models.raft import RaftModelCfg  # noqa: E402
+from stateright_tpu.models.raft_compiled import RaftCompiled  # noqa: E402
+from stateright_tpu.ops.fingerprint import fingerprint  # noqa: E402
+
+
+def raft_model():
+    return RaftModelCfg(server_count=3).into_model()
+
+
+def test_step_differential_to_depth_4():
+    """Successors, rows, flags, and properties vs host over the 1,390
+    states within 4 actions of init (elections, votes, crash/recover, and
+    both timeout kinds are all reachable in this prefix)."""
+    model = raft_model()
+    cm = RaftCompiled(model)
+    props = model.properties()
+    seen = {}
+    frontier = list(model.init_states())
+    for s in frontier:
+        seen[fingerprint(s)] = s
+    depth = 0
+    while frontier and depth < 4:
+        depth += 1
+        encs = np.stack([cm.encode(s) for s in frontier]).astype(np.uint32)
+        nexts_b, valid_b, flag_b = jax.vmap(cm.step)(jnp.asarray(encs))
+        nexts_b = np.asarray(nexts_b)
+        valid_b = np.asarray(valid_b)
+        assert not np.asarray(flag_b).any()
+        conds_b = np.asarray(
+            jax.vmap(cm.property_conds)(jnp.asarray(encs))
+        )
+        nxt = []
+        for bi, s in enumerate(frontier):
+            assert fingerprint(cm.decode(encs[bi])) == fingerprint(s)
+            want = [bool(p.condition(model, s)) for p in props]
+            assert want == [bool(x) for x in conds_b[bi]], s
+            acts = []
+            model.actions(s, acts)
+            host_succ = {}
+            for a in acts:
+                ns = model.next_state(s, a)
+                if ns is None:
+                    continue
+                host_succ[tuple(cm.encode(ns).tolist())] = a
+                fp = fingerprint(ns)
+                if fp not in seen:
+                    seen[fp] = ns
+                    nxt.append(ns)
+            dev_succ = {
+                tuple(nexts_b[bi, k].tolist())
+                for k in range(cm.max_actions)
+                if valid_b[bi, k]
+            }
+            # Full-row equality: identity words AND delivered/buffer.
+            assert dev_succ == set(host_succ), s
+        frontier = nxt
+    assert len(seen) == 1390
+
+
+def test_spawn_tpu_raft_depth6_matches_host():
+    """The host suite's determinism pin (4,933 states by depth 6) through
+    the device engine, discovery sets included."""
+    tpu = (
+        raft_model()
+        .checker()
+        .target_max_depth(6)
+        .spawn_tpu(capacity=1 << 15, max_frontier=1 << 8)
+        .join()
+    )
+    host = raft_model().checker().target_max_depth(6).spawn_bfs().join()
+    assert host.unique_state_count() == 4_933
+    assert tpu.unique_state_count() == 4_933
+    assert tpu.max_depth() == host.max_depth() == 6
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_any_discovery("Election Liveness")
+    tpu.assert_no_discovery("Election Safety")
+    tpu.assert_no_discovery("State Machine Safety")
+
+
+@pytest.mark.slow
+def test_spawn_tpu_raft_depth8_dual_pin():
+    """Depth 8: the first depth where representative choice under the
+    reference's partial state identity matters (see module docstring) —
+    both engine counts are pinned, discoveries must agree, and neither
+    safety property may fire."""
+    host = raft_model().checker().target_max_depth(8).spawn_bfs().join()
+    tpu = (
+        raft_model()
+        .checker()
+        .target_max_depth(8)
+        .spawn_tpu(capacity=1 << 19, max_frontier=1 << 9)
+        .join()
+    )
+    assert host.unique_state_count() == 61_702
+    assert tpu.unique_state_count() == 61_697
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_any_discovery("Log Liveness")
+    tpu.assert_no_discovery("Election Safety")
+    tpu.assert_no_discovery("State Machine Safety")
+
+
+@pytest.mark.tpu
+def test_spawn_tpu_raft_depth9_device():
+    """Depth 9 on real hardware (host BFS: 225,379; the same engine
+    config on the CPU backend measured 225,298 — the band covers the
+    representative-order nondeterminism described in the module
+    docstring)."""
+    tpu = (
+        raft_model()
+        .checker()
+        .target_max_depth(9)
+        .spawn_tpu(capacity=1 << 20, max_frontier=1 << 10)
+        .join()
+    )
+    assert 225_000 < tpu.unique_state_count() < 226_000
+    tpu.assert_any_discovery("Election Liveness")
+    tpu.assert_any_discovery("Log Liveness")
+    tpu.assert_no_discovery("Election Safety")
+    tpu.assert_no_discovery("State Machine Safety")
