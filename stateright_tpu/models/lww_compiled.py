@@ -1,0 +1,397 @@
+"""Bit-packed codec + device step kernel for the LWW-register CRDT.
+
+Closes the last reference action family on device: **SelectRandom**
+(src/actor/model.rs:320-333).  With raft covering Timeout/Crash/Recover
+and ping_pong covering Drop, every family the reference enumerates now has
+a compiled form.
+
+Host model: models/lww_register.py (reference examples/lww-register.rs) —
+each node nondeterministically sets a value or skews its clock via
+``choose_random``; broadcasts merge by (timestamp, updater_id).
+
+The random-choice *menu* needs no encoding: it is always exactly
+``_populate_choices(local_clock)`` (repopulated by every on_random, and
+on_msg never changes the clock), so the five SelectRandom lanes per node
+are derivable from the packed clock — the host's ``random_choices`` dict
+round-trips through ``decode`` by reconstruction.
+
+Layout (N ≤ 3 nodes): one word per node — register present(1) value(2)
+ts(6, offset-coded) updater(2), local_clock(6), maximum_used_clock(6) —
+then M single-word envelope codes (src 2 | dst 2 | value 2 | ts 6 |
+updater 2, +1 so 0 = empty).  Clocks are offset-coded around the model's
+starting clock of 1000 with a ±31 budget; exhaustion flags loudly, and
+the reference checks this model depth-bounded (examples/lww-register.rs:
+190-196) so the budget covers any practical bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+from ..actor import Envelope, Id, Network
+from ..actor.model import ActorModelState
+from ..parallel.compiled import CompiledModel
+from .lww_register import (
+    LwwActorState,
+    LwwRegister,
+    SetTime,
+    SetValue,
+    VALUES,
+)
+
+CLOCK_BASE = 1000 - 31  # offset code 0..63 covers clocks 969..1032
+NET_SLOTS = 12
+N_CHOICES = 5  # SetValue(A/B/C), SetTime(+1), SetTime(-1)
+
+
+class LwwCompiled(CompiledModel):
+    """Codec + device step kernel for ``lww_register.build_model()``."""
+
+    step_flags = True
+
+    def __init__(self, model):
+        self.model = model
+        self.n = len(model.actors)
+        if self.n > 3:
+            raise ValueError("packed lww supports at most 3 nodes")
+        if model.lossy_network or model.max_crashes:
+            raise ValueError("packed lww supports lossless, crash-free runs")
+        if model.init_network.kind != "unordered_nonduplicating":
+            raise ValueError(
+                "packed lww supports the unordered_nonduplicating network"
+            )
+        self.m = NET_SLOTS
+        self.state_width = self.n + self.m
+        self.max_actions = self.m + N_CHOICES * self.n
+
+    def cache_key(self):
+        return (type(self).__qualname__, self.n)
+
+    # --- small codes ----------------------------------------------------------
+
+    @staticmethod
+    def _clock_code(c: int) -> int:
+        off = c - CLOCK_BASE
+        if not 0 <= off < 64:
+            raise ValueError(f"clock {c} outside the offset budget")
+        return off
+
+    @staticmethod
+    def _val_code(v) -> int:
+        return VALUES.index(v)
+
+    def _encode_node(self, s: LwwActorState) -> int:
+        bits = 0
+        if s.register is not None:
+            bits |= 1
+            bits |= self._val_code(s.register.value) << 1
+            bits |= self._clock_code(s.register.timestamp) << 3
+            bits |= s.register.updater_id << 9
+        bits |= self._clock_code(s.local_clock) << 11
+        bits |= self._clock_code(s.maximum_used_clock) << 17
+        return bits
+
+    def _decode_node(self, bits: int) -> LwwActorState:
+        reg = None
+        if bits & 1:
+            reg = LwwRegister(
+                VALUES[(bits >> 1) & 3],
+                CLOCK_BASE + ((bits >> 3) & 63),
+                (bits >> 9) & 3,
+            )
+        return LwwActorState(
+            register=reg,
+            local_clock=CLOCK_BASE + ((bits >> 11) & 63),
+            maximum_used_clock=CLOCK_BASE + ((bits >> 17) & 63),
+        )
+
+    def _env_code(self, env: Envelope) -> int:
+        msg = env.msg
+        assert isinstance(msg, LwwRegister), msg
+        return 1 + (
+            int(env.src)
+            | (int(env.dst) << 2)
+            | (self._val_code(msg.value) << 4)
+            | (self._clock_code(msg.timestamp) << 6)
+            | (msg.updater_id << 12)
+        )
+
+    def _env_of(self, code: int) -> Envelope:
+        code -= 1
+        return Envelope(
+            Id(code & 3),
+            Id((code >> 2) & 3),
+            LwwRegister(
+                VALUES[(code >> 4) & 3],
+                CLOCK_BASE + ((code >> 6) & 63),
+                (code >> 12) & 3,
+            ),
+        )
+
+    # --- full state -----------------------------------------------------------
+
+    def _choices_for(self, clock: int) -> Tuple[Tuple[str, tuple], ...]:
+        menu = tuple(
+            [SetValue(v) for v in VALUES]
+            + [SetTime(clock + 1), SetTime(max(clock - 1, 0))]
+        )
+        return (("node_action", menu),)
+
+    def encode(self, st: ActorModelState) -> np.ndarray:
+        words = np.zeros(self.state_width, dtype=np.uint32)
+        for i in range(self.n):
+            words[i] = self._encode_node(st.actor_states[i])
+            # The menu must be the derivable one, or decode cannot
+            # reconstruct it.
+            assert st.random_choices[i] == self._choices_for(
+                st.actor_states[i].local_clock
+            ), st.random_choices[i]
+        # Duplicate envelopes are REACHABLE here (a register-less SetValue
+        # stamps local_clock without bumping maximum_used_clock, so an
+        # identical broadcast can be re-sent while the first is still in
+        # flight) — the multiset is encoded as repeated sorted codes, like
+        # raft's.
+        codes: List[int] = []
+        for env, count in st.network.counts:
+            codes.extend([self._env_code(env)] * count)
+        if len(codes) > self.m:
+            raise ValueError(
+                f"{len(codes)} in-flight envelopes exceed {self.m} slots"
+            )
+        codes.sort()
+        for k, c in enumerate(codes):
+            words[self.n + k] = c
+        return words
+
+    def decode(self, words: Sequence[int]) -> ActorModelState:
+        nodes = tuple(
+            self._decode_node(int(words[i])) for i in range(self.n)
+        )
+        counts: dict = {}
+        for k in range(self.m):
+            code = int(words[self.n + k])
+            if code:
+                env = self._env_of(code)
+                counts[env] = counts.get(env, 0) + 1
+        network = Network(
+            kind="unordered_nonduplicating", counts=frozenset(counts.items())
+        )
+        return ActorModelState(
+            actor_states=nodes,
+            network=network,
+            timers_set=(frozenset(),) * self.n,
+            random_choices=tuple(
+                self._choices_for(s.local_clock) for s in nodes
+            ),
+            crashed=(False,) * self.n,
+            history=self.model.init_history,
+            actor_storages=(None,) * self.n,
+        )
+
+    # --- device side ----------------------------------------------------------
+
+    def step(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jnp.arange(self.m, dtype=jnp.uint32)
+        dn, dv, df = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
+        outs = [(dn, dv, df)]
+        for i in range(self.n):
+            for c in range(N_CHOICES):
+                ns, valid, flag = self._random_lane(state, i, c)
+                outs.append((ns[None], valid[None], flag[None]))
+        nexts = jnp.concatenate([o[0] for o in outs])
+        valid = jnp.concatenate([o[1] for o in outs])
+        flags = jnp.concatenate([o[2] for o in outs])
+        return nexts, valid, jnp.any(flags & valid)
+
+    @staticmethod
+    def _merge(p_a, v_a, t_a, u_a, v_b, t_b, u_b):
+        """LwwRegister.merge: keep a iff (t_a, u_a) > (t_b, u_b) — with no
+        register (p_a == 0) the incoming value always wins."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        a_wins = (p_a == u(1)) & (
+            (t_a > t_b) | ((t_a == t_b) & (u_a > u_b))
+        )
+        return (
+            jnp.where(a_wins, v_a, v_b),
+            jnp.where(a_wins, t_a, t_b),
+            jnp.where(a_wins, u_a, u_b),
+        )
+
+    def _node_fields(self, word):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        return dict(
+            present=word & u(1),
+            val=(word >> u(1)) & u(3),
+            ts=(word >> u(3)) & u(63),
+            up=(word >> u(9)) & u(3),
+            clock=(word >> u(11)) & u(63),
+            max_used=(word >> u(17)) & u(63),
+        )
+
+    @staticmethod
+    def _node_word(present, val, ts, up, clock, max_used):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        return (
+            present.astype(u)
+            | (val << u(1))
+            | (ts << u(3))
+            | (up << u(9))
+            | (clock << u(11))
+            | (max_used << u(17))
+        )
+
+    def _deliver_lane(self, state, k):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        n, m = self.n, self.m
+        code = u(0)
+        for j in range(m):
+            code = jnp.where(k == u(j), state[n + j], code)
+        occupied = code != u(0)
+        # One Deliver per DISTINCT envelope: slots are sorted, so only the
+        # first of an equal run is a valid lane (host iter_deliverable
+        # enumerates multiset keys once).
+        prev = u(0)
+        for j in range(1, m):
+            prev = jnp.where(k == u(j), state[n + j - 1], prev)
+        first = (k == u(0)) | (prev != code)
+        e = code - u(1)
+        dst = (e >> u(2)) & u(3)
+        mv = (e >> u(4)) & u(3)
+        mt = (e >> u(6)) & u(63)
+        mu = (e >> u(12)) & u(3)
+
+        word = u(0)
+        for i in range(n):
+            word = jnp.where(dst == u(i), state[i], word)
+        f = self._node_fields(word)
+        nv, nt, nu = self._merge(
+            f["present"], f["val"], f["ts"], f["up"], mv, mt, mu
+        )
+        new_word = self._node_word(
+            jnp.ones((), jnp.bool_), nv, nt, nu, f["clock"], f["max_used"]
+        )
+        # Remove one copy of slot k; re-sort (no sends on deliver).
+        slots = [
+            jnp.where(k == u(j), u(0), state[n + j]) for j in range(m)
+        ]
+        cand = jnp.stack(slots)
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        new_slots = jnp.where(cand == ones, u(0), cand)
+        head = [
+            jnp.where(dst == u(i), new_word, state[i]) for i in range(n)
+        ]
+        ns = jnp.concatenate([jnp.stack(head), new_slots]).astype(u)
+        return ns, occupied & first, jnp.zeros((), jnp.bool_)
+
+    def _random_lane(self, state, i: int, c: int):
+        """SelectRandom(node i, choice c): c in 0..2 = SetValue(VALUES[c]),
+        c == 3 = SetTime(clock+1), c == 4 = SetTime(clock-1).  Always a
+        successor (the host applies on_random unconditionally and the
+        handler repopulates the same menu, actor/model.py:348-358)."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        n, m = self.n, self.m
+        f = self._node_fields(state[i])
+        flag = jnp.zeros((), jnp.bool_)
+        if c < 3:
+            # SetValue: clock_value = local if no register else
+            # max(local, max_used + 1); broadcast to peers.
+            cv = jnp.where(
+                f["present"] == u(1),
+                jnp.maximum(f["clock"], f["max_used"] + u(1)),
+                f["clock"],
+            )
+            flag = flag | (cv > u(63))
+            new_word = self._node_word(
+                jnp.ones((), jnp.bool_),
+                u(c),
+                cv,
+                u(i),
+                f["clock"],
+                jnp.where(f["present"] == u(1), cv, f["max_used"]),
+            )
+            # The model's peer list includes the sender itself
+            # (build_model passes every id to every actor), so the
+            # broadcast goes to ALL nodes.
+            sends = [
+                u(1)
+                + (
+                    u(i)
+                    | (u(p) << u(2))
+                    | (u(c) << u(4))
+                    | (cv << u(6))
+                    | (u(i) << u(12))
+                )
+                for p in range(n)
+            ]
+        else:
+            if c == 4:  # SetTime(max(clock - 1, 0))
+                nclock = f["clock"] - u(1)
+                flag = flag | (f["clock"] == u(0))  # offset floor, not 0
+            else:  # SetTime(clock + 1)
+                nclock = f["clock"] + u(1)
+                flag = flag | (nclock > u(63))
+            new_word = self._node_word(
+                f["present"] == u(1), f["val"], f["ts"], f["up"],
+                nclock, f["max_used"],
+            )
+            sends = []
+
+        slots = [state[n + j] for j in range(m)]
+        cand = jnp.stack(slots + sends) if sends else jnp.stack(slots)
+        ones = u(0xFFFFFFFF)
+        cand = jnp.where(cand == u(0), ones, cand)
+        cand = jnp.sort(cand)
+        overflow = jnp.any(cand[m:] != ones) if sends else jnp.zeros(
+            (), jnp.bool_
+        )
+        new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
+        head = [
+            new_word if j == i else state[j] for j in range(n)
+        ]
+        ns = jnp.concatenate([jnp.stack(head), new_slots]).astype(u)
+        valid = jnp.ones((), jnp.bool_)
+        return ns, valid, flag | overflow
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        n, m = self.n, self.m
+        net_empty = jnp.ones((), jnp.bool_)
+        for j in range(m):
+            net_empty = net_empty & (state[n + j] == u(0))
+        regs = [self._node_fields(state[i]) for i in range(n)]
+        agree = jnp.ones((), jnp.bool_)
+        for i in range(1, n):
+            same = (
+                (regs[i]["present"] == regs[0]["present"])
+                & (regs[i]["val"] == regs[0]["val"])
+                & (regs[i]["ts"] == regs[0]["ts"])
+                & (regs[i]["up"] == regs[0]["up"])
+            )
+            none_both = (regs[i]["present"] == u(0)) & (
+                regs[0]["present"] == u(0)
+            )
+            agree = agree & (same | none_both)
+        return jnp.stack([~net_empty | agree])
+
+
+def compiled_lww(model) -> LwwCompiled:
+    return LwwCompiled(model)

@@ -111,6 +111,13 @@ def build_model(num_actors: int = 2) -> ActorModel:
 
     model = ActorModel(cfg=None)
     model.add_actors(LwwActor(nodes) for _ in range(num_actors))
+
+    def _compiled():
+        from .lww_compiled import LwwCompiled
+
+        return LwwCompiled(model)
+
+    model.compiled = _compiled
     return model.init_network_(
         Network.new_unordered_nonduplicating()
     ).property(
@@ -128,6 +135,14 @@ def main(argv=None) -> int:
             build=lambda n: build_model(num_actors=n),
             default_n=2,
             n_meta="ACTOR_COUNT",
+            # The CRDT walk is unbounded (clocks skew forever); the
+            # reference's check bounds depth at 8 by default
+            # (examples/lww-register.rs:194-196).  The device run bounds
+            # tighter to fit its default table capacity.
+            target_max_depth=8,
+            tpu=True,
+            tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 9),
+            tpu_target_max_depth=6,
         ),
         argv,
     )

@@ -1,0 +1,85 @@
+"""Device-kernel gates for the compiled LWW-register CRDT.
+
+This closes the last reference action family on device: SelectRandom
+(src/actor/model.rs:320-333).  The model also exercises reachable
+multiset counts > 1 (a register-less SetValue re-broadcasts an identical
+envelope), encoded as repeated sorted slots like raft's fabric.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.models.lww_compiled import LwwCompiled  # noqa: E402
+from stateright_tpu.models.lww_register import build_model  # noqa: E402
+from stateright_tpu.ops.fingerprint import fingerprint  # noqa: E402
+
+
+def test_step_differential_to_depth_3():
+    """Successors (full rows), validity, flags, and the eventually-
+    consistent predicate vs the host model over the 706 states within 3
+    actions of init — SetValue/SetTime SelectRandom lanes and merge-by-
+    (timestamp, updater) deliveries all fire in this prefix."""
+    model = build_model(2)
+    cm = LwwCompiled(model)
+    props = model.properties()
+    seen = {}
+    frontier = list(model.init_states())
+    for s in frontier:
+        seen[fingerprint(s)] = s
+    depth = 0
+    while frontier and depth < 3:
+        depth += 1
+        encs = np.stack([cm.encode(s) for s in frontier]).astype(np.uint32)
+        nb, vb, fb = jax.vmap(cm.step)(jnp.asarray(encs))
+        nb = np.asarray(nb)
+        vb = np.asarray(vb)
+        assert not np.asarray(fb).any()
+        cb = np.asarray(jax.vmap(cm.property_conds)(jnp.asarray(encs)))
+        nxt = []
+        for bi, s in enumerate(frontier):
+            assert fingerprint(cm.decode(encs[bi])) == fingerprint(s)
+            want = [bool(p.condition(model, s)) for p in props]
+            assert want == [bool(x) for x in cb[bi]], s
+            acts = []
+            model.actions(s, acts)
+            host_succ = set()
+            for a in acts:
+                ns = model.next_state(s, a)
+                if ns is None:
+                    continue
+                host_succ.add(tuple(cm.encode(ns).tolist()))
+                fp = fingerprint(ns)
+                if fp not in seen:
+                    seen[fp] = ns
+                    nxt.append(ns)
+            dev_succ = {
+                tuple(nb[bi, k].tolist())
+                for k in range(cm.max_actions)
+                if vb[bi, k]
+            }
+            assert dev_succ == host_succ, s
+        frontier = nxt
+    assert len(seen) == 706
+
+
+def test_spawn_tpu_lww_depth5_matches_host():
+    """Depth-bounded engine parity (the reference checks this model only
+    depth-bounded, examples/lww-register.rs:190-196)."""
+    tpu = (
+        build_model(2)
+        .checker()
+        .target_max_depth(5)
+        .spawn_tpu(capacity=1 << 14, max_frontier=1 << 8)
+        .join()
+    )
+    host = (
+        build_model(2).checker().target_max_depth(5).spawn_bfs().join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_no_discovery("eventually consistent")
