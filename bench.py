@@ -53,11 +53,12 @@ sys.path.insert(0, str(_REPO))
 GOLDEN_UNIQUE = 1_194_428
 GOLDEN_DEPTH = 28
 HOST_TIME_SLICE = 60.0  # seconds of host BFS to establish the denominator
-# f=8192/dd=4 measured best on the v5e: per-chunk cost scales ~linearly
-# with max_frontier (no amortization win at 32k), and dedup_factor=16
-# overflows the compact-insert buffer on wide levels (scratch profiling,
-# round 3; see docs/TPU_PAXOS_DESIGN.md).
-TPU_KWARGS = dict(capacity=1 << 23, max_frontier=1 << 13)
+# f=8192/dd=8 measured best on the v5e (221k uniq/s): per-chunk cost
+# scales ~linearly with max_frontier (no amortization win at 32k);
+# dedup_factor=8 halves the probe-round width vs 4 and the widest paxos3
+# levels still fit its 32k valid-lane buffer, while 16 overflows
+# (scratch profiling, round 3; see docs/TPU_PAXOS_DESIGN.md).
+TPU_KWARGS = dict(capacity=1 << 23, max_frontier=1 << 13, dedup_factor=8)
 
 # Substrings identifying transient tunneled-device failures worth retrying
 # (observed: jax.errors.JaxRuntimeError INTERNAL "remote_compile: read
