@@ -139,7 +139,9 @@ class ShardedTpuChecker(Checker):
         from jax.sharding import PartitionSpec as P
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, insert_batch_compact, prededup
+        from .hashset import (
+            HashSet, compact_valid, insert_batch_compact, prededup,
+        )
         from .wave_common import make_finish_when_device, wave_eval
 
         cm = self._compiled
@@ -223,9 +225,18 @@ class ShardedTpuChecker(Checker):
             flat = nexts.reshape(b, w)
             flat_valid = valid.reshape(b)
             hi, lo = device_fp64(flat[:, :fpw])
-            u_hi, u_lo, u_origin, u_valid, local_overflow = prededup(
+            # Same two-stage shrink as the single-chip engine: compact the
+            # sparse valid lanes first (hashset.compact_valid, shared so
+            # the overflow criterion cannot drift), then dedup the
+            # compacted buffer — the sort and every downstream scatter
+            # work on real keys, not the sentinel-padded majority.
+            v_hi, v_lo, v_orig, v_act, local_overflow = compact_valid(
                 hi, lo, flat_valid, dedup_factor
             )
+            u_hi, u_lo, u_origin0, u_valid, _never = prededup(
+                v_hi, v_lo, v_act, dedup_factor=1
+            )
+            u_origin = v_orig[u_origin0]
             u_sz = u_hi.shape[0]
             rows_u = flat[u_origin]
             gid_u = my_gids[u_origin // u(a)]
@@ -684,9 +695,11 @@ class ShardedTpuChecker(Checker):
                 )
             if flags_h & 4:
                 raise RuntimeError(
-                    "a shard received more distinct states in one chunk "
-                    "than its insert dedup buffer holds; lower "
-                    f"dedup_factor (now {self._dedup_factor}) or chunk_size"
+                    "a shard's chunk had more VALID successor candidates "
+                    "(pre-exchange) or received more distinct states "
+                    "(post-exchange) than its compaction/dedup buffers "
+                    f"hold; lower dedup_factor (now {self._dedup_factor}; "
+                    "1 is always safe) or chunk_size"
                 )
             if flags_h & 8:
                 raise RuntimeError(

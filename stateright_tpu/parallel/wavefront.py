@@ -142,7 +142,9 @@ class TpuChecker(Checker):
         import jax.numpy as jnp
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, insert_batch, insert_batch_compact
+        from .hashset import (
+            HashSet, compact_valid, insert_batch, insert_batch_compact,
+        )
         from .wave_common import wave_eval
 
         cm = self._compiled
@@ -219,24 +221,13 @@ class TpuChecker(Checker):
             flat = nexts.reshape(f * a, w)
             flat_valid = valid.reshape(f * a)
             hi, lo = device_fp64(flat[:, :fpw])
-            # Compact the ~5% valid lanes to a B/dedup_factor buffer
-            # BEFORE the dedup sort: three 1-word scatters are cheaper
-            # than sorting the sentinel-padded majority (measured +13%
-            # throughput on the bench workload; warm-compile is
+            # Compact the ~5% valid lanes BEFORE the dedup sort (measured
+            # +13% throughput on the bench workload; warm-compile is
             # unaffected — it is pinned by the platform's server-side
             # compile, see docs).  Overflow flags loudly (flag 4).
-            from .wave_common import compact
-
-            b_lanes = f * a
-            v_sz = max(min(b_lanes, 1 << 14), b_lanes // dedup_factor)
-            v_hi = compact(flat_valid, hi, v_sz)
-            v_lo = compact(flat_valid, lo, v_sz)
-            v_orig = compact(
-                flat_valid, jnp.arange(b_lanes, dtype=jnp.uint32), v_sz
+            v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
+                hi, lo, flat_valid, dedup_factor
             )
-            n_valid = jnp.sum(flat_valid, dtype=jnp.uint32)
-            v_act = jnp.arange(v_sz, dtype=jnp.uint32) < n_valid
-            v_overflow = n_valid > jnp.uint32(v_sz)
             (
                 table, u_slot, u_new, u_origin, _u_active, probe_ok,
                 dd_overflow,
@@ -563,10 +554,11 @@ class TpuChecker(Checker):
                     )
                 if flags_h & 4:
                     raise RuntimeError(
-                        "a wave generated more distinct states than the "
-                        "insert dedup buffer holds (batch/dedup_factor); "
-                        f"lower spawn_tpu(dedup_factor=...) (now "
-                        f"{self._dedup_factor})"
+                        "a wave generated more VALID successor candidates "
+                        "than the compaction/dedup buffers hold "
+                        "(batch/dedup_factor); lower "
+                        f"spawn_tpu(dedup_factor=...) (now "
+                        f"{self._dedup_factor}; 1 is always safe)"
                     )
                 if flags_h & 8:
                     raise RuntimeError(

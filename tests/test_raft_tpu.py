@@ -149,3 +149,21 @@ def test_spawn_tpu_raft_depth9_device():
     tpu.assert_any_discovery("Log Liveness")
     tpu.assert_no_discovery("Election Safety")
     tpu.assert_no_discovery("State Machine Safety")
+
+
+def test_spawn_tpu_simulation_raft():
+    """Device Monte-carlo over the crash/recover model: random walks are
+    depth-bounded like the reference's default check (deep walks would
+    exceed the packed term budget, which the step flag would loudly
+    reject), find leaders fast, and never trip the safety properties."""
+    sim = (
+        raft_model()
+        .checker()
+        .target_max_depth(12)
+        .target_state_count(5_000)
+        .spawn_tpu_simulation(seed=3, walkers=128)
+        .join()
+    )
+    assert sim.state_count() >= 5_000
+    assert "Election Safety" not in sim.discoveries()
+    assert "State Machine Safety" not in sim.discoveries()
