@@ -218,8 +218,8 @@ class ShardedTpuChecker(Checker):
             # Local pre-dedup BEFORE the exchange: one stable sort elects a
             # representative per distinct local key, so only distinct keys
             # (U = B/dedup_factor lanes, not B) pay for the owner bucketing
-            # scatters, the four all_to_alls, and the owner-side row
-            # scatters.  Candidate batches are ~95% invalid/duplicate
+            # scatters, the single packed all_to_all, and the owner-side
+            # row scatters.  Candidate batches are ~95% invalid/duplicate
             # lanes; profiling the single-chip engine showed exactly these
             # B-indexed row operations dominating the chunk.
             flat = nexts.reshape(b, w)
@@ -259,39 +259,33 @@ class ShardedTpuChecker(Checker):
             pos = jnp.arange(u_sz, dtype=u) - offsets[key_s]
             dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
 
-            send_words = jnp.zeros((n, u_sz, w), u)
-            send_words = send_words.at[dst, pos].set(
-                rows_u[order], mode="drop"
+            # Pack the row + its parent gid, ebits, and validity into one
+            # [n, U, W+3] buffer so a SINGLE all_to_all (one collective
+            # launch per chunk, not four) carries the whole exchange —
+            # the docstring's W+3 layout.
+            payload = jnp.concatenate(
+                [
+                    rows_u,
+                    gid_u[:, None],
+                    eb_u[:, None],
+                    u_valid.astype(u)[:, None],
+                ],
+                axis=1,
             )
-            send_gid = jnp.full((n, u_sz), NO_GID, u)
-            send_gid = send_gid.at[dst, pos].set(gid_u[order], mode="drop")
-            send_eb = jnp.zeros((n, u_sz), u)
-            send_eb = send_eb.at[dst, pos].set(eb_u[order], mode="drop")
-            send_valid = jnp.zeros((n, u_sz), jnp.bool_)
-            send_valid = send_valid.at[dst, pos].set(
-                u_valid[order], mode="drop"
-            )
-
-            recv_words = jax.lax.all_to_all(
-                send_words, "shards", split_axis=0, concat_axis=0, tiled=False
-            )
-            recv_gid = jax.lax.all_to_all(
-                send_gid, "shards", split_axis=0, concat_axis=0, tiled=False
-            )
-            recv_eb = jax.lax.all_to_all(
-                send_eb, "shards", split_axis=0, concat_axis=0, tiled=False
-            )
-            recv_valid = jax.lax.all_to_all(
-                send_valid, "shards", split_axis=0, concat_axis=0, tiled=False
+            send = jnp.zeros((n, u_sz, w + 3), u)
+            send = send.at[dst, pos].set(payload[order], mode="drop")
+            recv = jax.lax.all_to_all(
+                send, "shards", split_axis=0, concat_axis=0, tiled=False
             )
 
             # Local insert — the owner's insert IS the global dedup; the
             # compact form keeps the store/parent/queue scatters
             # proportional to distinct received keys.
-            rw = recv_words.reshape(n * u_sz, w)
-            rv = recv_valid.reshape(n * u_sz)
-            rg = recv_gid.reshape(n * u_sz)
-            reb = recv_eb.reshape(n * u_sz)
+            flatrecv = recv.reshape(n * u_sz, w + 3)
+            rw = flatrecv[:, :w]
+            rg = flatrecv[:, w]
+            reb = flatrecv[:, w + 1]
+            rv = flatrecv[:, w + 2] != u(0)
             rhi, rlo = device_fp64(rw[:, :fpw])
             # dedup_factor=1: the receive batch is already per-sender
             # deduped, so its distinct-key count can approach the full
