@@ -10,7 +10,10 @@ import sys
 
 # Hermetic default: force the cpu platform (ambient JAX_PLATFORMS often
 # points at a TPU plugin that sitecustomize preloads).  To validate on real
-# hardware, opt in explicitly: STATERIGHT_TPU_TEST_PLATFORM=tpu pytest …
+# hardware, opt in explicitly with the platform's jax name, e.g.
+#   STATERIGHT_TPU_TEST_PLATFORM=tpu pytest -m tpu
+# (on this box the tunneled device registers as the "axon" platform, so
+#  STATERIGHT_TPU_TEST_PLATFORM=axon — all 3 tpu-marked goldens pass there).
 _platform = os.environ.get("STATERIGHT_TPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
