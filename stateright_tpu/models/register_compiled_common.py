@@ -255,6 +255,24 @@ class RegisterClientCodec:
         against the host tester over both full reachable state spaces and
         an exhaustive synthetic tester-state enumeration (including
         violations).
+
+        The subset dimension is BIT-PACKED into u32 lanes (bit k of word w
+        = subset 32w+k), so the DP state is ``[nv, nsub/32]`` u32 instead
+        of ``[nsub, nv]`` bool and every transition is word-parallel:
+
+        - appending op o maps subset ``sub^bit`` -> ``sub``, which for
+          o < 5 is an in-word shift by 2^o masked to lanes with bit o set,
+          and for o >= 5 a static word-level butterfly (low half -> high
+          half at stride 2^(o-5));
+        - the real-time gate ``pm[o] ⊆ sub`` is a *superset indicator*,
+          built by ANDing the static has-bit masks of pm's set bits — no
+          per-subset arithmetic at all (pm never contains o itself: writes
+          have empty masks and a read's mask holds only other ops, so the
+          gate over ``sub`` equals the gate over ``sub^bit``).
+
+        At C=6 this turns 28,672 bool cells/state into 896 u32 words/state
+        (the difference between `paxos check 6` lowering and running —
+        VERDICT r3 #1; cost table in docs/TPU_PAXOS_DESIGN.md).
         """
         import jax.numpy as jnp
 
@@ -263,6 +281,7 @@ class RegisterClientCodec:
         n_ops = 2 * c  # op i = W_i (client i's put), op c+i = R_i (its get)
         nsub = 1 << n_ops
         nv = c + 1  # register values: 0 = NULL, 1+i = client i's value
+        nwords = max(1, nsub // 32)
         lcb = self.lcb
         tst0 = self.tst0
 
@@ -299,37 +318,84 @@ class RegisterClientCodec:
             pm.append(mask)
         present = w_present + r_present
 
-        sub = np.arange(nsub, dtype=np.uint32)
-        dp = jnp.zeros((nsub, nv), jnp.bool_)
-        dp = dp.at[0, 0].set(True)
-        col = np.eye(nv, dtype=bool)
-        for _ in range(n_ops):
+        # Static has-bit masks: HAS[b] bit k of word w <=> subset 32w+k
+        # contains op b.  Only real subsets get bits, so for nsub < 32 the
+        # unused high lanes of the single word can never light up.
+        sub_np = np.arange(nsub, dtype=np.uint64)
+        weights = (np.uint64(1) << np.arange(32, dtype=np.uint64))
+        has_np = np.empty((n_ops, nwords), np.uint32)
+        for b in range(n_ops):
+            bits = ((sub_np >> np.uint64(b)) & np.uint64(1)).astype(np.uint64)
+            pad = np.zeros(nwords * 32 - nsub, np.uint64)
+            bits = np.concatenate([bits, pad]).reshape(nwords, 32)
+            has_np[b] = (bits * weights[None, :]).sum(axis=1).astype(np.uint32)
+        HAS = jnp.asarray(has_np)  # [n_ops, nwords]
+        ones = jnp.full((nwords,), 0xFFFFFFFF, u)
+
+        def superset_indicator(mask_scalar):
+            """Packed indicator of {sub : mask ⊆ sub} via AND of HAS rows."""
+            out = ones
+            for b in range(n_ops):
+                bit_set = (mask_scalar >> u(b)) & u(1)
+                out = out & jnp.where(bit_set == u(1), HAS[b], ones)
+            return out
+
+        # Per-op gates, hoisted out of the sweep (pm is sweep-invariant).
+        gates = []
+        for o in range(n_ops):
+            g = superset_indicator(pm[o]) & HAS[o]
+            gates.append(jnp.where(present[o], g, jnp.zeros((), u)))
+        v_arange = jnp.arange(nv, dtype=u)
+        # Read-op value-column mask, also sweep-invariant: [n_ops, nv].
+        colmask = []
+        for o in range(n_ops):
+            if o < c:
+                colmask.append((v_arange == u(1 + o)).astype(u) * u(0xFFFFFFFF))
+            else:
+                colmask.append(
+                    (v_arange == v_read[o - c]).astype(u) * u(0xFFFFFFFF)
+                )
+
+        def shift_src(dp, o):
+            """dp word-image of sub^bit(o) at lanes with bit o set."""
+            if o < 5:
+                return (dp << u(1 << o)) & HAS[o][None, :]
+            stride = 1 << (o - 5)
+            r = dp.reshape(nv, nwords // (2 * stride), 2, stride)
+            lowhalf = r[:, :, 0:1, :]
+            shifted = jnp.concatenate(
+                [jnp.zeros_like(lowhalf), lowhalf], axis=2
+            )
+            return shifted.reshape(nv, nwords)
+
+        def sweep(dp):
             for o in range(n_ops):
-                bit = 1 << o
-                has = (sub & bit) != 0  # static
-                src = np.where(has, sub ^ bit, 0).astype(np.uint32)
-                dp_src = dp[src]
-                predok = ((pm[o] & ~jnp.asarray(src)) == u(0)) & present[o]
-                if o < c:  # write: register becomes 1+o
-                    add = (
-                        jnp.any(dp_src, axis=-1)
-                        & predok
-                        & jnp.asarray(has)
-                    )
-                    dp = dp | (add[:, None] & jnp.asarray(col[1 + o])[None, :])
-                else:  # read: register must equal the returned value
-                    vmatch = jnp.arange(nv, dtype=u) == v_read[o - c]
-                    add = (
-                        dp_src
-                        & vmatch[None, :]
-                        & predok[:, None]
-                        & jnp.asarray(has)[:, None]
-                    )
-                    dp = dp | add
+                shifted = shift_src(dp, o)
+                if o < c:
+                    # Write: any source value reaches; register becomes 1+o.
+                    any_v = shifted[0]
+                    for v in range(1, nv):
+                        any_v = any_v | shifted[v]
+                    add = any_v & gates[o]
+                    dp = dp | (add[None, :] & colmask[o][:, None])
+                else:
+                    # Read: register must already equal the returned value.
+                    dp = dp | (shifted & gates[o] & colmask[o][:, None])
+            return dp
+
+        dp0 = jnp.zeros((nv, nwords), u).at[0, 0].set(u(1))
+        # n_ops rounds of relaxation reach any appendable-op order; the
+        # round body is o-unrolled but round-invariant, so a fori_loop
+        # keeps the trace 2C× smaller than full unrolling.
+        import jax
+
+        dp = jax.lax.fori_loop(
+            0, n_ops, lambda _, d: sweep(d), dp0, unroll=False
+        )
 
         req = u(0)
         for i in range(c):
             req = req | jnp.where(w_completed[i], u(1 << i), u(0))
             req = req | jnp.where(r_present[i], u(1 << (c + i)), u(0))
-        covers = (req & ~jnp.asarray(sub)) == u(0)
-        return jnp.any(dp & covers[:, None])
+        covers = superset_indicator(req)
+        return jnp.any((dp & covers[None, :]) != u(0))
