@@ -383,7 +383,12 @@ class RegisterClientCodec:
                     dp = dp | (shifted & gates[o] & colmask[o][:, None])
             return dp
 
-        dp0 = jnp.zeros((nv, nwords), u).at[0, 0].set(u(1))
+        # ``| (state[0] & 0)`` types the loop carry as varying so the DP
+        # also traces under the sharded engine's shard_map (a constant
+        # carry with a varying loop body fails scan type checking).
+        dp0 = (
+            jnp.zeros((nv, nwords), u) | (state[0] & u(0))
+        ).at[0, 0].set(u(1))
         # n_ops rounds of relaxation reach any appendable-op order; the
         # round body is o-unrolled but round-invariant, so a fori_loop
         # keeps the trace 2C× smaller than full unrolling.

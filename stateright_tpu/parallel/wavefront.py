@@ -8,8 +8,12 @@ into an HBM-resident fingerprint table, and property conditions are fused
 predicates over the whole wave.
 
 The whole wave loop runs on device inside one ``lax.while_loop`` program —
-frontier, visited table, counters, and discovery slots all live in HBM, and
-the host reads back a handful of scalars every ``waves_per_call`` waves.
+the append-only state-row log, visited table, counters, and discovery slots
+all live in HBM, and the host reads back a handful of scalars every
+``waves_per_call`` waves.  States are identified by *BFS position* (the
+order of first discovery): positions within a level are contiguous, so the
+frontier read and the new-state append are contiguous block transfers, and
+the only randomly-indexed memory is the fingerprint hash table.
 This matters doubly on hardware reached through a network tunnel: the
 chunked-dispatch version spent ~95% of wall-clock on per-wave host↔device
 round trips.
@@ -110,8 +114,7 @@ class TpuChecker(Checker):
         self._resume_from = resume_from
         self._carry_dev: Optional[dict] = None  # full run state at stop
         self._discoveries_cache: Optional[Dict[str, Path]] = None
-        self._tables_host: Optional[tuple] = None  # (parent, states) np arrays
-        self._tables_dev: Optional[tuple] = None  # same, still on device
+        self._tables_dev: Optional[tuple] = None  # (parent, rows) on device
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -121,20 +124,32 @@ class TpuChecker(Checker):
     def _build_run(self):
         """Build the fused multi-chunk program.
 
-        The frontier is a FIFO *slot queue* in HBM with explicit BFS-level
-        boundaries: each loop iteration expands one chunk (≤ ``chunk``
-        states) of the current level, appends newly inserted slots at the
-        queue tail, and advances ``depth`` only when a level is fully
+        States live in an APPEND-ONLY row log indexed by *BFS position*
+        (the order states were first discovered), with explicit level
+        boundaries: each loop iteration expands one chunk (≤ ``f``
+        positions) of the current level, appends newly inserted states'
+        rows at the tail, and advances ``depth`` only when a level is fully
         drained — so levels may be arbitrarily wide (no frontier-overflow
         failure mode) while depth/target semantics stay exactly those of a
         level-at-a-time BFS.
 
-        Carry: (key_hi, key_lo, store, parent, ebits, queue, level_start,
+        Because positions in a level are contiguous, the chunk read is one
+        contiguous ``dynamic_slice`` and the append is one contiguous
+        ``dynamic_update_slice`` — the only randomly-indexed memory left is
+        the hash table itself.  The log is a FLAT u32 buffer: a 2-D
+        ``[positions, W]`` layout gets its minor dim tile-padded to 128
+        lanes (W=42 → 3×, W=75 → 1.7× HBM — the round-3 store shipped that
+        way and it capped paxos c≥4 and raft depth 12 on a 16 GB chip),
+        and XLA re-imposes that layout on a transposed store, so flat +
+        block access is the only padding-free shape.  Offsets can exceed
+        2³¹ (u32 starts; validated on-device up to 10.7 GB buffers).
+
+        Carry: (key_hi, key_lo, rows, parent, ebits, level_start,
         level_end, tail, sc_lo, sc_hi, unique_count, depth, disc[P],
         waves_left, flags).  ``sc_lo``/``sc_hi`` form the 64-bit
         generated-state counter (no u64 on device).  flag values: 1 = table
-        overfull (probe failure or beyond 50% load); 2 = queue overflow
-        (cannot happen before 1 at queue size == capacity; kept as a
+        overfull (probe failure or beyond 50% load); 2 = position log full
+        (cannot happen before 1 at log length == capacity; kept as a
         backstop); 4 = insert dedup-buffer overflow; 8 = model step kernel
         capacity overflow.
         """
@@ -155,7 +170,8 @@ class TpuChecker(Checker):
         a = cm.max_actions
         f = self._max_frontier  # chunk size
         cap = self._capacity
-        qcap = cap  # every unique state enters the queue exactly once
+        qcap = cap  # every unique state occupies exactly one position
+        pad = self._block_pad()  # append-block lanes past qcap
         dedup_factor = self._dedup_factor
         props = self._properties
         n_props = len(props)
@@ -180,10 +196,9 @@ class TpuChecker(Checker):
             (
                 key_hi,
                 key_lo,
-                store,
+                rows,
                 parent,
                 ebits,
-                queue,
                 level_start,
                 level_end,
                 tail,
@@ -197,15 +212,16 @@ class TpuChecker(Checker):
             ) = carry
 
             count = jnp.minimum(level_end - level_start, jnp.uint32(f))
-            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
             lane = jnp.arange(f, dtype=jnp.uint32)
             active = lane < count
-            safe_slots = jnp.where(active, chunk, 0)
-            states = store[safe_slots]  # [F, W]
+            ids = level_start + lane  # BFS positions are the state ids
+            states = jax.lax.dynamic_slice(
+                rows, (level_start * jnp.uint32(w),), (f * w,)
+            ).reshape(f, w)
+            eb_chunk = jax.lax.dynamic_slice(ebits, (level_start,), (f,))
 
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
-                cm, props, ev_indices, states, active, safe_slots,
-                ebits[safe_slots], disc,
+                cm, props, ev_indices, states, active, ids, eb_chunk, disc,
             )
             new_lo = sc_lo + generated
             sc_hi = sc_hi + (new_lo < sc_lo).astype(jnp.uint32)
@@ -213,11 +229,8 @@ class TpuChecker(Checker):
 
             # Dedup + insert, in compact form: results come back U-sized
             # (one lane per distinct key, U = B/dedup_factor), so the
-            # row/parent/ebits/queue scatters below cost O(distinct keys)
-            # instead of O(candidate lanes).  Profiling on the chip showed
-            # the B-indexed 42-word row scatter alone was ~2/3 of the
-            # 69 ms chunk — ~95% of candidate lanes are invalid or
-            # duplicates and paid full scatter price anyway.
+            # append below costs O(distinct keys) instead of O(candidate
+            # lanes) — ~95% of candidate lanes are invalid or duplicates.
             flat = nexts.reshape(f * a, w)
             flat_valid = valid.reshape(f * a)
             hi, lo = device_fp64(flat[:, :fpw])
@@ -229,7 +242,7 @@ class TpuChecker(Checker):
                 hi, lo, flat_valid, dedup_factor
             )
             (
-                table, u_slot, u_new, u_origin, _u_active, probe_ok,
+                table, _u_slot, u_new, u_origin, _u_active, probe_ok,
                 dd_overflow,
             ) = insert_batch_compact(
                 HashSet(key_hi, key_lo), v_hi, v_lo, v_act,
@@ -237,26 +250,31 @@ class TpuChecker(Checker):
             )
             dd_overflow = dd_overflow | v_overflow
             u_origin = v_orig[u_origin]
-            # Representative row + its parent/ebits, gathered at the
-            # compact lanes (u_origin is the rep's original flat lane; the
-            # rep is the lowest lane of each key run, so first-inserter
-            # ebits semantics are unchanged).
-            rows = flat[u_origin]
-            src_state = u_origin // jnp.uint32(a)
-            par_u = safe_slots[src_state]
-            eb_u = eb[src_state]
-            sslot = jnp.where(u_new, u_slot, jnp.uint32(cap))
-            store = store.at[sslot].set(rows, mode="drop")
-            parent = parent.at[sslot].set(par_u, mode="drop")
-            ebits = ebits.at[sslot].set(eb_u, mode="drop")
             n_new = jnp.sum(u_new, dtype=jnp.uint32)
             unique_count = unique_count + n_new
 
-            # Append new slots at the queue tail (sorted-key order within
-            # the chunk — deterministic, like the old lane order).
-            qpos = tail + jnp.cumsum(u_new.astype(jnp.uint32)) - 1
-            qidx = jnp.where(u_new, qpos, jnp.uint32(qcap + f))
-            queue = queue.at[qidx].set(u_slot, mode="drop")
+            # Select the newly inserted representatives (in sorted-key
+            # order, matching position assignment) and APPEND their rows,
+            # parent positions, and ebits as three contiguous block writes
+            # — no table-sized scatters at all.  ``sel`` lanes beyond
+            # n_new alias lane 0; their garbage lands at positions ≥ the
+            # new tail, which only ever get (re)written by later appends
+            # before any read.  First-inserter ebits semantics are
+            # unchanged (u_origin is the lowest lane of each key run).
+            u = u_new.shape[0]
+            from .wave_common import compact
+
+            sel = compact(u_new, jnp.arange(u, dtype=jnp.uint32), pad)
+            idxs = u_origin[sel]  # original flat candidate lane
+            rows_blk = flat[idxs]  # [pad, w] gather
+            src_state = idxs // jnp.uint32(a)
+            par_blk = level_start + src_state
+            eb_blk = eb[src_state]
+            rows = jax.lax.dynamic_update_slice(
+                rows, rows_blk.reshape(-1), (tail * jnp.uint32(w),)
+            )
+            parent = jax.lax.dynamic_update_slice(parent, par_blk, (tail,))
+            ebits = jax.lax.dynamic_update_slice(ebits, eb_blk, (tail,))
             tail = tail + n_new
 
             # Advance within the level; roll the level boundary when drained.
@@ -278,10 +296,9 @@ class TpuChecker(Checker):
             return (
                 table.key_hi,
                 table.key_lo,
-                store,
+                rows,
                 parent,
                 ebits,
-                queue,
                 level_start,
                 level_end,
                 tail,
@@ -295,12 +312,12 @@ class TpuChecker(Checker):
             )
 
         def wave_cond(carry):
-            level_start = carry[6]
-            level_end = carry[7]
-            depth = carry[12]
-            disc = carry[13]
-            waves_left = carry[14]
-            flags = carry[15]
+            level_start = carry[5]
+            level_end = carry[6]
+            depth = carry[11]
+            disc = carry[12]
+            waves_left = carry[13]
+            flags = carry[14]
             go = (level_start < level_end) & (waves_left > 0) & (flags == 0)
             if target_depth:
                 # The next chunk would expand states at depth+1; the
@@ -310,17 +327,16 @@ class TpuChecker(Checker):
             go = go & ~fw_matched(disc)
             return go
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
-        def run(key_hi, key_lo, store, parent, ebits, queue, level_start,
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def run(key_hi, key_lo, rows, parent, ebits, level_start,
                 level_end, tail, sc_lo, sc_hi, unique_count, depth, disc,
                 waves):
             carry = (
                 key_hi,
                 key_lo,
-                store,
+                rows,
                 parent,
                 ebits,
-                queue,
                 level_start,
                 level_end,
                 tail,
@@ -337,29 +353,29 @@ class TpuChecker(Checker):
         eb0 = (1 << len(ev_indices)) - 1
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def seed(key_hi, key_lo, store, ebits, init_padded, n_init):
+        def seed(key_hi, key_lo, rows, ebits, init_padded, n_init):
             from .wave_common import compact
 
             hi, lo = device_fp64(init_padded[:, :fpw])
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
-            table, slot, is_new, probe_ok, dd_overflow = insert_batch(
+            table, _slot, is_new, probe_ok, dd_overflow = insert_batch(
                 HashSet(key_hi, key_lo), hi, lo, seed_active
             )
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap))
-            store = store.at[sslot].set(init_padded, mode="drop")
-            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            # Queue is padded by one chunk so mid-level dynamic slices never
-            # clamp; slots beyond the tail are masked by `count` anyway.
-            queue = jnp.zeros((qcap + f,), jnp.uint32)
-            queue = queue.at[:f].set(compact(is_new, slot, f))
+            # Unique init states take positions 0..fcount in lane order.
+            sel = compact(is_new, jnp.arange(f, dtype=jnp.uint32), f)
+            rows = jax.lax.dynamic_update_slice(
+                rows, init_padded[sel].reshape(-1), (jnp.uint32(0),)
+            )
+            ebits = jax.lax.dynamic_update_slice(
+                ebits, jnp.full((f,), eb0, jnp.uint32), (jnp.uint32(0),)
+            )
             fcount = jnp.sum(is_new, dtype=jnp.uint32)
             ok = probe_ok & ~dd_overflow
             return (
                 table.key_hi,
                 table.key_lo,
-                store,
+                rows,
                 ebits,
-                queue,
                 fcount,
                 ok,
             )
@@ -413,6 +429,19 @@ class TpuChecker(Checker):
             _time.monotonic() + opts._timeout if opts._timeout is not None else None
         )
 
+        qcap = cap
+        pad = self._block_pad()
+
+        def sized(arr_np, n):
+            """Pad/trim a 1-D snapshot array to ``n`` (the tail padding
+            holds garbage by construction, so resumes may use different
+            block-pad tuning than the run that saved the snapshot)."""
+            if arr_np.shape[0] < n:
+                return np.concatenate(
+                    [arr_np, np.zeros(n - arr_np.shape[0], arr_np.dtype)]
+                )
+            return arr_np[:n]
+
         with jax.default_device(self._device):
             seed, run = self._programs()
             if self._resume_from is not None:
@@ -426,10 +455,11 @@ class TpuChecker(Checker):
                     )
                 key_hi = jnp.asarray(snap["key_hi"])
                 key_lo = jnp.asarray(snap["key_lo"])
-                store = jnp.asarray(snap["store"])
-                parent = jnp.asarray(snap["parent"])
-                ebits = jnp.asarray(snap["ebits"])
-                queue = jnp.asarray(snap["queue"])
+                rows = jnp.asarray(
+                    sized(np.asarray(snap["rows"]), (qcap + pad) * cm.state_width)
+                )
+                parent = jnp.asarray(sized(np.asarray(snap["parent"]), qcap + pad))
+                ebits = jnp.asarray(sized(np.asarray(snap["ebits"]), qcap + pad))
                 level_start = jnp.uint32(int(snap["level_start"]))
                 level_end = jnp.uint32(int(snap["level_end"]))
                 tail = jnp.uint32(int(snap["tail"]))
@@ -450,28 +480,30 @@ class TpuChecker(Checker):
                             self._discovery_slots[prop.name] = int(disc_np[p])
             else:
                 table = make_hashset(cap)
-                store = jnp.zeros((cap, cm.state_width), jnp.uint32)
-                parent = jnp.full((cap,), NO_SLOT_HOST, jnp.uint32)
-                ebits = jnp.zeros((cap,), jnp.uint32)
+                rows = jnp.zeros(
+                    ((qcap + pad) * cm.state_width,), jnp.uint32
+                )
+                parent = jnp.full((qcap + pad,), NO_SLOT_HOST, jnp.uint32)
+                ebits = jnp.zeros((qcap + pad,), jnp.uint32)
 
                 # Seed init states.
                 init = cm.init_packed()
                 n_init = init.shape[0]
                 if n_init > f:
                     # The one level still bounded by the chunk size: seeding
-                    # writes the init batch into the queue in one program.
+                    # writes the init batch into the log in one program.
                     raise ValueError(
                         f"{n_init} init states exceed the chunk size "
                         f"({f}); raise spawn_tpu(max_frontier=...) to at "
                         "least the init-state count (interior levels are "
                         "unbounded)"
                     )
-                pad = np.zeros((f - n_init, cm.state_width), np.uint32)
-                init_padded = jnp.asarray(np.concatenate([init, pad]))
-                key_hi, key_lo, store, ebits, queue, fcount, seed_ok = seed(
+                fill = np.zeros((f - n_init, cm.state_width), np.uint32)
+                init_padded = jnp.asarray(np.concatenate([init, fill]))
+                key_hi, key_lo, rows, ebits, fcount, seed_ok = seed(
                     table.key_hi,
                     table.key_lo,
-                    store,
+                    rows,
                     ebits,
                     init_padded,
                     jnp.uint32(n_init),
@@ -497,10 +529,9 @@ class TpuChecker(Checker):
                 (
                     key_hi,
                     key_lo,
-                    store,
+                    rows,
                     parent,
                     ebits,
-                    queue,
                     level_start,
                     level_end,
                     tail,
@@ -514,10 +545,9 @@ class TpuChecker(Checker):
                 ) = run(
                     key_hi,
                     key_lo,
-                    store,
+                    rows,
                     parent,
                     ebits,
-                    queue,
                     level_start,
                     level_end,
                     tail,
@@ -549,8 +579,8 @@ class TpuChecker(Checker):
                     )
                 if flags_h & 2:
                     raise RuntimeError(
-                        "frontier queue overflowed its backstop bound; raise "
-                        "spawn_tpu(capacity=...)"
+                        "the position log overflowed its backstop bound; "
+                        "raise spawn_tpu(capacity=...)"
                     )
                 if flags_h & 4:
                     raise RuntimeError(
@@ -586,19 +616,19 @@ class TpuChecker(Checker):
                 if deadline is not None and _time.monotonic() >= deadline:
                     break
 
-            # Keep the device arrays; path reconstruction pulls them to the
-            # host lazily (the readback is expensive on tunneled devices).
-            self._tables_dev = (parent, store)
+            # Keep the device arrays; path reconstruction walks the parent
+            # chain ON DEVICE and reads back only the chain (a full-table
+            # pull would be GBs through a tunneled device's ~18 MB/s link).
+            self._tables_dev = (parent, rows)
             # Full run state, for snapshotting: the reference cannot persist
             # a run's visited set at all (SURVEY §5); here the whole checker
             # state is a handful of dense arrays.
             self._carry_dev = {
                 "key_hi": key_hi,
                 "key_lo": key_lo,
-                "store": store,
+                "rows": rows,
                 "parent": parent,
                 "ebits": ebits,
-                "queue": queue,
                 "level_start": level_start,
                 "level_end": level_end,
                 "tail": tail,
@@ -608,6 +638,15 @@ class TpuChecker(Checker):
                 "depth": depth,
                 "disc": disc,
             }
+
+    def _block_pad(self) -> int:
+        """Append-block lanes past the position log's capacity: one chunk's
+        insert can mint up to U = max(min(B, 16K), B/dedup_factor) new
+        states (hashset.py's unique-buffer size), and appends are whole
+        U-blocks whose tail garbage must land in bounds."""
+        b = self._max_frontier * self._compiled.max_actions
+        u = max(min(b, 1 << 14), b // self._dedup_factor)
+        return max(self._max_frontier, u)
 
     def _snapshot_key(self) -> str:
         """Process-stable compatibility key for snapshots.  Deliberately
@@ -623,6 +662,7 @@ class TpuChecker(Checker):
         ).hexdigest()[:16]
         return repr(
             (
+                "rowlog-v2",  # append-only flat row log (round 4)
                 type(cm).__qualname__,
                 cm.state_width,
                 cm.max_actions,
@@ -634,17 +674,17 @@ class TpuChecker(Checker):
         )
 
     def save_snapshot(self, path: str) -> None:
-        """Persist the full checker state (visited table, state store,
-        parent links, frontier queue, counters, discoveries) so a bounded
-        run — e.g. stopped by ``timeout`` or ``target_state_count`` — can
-        be resumed later with ``spawn_tpu(resume_from=path)``.  The
-        reference has no checker persistence (its visited set is not
-        persistable, SURVEY §5); on device the whole run state is dense
-        arrays, so snapshots are a plain ``np.savez``.
+        """Persist the full checker state (visited table, row log, parent
+        links, counters, discoveries) so a bounded run — e.g. stopped by
+        ``timeout`` or ``target_state_count`` — can be resumed later with
+        ``spawn_tpu(resume_from=path)``.  The reference has no checker
+        persistence (its visited set is not persistable, SURVEY §5); on
+        device the whole run state is dense arrays, so snapshots are a
+        plain ``np.savez``.
 
         Note: to stay snapshot-ready, a finished checker keeps its key
-        planes, ebits, and queue (16 bytes × capacity) on device alongside
-        the store/parent arrays that path reconstruction already retains;
+        planes and ebits (12 bytes × capacity) on device alongside the
+        row-log/parent arrays that path reconstruction already retains;
         dropping the checker object frees all of it.
 
         Engine tuning knobs that do not shape the persisted arrays —
@@ -669,19 +709,72 @@ class TpuChecker(Checker):
     def max_depth(self) -> int:
         return self._max_depth
 
+    def _chain_program(self, length: int):
+        """Device program walking a parent chain and gathering its rows:
+        the readback is O(depth × W) instead of the full tables (which are
+        GBs at bench capacities, behind a ~18 MB/s tunnel link)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .wave_common import cached_program
+
+        w = self._compiled.state_width
+        n = self._capacity + self._block_pad()
+        key = ("chain", w, n, length)
+
+        def build():
+            @jax.jit
+            def chain(parent, rows, pos):
+                def walk(i, c):
+                    ch, s = c
+                    ch = ch.at[i].set(s)
+                    nxt = parent[jnp.minimum(s, jnp.uint32(n - 1))]
+                    s = jnp.where(s == jnp.uint32(NO_SLOT_HOST), s, nxt)
+                    return ch, s
+
+                ch, _ = jax.lax.fori_loop(
+                    0, length,
+                    walk,
+                    (jnp.full((length,), NO_SLOT_HOST, jnp.uint32), pos),
+                )
+
+                def gather(i, buf):
+                    s = jnp.minimum(ch[i], jnp.uint32(n - 1))
+                    row = jax.lax.dynamic_slice(
+                        rows, (s * jnp.uint32(w),), (w,)
+                    )
+                    return jax.lax.dynamic_update_slice(
+                        buf, row[None, :], (i, 0)
+                    )
+
+                out = jax.lax.fori_loop(
+                    0, length, gather, jnp.zeros((length, w), jnp.uint32)
+                )
+                return ch, out
+
+            return chain
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+        )
+
     def _slot_path(self, slot: int) -> Path:
-        if self._tables_host is None:
-            parent_dev, store_dev = self._tables_dev
-            self._tables_host = (np.asarray(parent_dev), np.asarray(store_dev))
-        parent, store = self._tables_host
-        chain: List[int] = []
-        s = slot
-        while s != NO_SLOT_HOST:
-            chain.append(s)
-            s = int(parent[s])
+        import jax.numpy as jnp
+
+        # Chain length bucketed to powers of two so a run's discoveries
+        # share one compiled walk program.
+        need = self._max_depth + 2
+        length = 1 << max(4, (need - 1).bit_length())
+        parent_dev, rows_dev = self._tables_dev
+        chain_fn = self._chain_program(length)
+        ch, rows_l = chain_fn(parent_dev, rows_dev, jnp.uint32(slot))
+        ch = np.asarray(ch)
+        rows_l = np.asarray(rows_l)
+        chain = [i for i, s in enumerate(ch) if s != NO_SLOT_HOST]
         chain.reverse()
         fps = [
-            self._model.fingerprint(self._compiled.decode(store[s])) for s in chain
+            self._model.fingerprint(self._compiled.decode(rows_l[i]))
+            for i in chain
         ]
         return Path.from_fingerprints(self._model, fps)
 
