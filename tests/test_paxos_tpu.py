@@ -401,3 +401,71 @@ def test_paxos_check6_codec_compiles():
     enc0 = jnp.asarray(cm.encode(next(iter(model.init_states()))))
     jax.jit(cm.step).lower(enc0)
     jax.jit(cm.property_conds).lower(enc0)
+
+
+@pytest.mark.slow
+def test_spawn_tpu_paxos_c6_depth_bounded_differential():
+    """`paxos check 6` — the biggest reference bench workload
+    (bench.sh:28) — depth-bounded so the host oracle fits suite runtime.
+    The bit-packed linearizability DP (128 subset words per value column
+    at C=6) must agree exactly with the host tester; the full-scale
+    anchors are the tpu-marked golden below and bench.py's device suite
+    (full c=6 on hardware: 9,357,525 unique, depth 28, differential vs
+    host pinned at depth 12: 283,217)."""
+    host = (
+        paxos_model(6)
+        .checker()
+        .target_max_depth(9)
+        .spawn_bfs()
+        .join()
+    )
+    tpu = (
+        paxos_model(6)
+        .checker()
+        .target_max_depth(9)
+        .spawn_tpu(capacity=1 << 20, max_frontier=1 << 10)
+        .join()
+    )
+    assert host.unique_state_count() == tpu.unique_state_count()
+    assert host.state_count() == tpu.state_count()
+    assert tpu.max_depth() == host.max_depth() == 9
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.tpu
+def test_paxos_check5_full_golden_device():
+    """Full `paxos check 5` on the real chip: this framework's pinned
+    golden (no reference-pinned count exists past c=2); cross-validated
+    by the depth-bounded host differentials and the c=6 depth-12
+    differential (283,217 both engines, scratch run 2026-07-31)."""
+    tpu = (
+        paxos_model(5)
+        .checker()
+        .spawn_tpu(capacity=1 << 24, max_frontier=1 << 13, dedup_factor=8)
+        .join()
+    )
+    assert tpu.unique_state_count() == 4_711_569
+    assert tpu.max_depth() == 28
+    assert sorted(tpu.discoveries()) == ["value chosen"]
+
+
+@pytest.mark.tpu
+def test_paxos_check6_full_golden_device():
+    """Full `paxos check 6` (reference bench.sh:28) on the real chip:
+    9,357,525 unique states at depth 28.  The decoupled table/row-log
+    geometry (2^25 slots / 10.5M positions) is what fits the run on one
+    16 GB chip."""
+    tpu = (
+        paxos_model(6)
+        .checker()
+        .spawn_tpu(
+            capacity=1 << 25,
+            log_capacity=10_500_000,
+            max_frontier=1 << 13,
+            dedup_factor=8,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == 9_357_525
+    assert tpu.max_depth() == 28
+    assert sorted(tpu.discoveries()) == ["value chosen"]

@@ -329,7 +329,71 @@ def test_checkpoint_resume_matches_straight_run(tmp_path):
     assert sorted(resumed.discoveries()) == sorted(straight.discoveries())
     resumed.assert_properties()
 
+    # Geometry is NOT key material: a resume adopts the snapshot's
+    # table/log sizes (an auto-tuned run persists its GROWN geometry, so
+    # the original spawn arguments must still resume it).
+    adopted = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 16, max_frontier=1 << 7, resume_from=snap)
+        .join()
+    )
+    assert adopted.unique_state_count() == 8832
+
+    # A different MODEL must still be rejected loudly.
     with pytest.raises(ValueError, match="snapshot does not match"):
-        model.checker().spawn_tpu(
-            capacity=1 << 16, max_frontier=1 << 7, resume_from=snap
+        TwoPhaseSys(rm_count=4).checker().spawn_tpu(
+            capacity=1 << 15, max_frontier=1 << 7, resume_from=snap
         ).join()
+
+
+def test_auto_tune_grows_overfull_table():
+    """A capacity far below the state count completes anyway: the engine
+    restarts with a grown table instead of failing into a hand-tuning
+    loop (VERDICT r3 weak #7).  2pc(3) has 288 unique states, so a
+    256-slot table trips the 50%-load flag almost immediately."""
+    model = TwoPhaseSys(rm_count=3)
+    tpu = model.checker().spawn_tpu(capacity=1 << 8, max_frontier=1 << 9).join()
+    assert tpu.unique_state_count() == 288
+
+    with pytest.raises(RuntimeError, match="table overfull"):
+        model.checker().spawn_tpu(
+            capacity=1 << 8, max_frontier=1 << 9, auto_tune=False
+        ).join()
+
+
+def test_auto_tune_grows_full_row_log():
+    """log_capacity sizes the row log independently of the table; an
+    undersized log grows on retry, and without auto_tune fails loudly
+    naming the knob."""
+    model = TwoPhaseSys(rm_count=3)
+    tpu = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 14, max_frontier=1 << 9, log_capacity=256)
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+
+    with pytest.raises(RuntimeError, match="row log is full"):
+        model.checker().spawn_tpu(
+            capacity=1 << 14,
+            max_frontier=1 << 9,
+            log_capacity=256,
+            auto_tune=False,
+        ).join()
+
+
+def test_log_capacity_smaller_than_table_exact():
+    """A decoupled (table=2^14, log=512) geometry — the `paxos check 6`
+    memory shape in miniature — still produces exact counts, depth, and
+    discoveries."""
+    model = TwoPhaseSys(rm_count=3)
+    host = model.checker().spawn_bfs().join()
+    tpu = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 14, max_frontier=1 << 9, log_capacity=512)
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count() == 288
+    assert tpu.max_depth() == host.max_depth()
+    assert tpu.state_count() == host.state_count()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
