@@ -397,8 +397,10 @@ class AbdCompiled(CompiledModel):
             for env, count in sorted(
                 st.network.counts, key=lambda ec: self._env_code(ec[0])
             ):
-                assert count == 1, f"multiset count {count} for {env!r}"
-                env_codes.append(self._env_code(env))
+                # Multiset counts > 1 are repeated codes, like the raft
+                # codec (raft_compiled.py) — a duplicate in-flight send is
+                # data, not an engine error.
+                env_codes.extend([self._env_code(env)] * count)
             if len(env_codes) > self.m:
                 raise ValueError(
                     f"{len(env_codes)} in-flight envelopes exceed "
@@ -429,13 +431,15 @@ class AbdCompiled(CompiledModel):
                     flows.append(((Id(src), Id(dst)), tuple(msgs)))
             network = Network(kind="ordered", flows=tuple(sorted(flows)))
         else:
-            envs = []
+            env_counts: dict = {}
             for k in range(self.m):
                 code = int(words[S + 1 + k])
                 if code:
-                    envs.append((self._env_of(code), 1))
+                    env = self._env_of(code)
+                    env_counts[env] = env_counts.get(env, 0) + 1
             network = Network(
-                kind="unordered_nonduplicating", counts=frozenset(envs)
+                kind="unordered_nonduplicating",
+                counts=frozenset(env_counts.items()),
             )
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
@@ -475,7 +479,18 @@ class AbdCompiled(CompiledModel):
         net0 = S + 1
         lane_sel = jnp.arange(m, dtype=u) == k
         code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        occupied = code != u(0)
+        # The host enumerates ONE Deliver per DISTINCT envelope
+        # (network.iter_deliverable); slots are kept sorted, so only the
+        # first slot of an equal-code run is the representative lane —
+        # later copies of a duplicated send stay in flight.
+        prev = jnp.sum(
+            jnp.where(
+                jnp.arange(m, dtype=u) == k - u(1),
+                state[net0 : net0 + m],
+                u(0),
+            )
+        )
+        occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
         (
             valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci,
         ) = self._handle(state, code, occupied)
@@ -486,11 +501,10 @@ class AbdCompiled(CompiledModel):
         cand = jnp.where(cand == u(0), ones, cand)
         cand = jnp.sort(cand)
         slot_overflow = valid & jnp.any(cand[m:] != ones)
-        # Duplicate send = host multiset count 2, unrepresentable in the
-        # slot codec — flag loudly (see paxos_compiled.py).
-        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        # Duplicate sends are repeated codes (host multiset count > 1),
+        # exactly like the raft codec — data, not an engine error.
         new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
-        flag = (branch_flag & valid) | slot_overflow | dup
+        flag = (branch_flag & valid) | slot_overflow
         ns = self._assemble(state, dsrv, srv_new, cli_f, ci, tw_f, new_slots)
         return ns, valid, flag
 

@@ -149,8 +149,9 @@ class SingleCopyCompiled(CompiledModel):
         for env, count in sorted(
             st.network.counts, key=lambda ec: self._env_code(ec[0])
         ):
-            assert count == 1, f"multiset count {count} for {env!r}"
-            env_codes.append(self._env_code(env))
+            # Multiset counts > 1 are repeated codes, like the raft codec
+            # — a duplicate in-flight send is data, not an engine error.
+            env_codes.extend([self._env_code(env)] * count)
         if len(env_codes) > self.m:
             raise ValueError(
                 f"{len(env_codes)} in-flight envelopes exceed {self.m} slots"
@@ -172,11 +173,13 @@ class SingleCopyCompiled(CompiledModel):
             for i in range(self.s)
         )
         clients = self.rc.decode_clients(int(words[1]))
-        envs = []
+        env_counts: dict = {}
         for k in range(self.m):
             code = int(words[2 + k])
             if code:
-                envs.append((self._env_of(code), 1))
+                env = self._env_of(code)
+                env_counts[env] = env_counts.get(env, 0) + 1
+        envs = list(env_counts.items())
         network = Network(kind="unordered_nonduplicating", counts=frozenset(envs))
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
@@ -216,7 +219,17 @@ class SingleCopyCompiled(CompiledModel):
 
         lane_sel = jnp.arange(m, dtype=u) == k
         code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        occupied = code != u(0)
+        # One Deliver per DISTINCT envelope (the host's iter_deliverable):
+        # slots are sorted, so only the first slot of an equal-code run is
+        # the representative lane; later copies stay in flight.
+        prev = jnp.sum(
+            jnp.where(
+                jnp.arange(m, dtype=u) == k - u(1),
+                state[net0 : net0 + m],
+                u(0),
+            )
+        )
+        occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
         e = code - u(1)
         tag = e >> u(19)
         addr = (e >> u(14)) & u(0x1F)
@@ -290,11 +303,10 @@ class SingleCopyCompiled(CompiledModel):
         cand = jnp.where(cand == u(0), ones, cand)
         cand = jnp.sort(cand)
         slot_overflow = valid & jnp.any(cand[m:] != ones)
-        # Duplicate send = host multiset count 2, unrepresentable in the
-        # slot codec — flag loudly (see paxos_compiled.py).
-        dup = valid & jnp.any((cand[1:] == cand[:-1]) & (cand[1:] != ones))
+        # Duplicate sends are repeated codes (host multiset count > 1) —
+        # data, not an engine error, exactly like the raft codec.
         new_slots = jnp.where(cand[:m] == ones, u(0), cand[:m])
-        flag = slot_overflow | dup
+        flag = slot_overflow
 
         head = [srv_f, cli_f]
         tail = [

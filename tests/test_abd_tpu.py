@@ -5,6 +5,8 @@ beyond paxos.  Reference golden: 544 unique states at 2 clients / 2
 servers (examples/linearizable-register.rs:288,315).
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -226,3 +228,98 @@ def test_spawn_tpu_abd_unordered_check3_matches_host():
     assert tpu.unique_state_count() == 35_009
     assert tpu.max_depth() == host.max_depth() == 37
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def _dup_send_differential(model, cm, net0):
+    """Shared body: bump EACH in-flight envelope of every reachable state
+    to count 2 in turn (duplicate runs at interior slots included), then
+    codec round-trip + device step must match the host exactly — one
+    Deliver per DISTINCT envelope (iter_deliverable), delivery consuming
+    one copy."""
+    dup_states = []
+    for s in enumerate_reachable(model).values():
+        counts = dict(s.network.counts)
+        if not counts or len(s.network.counts) + 1 > cm.m:
+            continue
+        for env in sorted(counts, key=cm._env_code):
+            counts2 = dict(counts)
+            counts2[env] = 2
+            dup_states.append(
+                dataclasses.replace(
+                    s,
+                    network=dataclasses.replace(
+                        s.network, counts=frozenset(counts2.items())
+                    ),
+                )
+            )
+    assert dup_states
+
+    enc = np.stack([cm.encode(s) for s in dup_states]).astype(np.uint32)
+    for s, e in zip(dup_states, enc):
+        assert cm.decode(e) == s  # repeated code round-trips to count=2
+
+    lane_fn = jax.jit(
+        jax.vmap(
+            lambda st: jax.vmap(lambda k: cm._deliver_lane(st, k))(
+                jnp.arange(cm.m, dtype=jnp.uint32)
+            )
+        )
+    )
+    nexts, valid, flags = (np.asarray(x) for x in lane_fn(jnp.asarray(enc)))
+    assert not flags.any()
+    for bi, s in enumerate(dup_states):
+        host_map = {}
+        for env in s.network.iter_deliverable():
+            ns = model.next_state(s, Deliver(env.src, env.dst, env.msg))
+            host_map[cm._env_code(env)] = None if ns is None else cm.encode(ns)
+        seen_codes = set()
+        for k in range(cm.m):
+            code = int(enc[bi][net0 + k])
+            if code == 0 or code in seen_codes:
+                # Empty or non-representative duplicate: not a lane.
+                assert not valid[bi, k]
+                if code:
+                    seen_codes.add(code)
+                continue
+            seen_codes.add(code)
+            want = host_map[code]
+            if want is None:
+                assert not valid[bi, k], cm._env_of(code)
+            else:
+                assert valid[bi, k], cm._env_of(code)
+                assert np.array_equal(nexts[bi, k], want), cm._env_of(code)
+
+
+def test_duplicate_inflight_send_step_differential_abd():
+    """Duplicate in-flight messages (host multiset count = 2) are DATA in
+    the slot codec — repeated codes, like the raft codec — not an engine
+    error.  None of the register protocols reach such a state (the full-
+    space differentials prove it), so the states are synthetic."""
+    model = abd_model(2)
+    _dup_send_differential(model, AbdCompiled(model), net0=3)
+
+
+def test_duplicate_inflight_send_step_differential_paxos():
+    from stateright_tpu.models.paxos import PaxosModelCfg
+    from stateright_tpu.models.paxos_compiled import PaxosCompiled
+
+    model = PaxosModelCfg(
+        client_count=2,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+    cm = PaxosCompiled(model)
+    _dup_send_differential(model, cm, net0=7)
+
+
+def test_duplicate_inflight_send_step_differential_single_copy():
+    from stateright_tpu.models.single_copy_register import SingleCopyModelCfg
+    from stateright_tpu.models.single_copy_compiled import SingleCopyCompiled
+
+    model = SingleCopyModelCfg(
+        client_count=2,
+        server_count=1,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+    cm = SingleCopyCompiled(model)
+    _dup_send_differential(model, cm, net0=2)
