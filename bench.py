@@ -60,9 +60,12 @@ HOST_TIME_SLICE = 60.0  # seconds of host BFS to establish the denominator
 # (scratch profiling, round 3; see docs/TPU_PAXOS_DESIGN.md).
 TPU_KWARGS = dict(capacity=1 << 23, max_frontier=1 << 13, dedup_factor=8)
 
-# Substrings identifying transient tunneled-device failures worth retrying
-# (observed: jax.errors.JaxRuntimeError INTERNAL "remote_compile: read
-# body: response body closed before all bytes were read").
+# Transient tunneled-device failures worth retrying (observed:
+# jax.errors.JaxRuntimeError INTERNAL "remote_compile: read body:
+# response body closed before all bytes were read"; UNAVAILABLE "TPU
+# worker process crashed or restarted").  Gated on the exception TYPE
+# being a JAX runtime error so an unrelated exception that merely
+# mentions a marker in its text is never retried.
 _TRANSIENT_MARKERS = (
     "read body",
     "response body closed",
@@ -75,30 +78,45 @@ _TRANSIENT_MARKERS = (
 _DEVICE_ATTEMPTS = 3
 
 
+def _is_transient(exc: BaseException) -> bool:
+    import jax
+
+    if not isinstance(exc, jax.errors.JaxRuntimeError):
+        return False
+    return any(m in str(exc) for m in _TRANSIENT_MARKERS)
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_device(make_checker, attempts: int = _DEVICE_ATTEMPTS):
-    """Build + join a device checker, retrying on transient tunnel errors.
+def run_device_timed(make_checker, attempts: int = _DEVICE_ATTEMPTS):
+    """Build + join a device checker, retrying on transient tunnel errors;
+    returns ``(checker, seconds)`` where seconds covers ONLY the
+    successful attempt — failed attempts and retry sleeps must never leak
+    into a reported rate.
 
     The checker thread dies with the error and re-raises it at ``join``;
     each retry rebuilds the whole checker (the program cache makes the
     retry warm, so retries cost run time, not compile time).
     """
     for attempt in range(1, attempts + 1):
+        t0 = time.time()
         try:
-            return make_checker().join()
+            return make_checker().join(), time.time() - t0
         except Exception as exc:  # noqa: BLE001 - classified below
             text = f"{type(exc).__name__}: {exc}"
-            transient = any(m in text for m in _TRANSIENT_MARKERS)
-            if not transient or attempt == attempts:
+            if not _is_transient(exc) or attempt == attempts:
                 raise
             log(
                 f"transient device error (attempt {attempt}/{attempts}), "
                 f"retrying in 5s: {text[:300]}"
             )
             time.sleep(5.0)
+
+
+def run_device(make_checker, attempts: int = _DEVICE_ATTEMPTS):
+    return run_device_timed(make_checker, attempts)[0]
 
 
 def paxos_model(clients: int, never_decided: bool = False):
@@ -111,6 +129,107 @@ def paxos_model(clients: int, never_decided: bool = False):
         network=Network.new_unordered_nonduplicating(),
         never_decided=never_decided,
     ).into_model()
+
+
+def _twophase(rm: int):
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    return TwoPhaseSys(rm_count=rm)
+
+
+def _abd(clients: int, ordered: bool = False):
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.abd import AbdModelCfg
+
+    return AbdModelCfg(
+        client_count=clients,
+        server_count=2,
+        network=(
+            Network.new_ordered()
+            if ordered
+            else Network.new_unordered_nonduplicating()
+        ),
+    ).into_model()
+
+
+def _single_copy(clients: int):
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.single_copy_register import SingleCopyModelCfg
+
+    return SingleCopyModelCfg(
+        client_count=clients,
+        server_count=1,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+
+
+# The reference's own benchmark list (bench.sh:27-34), run on device every
+# round.  Goldens: 2pc ≤5 and register c2 are reference-pinned; the rest
+# are this framework's cross-validated pins (depth-bounded host
+# differentials + dual-engine agreement; see tests/ and PARITY.md).
+REFERENCE_SUITE = [
+    ("2pc_check_10", lambda: _twophase(10), 61_515_776, 32),
+    ("paxos_check_6", lambda: paxos_model(6), 9_357_525, 28),
+    ("single_copy_register_check_4", lambda: _single_copy(4), 400_233, 17),
+    ("linearizable_register_check_2", lambda: _abd(2), 544, 25),
+    ("linearizable_register_check_3_ordered",
+     lambda: _abd(3, ordered=True), 46_516, 37),
+]
+
+
+def phase_reference_suite(record: dict) -> None:
+    """Run the reference's full bench list on device: a DISCOVERY run with
+    pure default engine knobs (auto-tune does all sizing — no hand-tuned
+    per-workload constants), then a measured run at the discovered sizes.
+    Each workload is golden-gated; one failure never hides the others."""
+    import gc
+
+    suite: dict = {}
+    record["reference_suite"] = suite
+    for name, mk, want_unique, want_depth in REFERENCE_SUITE:
+        entry: dict = {}
+        suite[name] = entry
+        try:
+            log(f"suite: {name}: discovery run (default knobs)...")
+            t0 = time.time()
+            ck = run_device(lambda: mk().checker().spawn_tpu())
+            entry["discovery_sec"] = round(time.time() - t0, 2)
+            tuned = ck.tuned_kwargs()
+            unique, depth = ck.unique_state_count(), ck.max_depth()
+            del ck
+            gc.collect()
+            if (unique, depth) != (want_unique, want_depth):
+                entry["error"] = (
+                    f"golden mismatch: unique={unique} depth={depth} != "
+                    f"{want_unique}/{want_depth}"
+                )
+                log(f"suite: {name}: {entry['error']}")
+                continue
+            log(f"suite: {name}: measured run {tuned}...")
+            ck, dt = run_device_timed(
+                lambda: mk().checker().spawn_tpu(**tuned)
+            )
+            unique, depth = ck.unique_state_count(), ck.max_depth()
+            del ck
+            gc.collect()
+            if (unique, depth) != (want_unique, want_depth):
+                entry["error"] = (
+                    f"golden mismatch (measured run): unique={unique} "
+                    f"depth={depth} != {want_unique}/{want_depth}"
+                )
+                log(f"suite: {name}: {entry['error']}")
+                continue
+            entry["unique_states"] = unique
+            entry["depth"] = depth
+            entry["sec"] = round(dt, 2)
+            entry["unique_states_per_sec"] = round(unique / dt, 1)
+            log(
+                f"suite: {name}: {unique} unique in {dt:.2f}s = "
+                f"{unique / dt:.0f} uniq/s"
+            )
+        except Exception:
+            entry["error"] = traceback.format_exc(limit=3)
+            log(f"suite: {name}: failed:\n{entry['error']}")
 
 
 def emit(record: dict) -> None:
@@ -131,9 +250,7 @@ def phase_ttfv(record: dict, threads: int) -> None:
 
     log("ttfv: warming violating-variant program...")
     run_device(spawn)
-    t0 = time.time()
-    v = run_device(spawn)
-    ttfv_tpu = time.time() - t0
+    v, ttfv_tpu = run_device_timed(spawn)
     assert "never decided" in v.discoveries(), "violation not found on device"
     t0 = time.time()
     vh = (
@@ -172,9 +289,7 @@ def phase_sharded_smoke(record: dict) -> None:
 
     log("sharded smoke: warming 1-device-mesh program on real chip...")
     run_device(spawn)
-    t0 = time.time()
-    c = run_device(spawn)
-    sharded_dt = time.time() - t0
+    c, sharded_dt = run_device_timed(spawn)
     assert c.unique_state_count() == 16_668, (
         f"sharded paxos2 unique={c.unique_state_count()} != 16668"
     )
@@ -185,9 +300,7 @@ def phase_sharded_smoke(record: dict) -> None:
         )
 
     run_device(spawn_single)
-    t0 = time.time()
-    s = run_device(spawn_single)
-    single_dt = time.time() - t0
+    s, single_dt = run_device_timed(spawn_single)
     assert s.unique_state_count() == 16_668
     log(
         f"sharded smoke: paxos2 sharded(1dev)={sharded_dt:.2f}s "
@@ -210,11 +323,9 @@ def main() -> None:
     warmup = time.time() - t0
     log(f"  warm-up run: {warmup:.1f}s")
 
-    t0 = time.time()
-    checker = run_device(
+    checker, tpu_dt = run_device_timed(
         lambda: paxos_model(3).checker().spawn_tpu(**TPU_KWARGS)
     )
-    tpu_dt = time.time() - t0
     unique = checker.unique_state_count()
     if unique != GOLDEN_UNIQUE or checker.max_depth() != GOLDEN_DEPTH:
         # FATAL: a wrong-answer run must not post a throughput number.
@@ -267,7 +378,11 @@ def main() -> None:
 
     # Optional phases — each failure is logged and skipped, never fatal.
     extras_ok = 0
-    for phase in (lambda r: phase_ttfv(r, threads), phase_sharded_smoke):
+    for phase in (
+        phase_reference_suite,
+        lambda r: phase_ttfv(r, threads),
+        phase_sharded_smoke,
+    ):
         try:
             phase(record)
             extras_ok += 1

@@ -52,7 +52,11 @@ from .abd import (
     Query,
     Record,
 )
-from .register_compiled_common import RegisterClientCodec
+from .register_compiled_common import (
+    RegisterClientCodec,
+    decode_slot_counts,
+    representative_slot_code,
+)
 
 S = 2  # servers (the golden configuration; majority = 2 = all)
 MAX_CLOCK = 7  # 4-bit seq code = clock*S + id
@@ -431,15 +435,11 @@ class AbdCompiled(CompiledModel):
                     flows.append(((Id(src), Id(dst)), tuple(msgs)))
             network = Network(kind="ordered", flows=tuple(sorted(flows)))
         else:
-            env_counts: dict = {}
-            for k in range(self.m):
-                code = int(words[S + 1 + k])
-                if code:
-                    env = self._env_of(code)
-                    env_counts[env] = env_counts.get(env, 0) + 1
             network = Network(
                 kind="unordered_nonduplicating",
-                counts=frozenset(env_counts.items()),
+                counts=decode_slot_counts(
+                    words, S + 1, self.m, self._env_of
+                ),
             )
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
@@ -477,23 +477,11 @@ class AbdCompiled(CompiledModel):
         u = jnp.uint32
         m = self.m
         net0 = S + 1
-        lane_sel = jnp.arange(m, dtype=u) == k
-        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        # The host enumerates ONE Deliver per DISTINCT envelope
-        # (network.iter_deliverable); slots are kept sorted, so only the
-        # first slot of an equal-code run is the representative lane —
-        # later copies of a duplicated send stay in flight.
-        prev = jnp.sum(
-            jnp.where(
-                jnp.arange(m, dtype=u) == k - u(1),
-                state[net0 : net0 + m],
-                u(0),
-            )
-        )
-        occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
+        code, occupied = representative_slot_code(state, net0, m, k)
         (
             valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci,
         ) = self._handle(state, code, occupied)
+        lane_sel = jnp.arange(m, dtype=u) == k
 
         slots = jnp.where(lane_sel, u(0), state[net0 : net0 + m])
         cand = jnp.concatenate([slots, s0[None]])

@@ -37,6 +37,10 @@ from ..actor.register import ClientState, Get, GetOk, Internal, Put, PutOk
 from ..parallel.compiled import CompiledModel
 from ..semantics import LinearizabilityTester, Register
 from ..semantics.register import READ, ReadOk, WriteOp, WRITE_OK
+from .register_compiled_common import (
+    decode_slot_counts,
+    representative_slot_code,
+)
 from .paxos import (
     Accept,
     Accepted,
@@ -392,15 +396,9 @@ class PaxosCompiled(CompiledModel):
             for i in range(S)
         )
         clients = self.rc.decode_clients(int(words[2 * S]))
-        env_counts: dict = {}
-        for k in range(self.m):
-            code = int(words[2 * S + 1 + k])
-            if code:
-                env = self._env_of(code)
-                env_counts[env] = env_counts.get(env, 0) + 1
-        envs = list(env_counts.items())
         network = Network(
-            kind="unordered_nonduplicating", counts=frozenset(envs)
+            kind="unordered_nonduplicating",
+            counts=decode_slot_counts(words, 2 * S + 1, self.m, self._env_of),
         )
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
@@ -495,19 +493,8 @@ class PaxosCompiled(CompiledModel):
         # and <= 3 clients every data-dependent index is a short where-select
         # chain, which XLA vectorizes cleanly on TPU (and avoids a observed
         # XLA:CPU batched-scatter miscompilation at large batch shapes).
+        code, occupied = representative_slot_code(state, net0, m, k)
         lane_sel = jnp.arange(self.m, dtype=u) == k
-        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        # One Deliver per DISTINCT envelope (the host's iter_deliverable):
-        # slots are sorted, so only the first slot of an equal-code run is
-        # the representative lane; later copies stay in flight.
-        prev = jnp.sum(
-            jnp.where(
-                jnp.arange(self.m, dtype=u) == k - u(1),
-                state[net0 : net0 + m],
-                u(0),
-            )
-        )
-        occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
         e = code - u(1)
         tag = e >> u(19)
         addr = (e >> u(14)) & u(0x1F)

@@ -35,6 +35,38 @@ from ..actor.register import ClientState
 from ..semantics.register import READ, ReadOk, WRITE_OK, WriteOp
 
 
+def representative_slot_code(state, net0: int, m: int, k):
+    """(code, occupied) for unordered-multiset Deliver lane ``k``.
+
+    Slots hold SORTED envelope codes with duplicates as repeated codes
+    (host multiset count > 1, like the raft codec).  The host enumerates
+    one Deliver per DISTINCT envelope (network.iter_deliverable), so only
+    the first slot of an equal-code run is the representative lane —
+    later copies of a duplicated send stay in flight.  Shared by the
+    paxos/ABD/single-copy codecs so the rule cannot drift."""
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    sel = jnp.arange(m, dtype=u)
+    slots = state[net0 : net0 + m]
+    code = jnp.sum(jnp.where(sel == k, slots, u(0)))
+    prev = jnp.sum(jnp.where(sel == k - u(1), slots, u(0)))
+    occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
+    return code, occupied
+
+
+def decode_slot_counts(words, net0: int, m: int, env_of):
+    """Host decode of the slot section back to multiset (env, count)
+    pairs, counting repeated codes.  Shared across the register codecs."""
+    env_counts: dict = {}
+    for k in range(m):
+        code = int(words[net0 + k])
+        if code:
+            env = env_of(code)
+            env_counts[env] = env_counts.get(env, 0) + 1
+    return frozenset(env_counts.items())
+
+
 class RegisterClientCodec:
     """Codec + device predicates for the harness's client/tester section.
 

@@ -30,7 +30,11 @@ from ..actor.model import ActorModelState
 from ..actor.register import Get, GetOk, Put, PutOk
 from ..parallel.compiled import CompiledModel
 from ..semantics import LinearizabilityTester, Register
-from .register_compiled_common import RegisterClientCodec
+from .register_compiled_common import (
+    RegisterClientCodec,
+    decode_slot_counts,
+    representative_slot_code,
+)
 from .single_copy_register import NULL_VALUE
 
 _T_PUT, _T_GET, _T_PUTOK, _T_GETOK = 0, 1, 2, 3
@@ -173,14 +177,10 @@ class SingleCopyCompiled(CompiledModel):
             for i in range(self.s)
         )
         clients = self.rc.decode_clients(int(words[1]))
-        env_counts: dict = {}
-        for k in range(self.m):
-            code = int(words[2 + k])
-            if code:
-                env = self._env_of(code)
-                env_counts[env] = env_counts.get(env, 0) + 1
-        envs = list(env_counts.items())
-        network = Network(kind="unordered_nonduplicating", counts=frozenset(envs))
+        network = Network(
+            kind="unordered_nonduplicating",
+            counts=decode_slot_counts(words, 2, self.m, self._env_of),
+        )
         tester = LinearizabilityTester(Register(NULL_VALUE))
         for i in range(self.c):
             self.rc.decode_tester_into(
@@ -217,19 +217,8 @@ class SingleCopyCompiled(CompiledModel):
         net0 = 2
         tst0 = net0 + m
 
+        code, occupied = representative_slot_code(state, net0, m, k)
         lane_sel = jnp.arange(m, dtype=u) == k
-        code = jnp.sum(jnp.where(lane_sel, state[net0 : net0 + m], u(0)))
-        # One Deliver per DISTINCT envelope (the host's iter_deliverable):
-        # slots are sorted, so only the first slot of an equal-code run is
-        # the representative lane; later copies stay in flight.
-        prev = jnp.sum(
-            jnp.where(
-                jnp.arange(m, dtype=u) == k - u(1),
-                state[net0 : net0 + m],
-                u(0),
-            )
-        )
-        occupied = (code != u(0)) & ((k == u(0)) | (prev != code))
         e = code - u(1)
         tag = e >> u(19)
         addr = (e >> u(14)) & u(0x1F)

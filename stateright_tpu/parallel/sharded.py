@@ -739,14 +739,26 @@ class ShardedTpuChecker(Checker):
         return self._max_depth
 
     def _gid_path(self, gid: int) -> Path:
-        if self._tables_host is None:
-            parent_dev, store_dev = self._tables_dev
-            n, cap_s, w = self._n, self._cap_s, self._compiled.state_width
-            self._tables_host = (
-                np.asarray(parent_dev).reshape(n, cap_s),
-                np.asarray(store_dev).reshape(n, cap_s, w),
-            )
-        parent, store = self._tables_host
+        # The lazy ~GB-scale host pull happens at most once (guarded: two
+        # concurrent path reconstructions must not both pull), and a query
+        # against a run that never finished cleanly fails with a clear
+        # error instead of unpacking None.
+        with self._lock:
+            if self._tables_host is None:
+                if self._tables_dev is None:
+                    raise RuntimeError(
+                        "no run state to reconstruct paths from (the "
+                        "checker did not complete cleanly)"
+                    )
+                parent_dev, store_dev = self._tables_dev
+                n, cap_s, w = (
+                    self._n, self._cap_s, self._compiled.state_width,
+                )
+                self._tables_host = (
+                    np.asarray(parent_dev).reshape(n, cap_s),
+                    np.asarray(store_dev).reshape(n, cap_s, w),
+                )
+            parent, store = self._tables_host
         chain: List[int] = []
         g = gid
         while g != NO_GID:

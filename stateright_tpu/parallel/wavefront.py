@@ -526,9 +526,9 @@ class TpuChecker(Checker):
 
         Table growth is aggressive (×16 — slots are 8 bytes and every
         retry pays a recompile plus a partial re-run) and drags a
-        defaulted row log with it; the row log alone grows ×4 (positions
-        are 4·state_width bytes); a dedup overflow relaxes the factor
-        toward the always-safe 1.
+        defaulted row log with it; the row log alone grows ×2 (positions
+        are 4·state_width bytes and copy-growth holds old + new at once);
+        a dedup overflow relaxes the factor toward the always-safe 1.
         """
         row_bytes = 4 * self._compiled.state_width
         log_cap_bound = max(self._log_capacity, _ROW_LOG_BYTE_BUDGET // row_bytes)
@@ -907,6 +907,22 @@ class TpuChecker(Checker):
             capacity=self._capacity,
             log_capacity=self._log_capacity,
             **arrays,
+        )
+
+    def tuned_kwargs(self) -> dict:
+        """Engine kwargs right-sized to THIS run's final counts, so a
+        fresh spawn of the same workload runs without any auto-tune
+        growth pauses: a default-knob discovery run, then a measured run
+        with the returned sizes (the bench.py pattern).  The table gets
+        ≥2× the unique count (50% max load), the row log the exact count
+        plus safety slack."""
+        self.join()
+        u = max(1, self._unique_count)
+        return dict(
+            capacity=1 << max(10, (2 * u).bit_length()),
+            log_capacity=u + max(64, u // 64),
+            max_frontier=self._max_frontier,
+            dedup_factor=self._dedup_factor,
         )
 
     # --- Checker surface -----------------------------------------------------

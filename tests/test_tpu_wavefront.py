@@ -397,3 +397,25 @@ def test_log_capacity_smaller_than_table_exact():
     assert tpu.max_depth() == host.max_depth()
     assert tpu.state_count() == host.state_count()
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.slow
+def test_twophase10_depth_bounded_differential():
+    """`2pc check 10` — the largest reference bench workload (bench.sh:27)
+    — depth-bounded so the host oracle fits suite runtime.  Full scale
+    runs in bench.py's reference-suite phase, golden-gated at 61,515,776
+    unique states / depth 32 (device, 2026-07-31; depth-8 differential
+    pinned 256,660 both engines)."""
+    model = TwoPhaseSys(rm_count=10)
+    host = model.checker().target_max_depth(7).spawn_bfs().join()
+    tpu = (
+        TwoPhaseSys(rm_count=10)
+        .checker()
+        .target_max_depth(7)
+        .spawn_tpu(capacity=1 << 20, max_frontier=1 << 11, dedup_factor=1)
+        .join()
+    )
+    assert host.unique_state_count() == tpu.unique_state_count()
+    assert host.state_count() == tpu.state_count()
+    assert tpu.max_depth() == host.max_depth() == 7
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
