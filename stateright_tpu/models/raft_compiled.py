@@ -27,16 +27,23 @@ Layout (3 servers, packed into ``state_width`` uint32 words):
   as in the host engines.
 
 The reference's default check is ``target_max_depth(12)`` BFS
-(examples/raft.rs:520-535).  The full depth-12 space is ~4x10^7 states
-(host-measured growth of ~3.6x per level from 225,379 at depth 9) — weeks
-of host BFS and beyond a single chip's HBM at this state width.  The
-gates (tests/test_raft_tpu.py) therefore pin a per-state successor
-differential to depth 4, EXACT engine parity at depth 6 (4,933), and
-dual-pinned counts at depths 8-9 (host 61,702 vs device 61,697; device
-225,298 vs host 225,379): past depth 7, states merging under the partial
-identity can have buffer-dependent successors, so representative order
-decides a handful of states — nondeterminism the reference itself has
-across checker threads.  Crash/recover lanes are reachable from depth 2.
+(examples/raft.rs:520-535).  The device engine runs it whole:
+**12,603,639 unique states (38.5M generated), depth 12, ~220 s on one
+v5e** (2026-07-31; 2^26-slot table + 14M-position row log ≈ 3.4 GB —
+an earlier note here estimated "4x10^7, beyond one chip's HBM" by
+conflating generated with unique states).  The discovery set includes a
+genuine **Election Safety counterexample**: the reference's actor
+persists nothing across crashes (``Storage = ()``, on_start resets
+``voted_for``), so crash→recover→re-vote elects two leaders in one term
+— reachable between depths 9 and 10, confirmed by the host oracle at
+depth 10.  The parity gates (tests/test_raft_tpu.py) pin a per-state
+successor differential to depth 4, EXACT engine parity at depth 6
+(4,933), and dual-pinned counts at depths 8-9 (host 61,702 vs device
+61,697; device 225,298 vs host 225,379): past depth 7, states merging
+under the partial identity can have buffer-dependent successors, so
+representative order decides a handful of states — nondeterminism the
+reference itself has across checker threads.  Crash/recover lanes are
+reachable from depth 2.
 """
 
 from __future__ import annotations

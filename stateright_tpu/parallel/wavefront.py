@@ -51,6 +51,22 @@ from .compiled import CompiledModel, compiled_model_for
 
 NO_SLOT_HOST = 0xFFFFFFFF
 
+# Layout of the stats vector — the ONE array the host reads back per run()
+# call (each distinct readback through a tunneled device is a full network
+# round trip).  Used by run()/seed()/the resume builder/the host loop; a
+# new slot must be added here, nowhere else.
+(
+    STAT_LEVEL_START,
+    STAT_LEVEL_END,
+    STAT_TAIL,
+    STAT_SC_LO,
+    STAT_SC_HI,
+    STAT_UNIQUE,
+    STAT_DEPTH,
+    STAT_FLAGS,
+) = range(8)
+STAT_DISC = 8  # disc[P] rides at [STAT_DISC : STAT_DISC + n_props]
+
 # Auto-tune growth bounds: the table's key planes cost 8 bytes a slot
 # (2 GiB at the cap, plus a transient claim plane per insert) and the row
 # log 4*state_width a position; growth stops at these bounds and the
@@ -399,34 +415,58 @@ class TpuChecker(Checker):
             go = go & ~fw_matched(disc)
             return go
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-        def run(key_hi, key_lo, rows, parent, ebits, level_start,
-                level_end, tail, sc_lo, sc_hi, unique_count, depth, disc,
-                waves):
+        waves_per_call = self._waves_per_call
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+        def run(key_hi, key_lo, rows, parent, ebits, stats):
+            # All host-visible scalars travel in ONE small stats array:
+            # through a tunneled device every distinct readback is a full
+            # network round trip (~100 ms measured), which dominated
+            # shallow runs like time-to-first-violation.
             carry = (
                 key_hi,
                 key_lo,
                 rows,
                 parent,
                 ebits,
-                level_start,
-                level_end,
-                tail,
-                sc_lo,
-                sc_hi,
-                unique_count,
-                depth,
-                disc,
-                waves,
+                stats[STAT_LEVEL_START],
+                stats[STAT_LEVEL_END],
+                stats[STAT_TAIL],
+                stats[STAT_SC_LO],
+                stats[STAT_SC_HI],
+                stats[STAT_UNIQUE],
+                stats[STAT_DEPTH],
+                stats[STAT_DISC : STAT_DISC + len(props)],
+                jnp.int32(waves_per_call),
                 jnp.uint32(0),
             )
-            return jax.lax.while_loop(wave_cond, wave_body, carry)
+            out = jax.lax.while_loop(wave_cond, wave_body, carry)
+            stats_out = jnp.concatenate(
+                [jnp.stack([out[5], out[6], out[7], out[8], out[9],
+                            out[10], out[11], out[14]]), out[12]]
+            )
+            return out[0], out[1], out[2], out[3], out[4], stats_out
 
         eb0 = (1 << len(ev_indices)) - 1
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def seed(key_hi, key_lo, rows, ebits, init_padded, n_init):
+        @jax.jit
+        def seed(init_rows, n_init):
+            """One dispatch creates EVERY device buffer and seeds the init
+            states: the table planes, row log, parent links, and ebits are
+            minted inside the program (five separate host-side allocation
+            dispatches used to cost a tunnel round trip each), and only
+            the n_init packed init rows are uploaded."""
             from .wave_common import compact
+
+            init_padded = jnp.zeros((f, w), jnp.uint32)
+            init_padded = jax.lax.dynamic_update_slice(
+                init_padded, init_rows, (0, 0)
+            )
+            key_hi = jnp.zeros((cap,), jnp.uint32)
+            key_lo = jnp.zeros((cap,), jnp.uint32)
+            rows = jnp.zeros(((qcap + pad) * w,), jnp.uint32)
+            parent = jnp.full((qcap + pad,), NO_SLOT_HOST, jnp.uint32)
+            ebits = jnp.zeros((qcap + pad,), jnp.uint32)
 
             hi, lo = device_fp64(init_padded[:, :fpw])
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
@@ -445,15 +485,23 @@ class TpuChecker(Checker):
                 ebits, jnp.full((f,), eb0, jnp.uint32), (jnp.uint32(0),)
             )
             fcount = jnp.sum(is_new, dtype=jnp.uint32)
-            ok = probe_ok & ~dd_overflow
-            return (
-                table.key_hi,
-                table.key_lo,
-                rows,
-                ebits,
-                fcount,
-                ok,
+            seed_fail = (~(probe_ok & ~dd_overflow)).astype(jnp.uint32)
+            stats = jnp.concatenate(
+                [
+                    jnp.stack([
+                        jnp.uint32(0),  # level_start
+                        fcount,  # level_end
+                        fcount,  # tail
+                        n_init,  # sc_lo
+                        jnp.uint32(0),  # sc_hi
+                        fcount,  # unique_count
+                        jnp.uint32(0),  # depth
+                        seed_fail,  # flags (nonzero = seed overflow)
+                    ]),
+                    jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32),
+                ]
             )
+            return table.key_hi, table.key_lo, rows, parent, ebits, stats
 
         return seed, run
 
@@ -464,6 +512,7 @@ class TpuChecker(Checker):
             self._log_capacity,
             self._max_frontier,
             self._dedup_factor,
+            self._waves_per_call,  # baked into run() as a constant
             tuple(p.expectation for p in self._properties),
             (
                 self._options._finish_when._kind,
@@ -566,8 +615,6 @@ class TpuChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        from .hashset import make_hashset
-
         opts = self._options
         cm = self._compiled
         props = self._properties
@@ -618,33 +665,43 @@ class TpuChecker(Checker):
                 )
                 parent = jnp.asarray(sized(np.asarray(snap["parent"]), qcap + pad))
                 ebits = jnp.asarray(sized(np.asarray(snap["ebits"]), qcap + pad))
-                level_start = jnp.uint32(int(snap["level_start"]))
-                level_end = jnp.uint32(int(snap["level_end"]))
-                tail = jnp.uint32(int(snap["tail"]))
-                sc_lo = jnp.uint32(int(snap["sc_lo"]))
-                sc_hi = jnp.uint32(int(snap["sc_hi"]))
-                unique_count = jnp.uint32(int(snap["unique_count"]))
-                depth = jnp.uint32(int(snap["depth"]))
-                disc = jnp.asarray(snap["disc"])
+                disc_np = np.asarray(snap["disc"]).astype(np.uint32)
+                stats = jnp.asarray(
+                    np.concatenate(
+                        [
+                            np.array(
+                                [
+                                    int(snap["level_start"]),
+                                    int(snap["level_end"]),
+                                    int(snap["tail"]),
+                                    int(snap["sc_lo"]),
+                                    int(snap["sc_hi"]),
+                                    int(snap["unique_count"]),
+                                    int(snap["depth"]),
+                                    0,  # flags
+                                ],
+                                np.uint32,
+                            ),
+                            disc_np,
+                        ]
+                    )
+                )
                 with self._lock:
-                    self._state_count = (int(sc_hi) << 32) | int(sc_lo)
-                    self._unique_count = int(unique_count)
-                    self._max_depth = int(depth)
+                    self._state_count = (
+                        int(snap["sc_hi"]) << 32
+                    ) | int(snap["sc_lo"])
+                    self._unique_count = int(snap["unique_count"])
+                    self._max_depth = int(snap["depth"])
                     # Discovery names derive from the persisted disc array
                     # and the property order, which the key above pins.
-                    disc_np = np.asarray(snap["disc"])
                     for p, prop in enumerate(props):
                         if int(disc_np[p]) != NO_SLOT_HOST:
                             self._discovery_slots[prop.name] = int(disc_np[p])
             else:
-                table = make_hashset(cap)
-                rows = jnp.zeros(
-                    ((qcap + pad) * cm.state_width,), jnp.uint32
-                )
-                parent = jnp.full((qcap + pad,), NO_SLOT_HOST, jnp.uint32)
-                ebits = jnp.zeros((qcap + pad,), jnp.uint32)
-
-                # Seed init states.
+                # Seed init states: ONE upload (the packed init rows) +
+                # ONE dispatch that creates every device buffer — a
+                # tunneled device pays ~100 ms per host-side round trip,
+                # so shallow runs live and die on dispatch count.
                 init = cm.init_packed()
                 n_init = init.shape[0]
                 if n_init > f:
@@ -656,17 +713,11 @@ class TpuChecker(Checker):
                         "least the init-state count (interior levels are "
                         "unbounded)"
                     )
-                fill = np.zeros((f - n_init, cm.state_width), np.uint32)
-                init_padded = jnp.asarray(np.concatenate([init, fill]))
-                key_hi, key_lo, rows, ebits, fcount, seed_ok = seed(
-                    table.key_hi,
-                    table.key_lo,
-                    rows,
-                    ebits,
-                    init_padded,
-                    jnp.uint32(n_init),
+                key_hi, key_lo, rows, parent, ebits, stats = seed(
+                    jnp.asarray(init.astype(np.uint32)), jnp.uint32(n_init)
                 )
-                if not bool(seed_ok):
+                stats_h = np.asarray(stats)
+                if int(stats_h[STAT_FLAGS]):
                     # Same auto-tunable condition as the in-loop flag 1: a
                     # dense init batch can exhaust probing before wave 0.
                     raise _OverflowRetry(
@@ -674,59 +725,29 @@ class TpuChecker(Checker):
                         "init-state seeding overflowed the fingerprint "
                         "table; raise spawn_tpu(capacity=...)",
                     )
-
                 self._state_count = n_init
-                self._unique_count = int(fcount)
-                sc_lo = jnp.uint32(n_init)
-                sc_hi = jnp.uint32(0)
-                unique_count = fcount
-                level_start = jnp.uint32(0)
-                level_end = unique_count
-                tail = unique_count
-                depth = jnp.uint32(0)
-                disc = jnp.full((len(props),), NO_SLOT_HOST, jnp.uint32)
+                self._unique_count = int(stats_h[STAT_UNIQUE])
 
             while True:
-                (
-                    key_hi,
-                    key_lo,
-                    rows,
-                    parent,
-                    ebits,
-                    level_start,
-                    level_end,
-                    tail,
-                    sc_lo,
-                    sc_hi,
-                    unique_count,
-                    depth,
-                    disc,
-                    _waves_left,
-                    flags,
-                ) = run(
-                    key_hi,
-                    key_lo,
-                    rows,
-                    parent,
-                    ebits,
-                    level_start,
-                    level_end,
-                    tail,
-                    sc_lo,
-                    sc_hi,
-                    unique_count,
-                    depth,
-                    disc,
-                    jnp.int32(self._waves_per_call),
+                key_hi, key_lo, rows, parent, ebits, stats = run(
+                    key_hi, key_lo, rows, parent, ebits, stats
                 )
-                # One small sync per waves_per_call chunks.
-                remaining_h = int(level_end) - int(level_start)
-                depth_h = int(depth)
-                flags_h = int(flags)
-                disc_h = np.asarray(disc)
+                # ONE small sync per waves_per_call chunks: every scalar
+                # the host reads travels in the stats vector.
+                stats_h = np.asarray(stats)
+                remaining_h = int(stats_h[STAT_LEVEL_END]) - int(
+                    stats_h[STAT_LEVEL_START]
+                )
+                tail_h = int(stats_h[STAT_TAIL])
+                depth_h = int(stats_h[STAT_DEPTH])
+                flags_h = int(stats_h[STAT_FLAGS])
+                unique_h = int(stats_h[STAT_UNIQUE])
+                disc_h = stats_h[STAT_DISC:]
                 with self._lock:
-                    self._state_count = (int(sc_hi) << 32) | int(sc_lo)
-                    self._unique_count = int(unique_count)
+                    self._state_count = (
+                        int(stats_h[STAT_SC_HI]) << 32
+                    ) | int(stats_h[STAT_SC_LO])
+                    self._unique_count = unique_h
                     self._max_depth = depth_h + (1 if remaining_h else 0)
                     for p, prop in enumerate(props):
                         if int(disc_h[p]) != NO_SLOT_HOST:
@@ -783,8 +804,7 @@ class TpuChecker(Checker):
                     logging.getLogger(__name__).warning(
                         "auto-tune: overflow flags=%d; growing in place "
                         "(%s) at unique=%d depth=%d",
-                        flags_h, "; ".join(grown), int(unique_count),
-                        depth_h,
+                        flags_h, "; ".join(grown), unique_h, depth_h,
                     )
                     new_qcap = self._log_capacity
                     new_pad = self._block_pad()
@@ -797,7 +817,7 @@ class TpuChecker(Checker):
                         ebits = _resize_flat(ebits, n_new_len, 0)
                         qcap, pad = new_qcap, new_pad
                     cap = self._capacity
-                    key_hi, key_lo = self._rehash(rows, int(tail))
+                    key_hi, key_lo = self._rehash(rows, tail_h)
                     seed, run = self._programs()
                     continue
                 if remaining_h == 0:
@@ -825,21 +845,22 @@ class TpuChecker(Checker):
             self._tables_dev = (parent, rows)
             # Full run state, for snapshotting: the reference cannot persist
             # a run's visited set at all (SURVEY §5); here the whole checker
-            # state is a handful of dense arrays.
+            # state is a handful of dense arrays.  Scalars come from the
+            # last stats readback (same npz keys as before).
             self._carry_dev = {
                 "key_hi": key_hi,
                 "key_lo": key_lo,
                 "rows": rows,
                 "parent": parent,
                 "ebits": ebits,
-                "level_start": level_start,
-                "level_end": level_end,
-                "tail": tail,
-                "sc_lo": sc_lo,
-                "sc_hi": sc_hi,
-                "unique_count": unique_count,
-                "depth": depth,
-                "disc": disc,
+                "level_start": stats_h[STAT_LEVEL_START],
+                "level_end": stats_h[STAT_LEVEL_END],
+                "tail": stats_h[STAT_TAIL],
+                "sc_lo": stats_h[STAT_SC_LO],
+                "sc_hi": stats_h[STAT_SC_HI],
+                "unique_count": stats_h[STAT_UNIQUE],
+                "depth": stats_h[STAT_DEPTH],
+                "disc": stats_h[STAT_DISC:].copy(),
             }
 
     def _block_pad(self) -> int:

@@ -167,3 +167,35 @@ def test_spawn_tpu_simulation_raft():
     assert sim.state_count() >= 5_000
     assert "Election Safety" not in sim.discoveries()
     assert "State Machine Safety" not in sim.discoveries()
+
+
+@pytest.mark.tpu
+def test_spawn_tpu_raft_default_check_depth12_device():
+    """The reference's DEFAULT `raft check`: BFS to target_max_depth(12)
+    (examples/raft.rs:520-535), whole on one chip.  Count pinned from the
+    2026-07-31 device run (12,603,639 unique / 38.5M generated, ~220 s);
+    representative-order nondeterminism under the partial state identity
+    makes tiny drift possible across engine-shape changes, hence a band.
+    The Election Safety counterexample is genuine — the reference actor
+    persists nothing across crashes, so crash->recover->re-vote elects
+    two leaders in one term; the host oracle finds the identical
+    discovery set at depth 10 (host 844,999 vs device 844,306 unique,
+    the usual representative-order band; runs of 2026-07-31)."""
+    tpu = (
+        raft_model()
+        .checker()
+        .target_max_depth(12)
+        .spawn_tpu(
+            capacity=1 << 26,
+            log_capacity=14_000_000,
+            max_frontier=1 << 13,
+            dedup_factor=1,
+        )
+        .join()
+    )
+    assert 12_550_000 < tpu.unique_state_count() < 12_650_000
+    assert tpu.max_depth() == 12
+    tpu.assert_any_discovery("Election Liveness")
+    tpu.assert_any_discovery("Log Liveness")
+    tpu.assert_any_discovery("Election Safety")
+    tpu.assert_no_discovery("State Machine Safety")
