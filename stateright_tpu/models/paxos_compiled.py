@@ -479,6 +479,81 @@ class PaxosCompiled(CompiledModel):
         nexts, valid, flags = jax.vmap(lambda k: self._deliver_lane(state, k))(ks)
         return nexts, valid, jnp.any(flags)
 
+    def step_valid(self, state):
+        """Phase-A lane validity WITHOUT successor construction.
+
+        ~95% of candidate lanes are invalid for this protocol, and the
+        step kernel's cost is the word assembly + per-lane slot re-sort —
+        so the engine asks for validity first, stream-compacts, and runs
+        the full ``_deliver_lane`` only on the survivors (two-phase
+        expansion).  The guard logic here must match ``_deliver_lane``
+        exactly; tests/test_paxos_tpu.py pins ``step_valid`` against the
+        full kernel's valid plane over entire reachable spaces."""
+        import jax
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        c = self.c
+        m = self.m
+        net0 = self._NET0
+
+        def lane_valid(k):
+            code, occupied = representative_slot_code(state, net0, m, k)
+            e = code - u(1)
+            tag = e >> u(19)
+            addr = (e >> u(14)) & u(0x1F)
+            payload = e & u(0x3FFF)
+            i_src = addr >> u(3)
+            i_dst = addr & u(7)
+            dsrv = jnp.where(
+                tag == u(_T_PUT),
+                addr % u(3),
+                jnp.where(tag == u(_T_GET), (addr + u(1)) % u(3), i_dst),
+            )
+            lo = u(0)
+            hi = u(0)
+            for s in range(S):
+                lo = jnp.where(dsrv == u(s), state[2 * s], lo)
+                hi = jnp.where(dsrv == u(s), state[2 * s + 1], hi)
+            ballot = self._ext(lo, hi, *self._F_BALLOT)
+            prop = self._ext(lo, hi, *self._F_PROP)
+            decided = self._ext(lo, hi, *self._F_DECIDED)
+            not_dec = decided == u(0)
+
+            _ci, _cli, kind, _opc = self.rc.client_record(state, i_dst)
+
+            def sel(pairs, default):
+                out = default
+                for t, v in pairs:
+                    out = jnp.where(tag == u(t), v, out)
+                return out
+
+            return occupied & sel(
+                [
+                    (_T_PUT, not_dec & (prop == u(0))),
+                    (_T_GET, decided == u(1)),
+                    (_T_PREPARE, not_dec & (ballot < payload * u(3) + i_src)),
+                    (
+                        _T_PREPARED,
+                        not_dec & ((payload // u(512)) * u(3) + i_dst == ballot),
+                    ),
+                    (
+                        _T_ACCEPT,
+                        not_dec & (ballot <= (payload // u(8)) * u(3) + i_src),
+                    ),
+                    (
+                        _T_ACCEPTED,
+                        not_dec & (payload * u(3) + i_dst == ballot),
+                    ),
+                    (_T_DECIDED, not_dec),
+                    (_T_PUTOK, (kind == u(1)) & (i_dst < u(c))),
+                    (_T_GETOK, (kind == u(2)) & (i_dst < u(c))),
+                ],
+                jnp.zeros((), jnp.bool_),
+            )
+
+        return jax.vmap(lane_valid)(jnp.arange(m, dtype=u))
+
     def _deliver_lane(self, state, k):
         """One Deliver lane: expand slot ``k``'s envelope (if occupied)."""
         import jax.numpy as jnp

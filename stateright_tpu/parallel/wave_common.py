@@ -122,11 +122,14 @@ def compact(mask, values, size: int):
     return buf.at[idx].set(values, mode="drop")
 
 
-def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
+def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc,
+              allow_two_phase: bool = False):
     """The shared wave step (minus dedup/insert, which differs per engine).
 
     Returns :class:`WaveEval` with ``disc`` already folded (first-writer-
-    wins against the incoming ``disc`` vector).
+    wins against the incoming ``disc`` vector).  With ``allow_two_phase``
+    and a model exposing ``step_valid``, ``nexts`` comes back None — the
+    caller constructs successors itself on the compacted valid lanes.
     """
     import jax
     import jax.numpy as jnp
@@ -170,8 +173,20 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc):
     for bit, p in enumerate(ev_indices):
         eb = eb & ~(conds[:, p].astype(jnp.uint32) << bit)
 
-    # Successor expansion.
-    if getattr(cm, "step_flags", False):
+    # Successor expansion.  Two-phase models answer lane VALIDITY without
+    # constructing successors (construction then runs compacted, on the
+    # ~5% surviving lanes — the engine's phase B); their per-lane
+    # capacity flags surface in phase B instead.
+    two_phase = (
+        allow_two_phase
+        and hasattr(cm, "step_valid")
+        and cm.boundary(states[0]) is None
+    )
+    if two_phase:
+        nexts = None
+        valid = jax.vmap(cm.step_valid)(states)  # [F, A]
+        step_flag = jnp.zeros((), jnp.bool_)
+    elif getattr(cm, "step_flags", False):
         nexts, valid, lane_flags = jax.vmap(cm.step)(states)
         step_flag = jnp.any(jnp.asarray(lane_flags) & active)
     else:

@@ -294,31 +294,50 @@ class TpuChecker(Checker):
             disc_prev = disc
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, ids, eb_chunk, disc,
+                allow_two_phase=True,
             )
 
-            # Dedup + insert, in compact form: results come back U-sized
-            # (one lane per distinct key, U = B/dedup_factor), so the
-            # append below costs O(distinct keys) instead of O(candidate
-            # lanes) — ~95% of candidate lanes are invalid or duplicates.
-            flat = nexts.reshape(f * a, w)
             flat_valid = valid.reshape(f * a)
-            hi, lo = device_fp64(flat[:, :fpw])
-            # Compact the ~5% valid lanes BEFORE the dedup sort (measured
-            # +13% throughput on the bench workload; warm-compile is
-            # unaffected — it is pinned by the platform's server-side
-            # compile, see docs).  Overflow flags loudly (flag 4).
-            v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
-                hi, lo, flat_valid, dedup_factor
-            )
+            if nexts is None:
+                # TWO-PHASE expansion: compact the ~5% valid lanes FIRST,
+                # then construct successors (word assembly + per-lane slot
+                # re-sort — the expensive half of the step kernel) only
+                # for the survivors, and fingerprint U lanes instead of B.
+                from .hashset import compact_valid_indices
+
+                v_orig, v_act, n_valid, v_overflow = compact_valid_indices(
+                    flat_valid, dedup_factor
+                )
+                src_state = v_orig // jnp.uint32(a)
+                lane_k = v_orig % jnp.uint32(a)
+                par_rows = states[src_state]  # [U, w] gather
+                nexts_u, _valid_u, lane_flags_u = jax.vmap(
+                    cm.step_lane
+                )(par_rows, lane_k)
+                step_flag = step_flag | jnp.any(lane_flags_u & v_act)
+                hi, lo = device_fp64(nexts_u[:, :fpw])
+                compact_rows = nexts_u
+                compact_src = src_state
+            else:
+                # Dedup + insert, in compact form: results come back
+                # U-sized (one lane per distinct key), so the append below
+                # costs O(distinct keys) instead of O(candidate lanes).
+                flat = nexts.reshape(f * a, w)
+                hi_b, lo_b = device_fp64(flat[:, :fpw])
+                v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
+                    hi_b, lo_b, flat_valid, dedup_factor
+                )
+                hi, lo = v_hi, v_lo
+                compact_rows = None
+                compact_src = None
             (
                 table, _u_slot, u_new, u_origin, _u_active, probe_ok,
                 dd_overflow,
             ) = insert_batch_compact(
-                HashSet(key_hi, key_lo), v_hi, v_lo, v_act,
+                HashSet(key_hi, key_lo), hi, lo, v_act,
                 dedup_factor=1,
             )
             dd_overflow = dd_overflow | v_overflow
-            u_origin = v_orig[u_origin]
             n_new = jnp.sum(u_new, dtype=jnp.uint32)
 
             # An overflowing wave must NOT commit: the host grows the
@@ -363,9 +382,14 @@ class TpuChecker(Checker):
             from .wave_common import compact
 
             sel = compact(u_new, jnp.arange(u, dtype=jnp.uint32), pad)
-            idxs = u_origin[sel]  # original flat candidate lane
-            rows_blk = flat[idxs]  # [pad, w] gather
-            src_state = idxs // jnp.uint32(a)
+            sel_u = u_origin[sel]  # lane in the compacted valid buffer
+            if compact_rows is not None:  # two-phase: rows already built
+                rows_blk = compact_rows[sel_u]  # [pad, w] gather
+                src_state = compact_src[sel_u]
+            else:
+                idxs = v_orig[sel_u]  # original flat candidate lane
+                rows_blk = flat[idxs]  # [pad, w] gather
+                src_state = idxs // jnp.uint32(a)
             par_blk = level_start + src_state
             eb_blk = eb[src_state]
             rows = jax.lax.dynamic_update_slice(
