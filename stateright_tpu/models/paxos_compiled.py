@@ -487,8 +487,9 @@ class PaxosCompiled(CompiledModel):
         so the engine asks for validity first, stream-compacts, and runs
         the full ``_deliver_lane`` only on the survivors (two-phase
         expansion).  The guard logic here must match ``_deliver_lane``
-        exactly; tests/test_paxos_tpu.py pins ``step_valid`` against the
-        full kernel's valid plane over entire reachable spaces."""
+        exactly; tests/test_paxos_tpu.py::test_step_valid_matches_full_kernel_c2
+        pins ``step_valid`` against the full kernel's valid plane over the
+        entire 16,668-state reachable space."""
         import jax
         import jax.numpy as jnp
 
@@ -553,6 +554,19 @@ class PaxosCompiled(CompiledModel):
             )
 
         return jax.vmap(lane_valid)(jnp.arange(m, dtype=u))
+
+    def step_lane(self, state, k):
+        """Phase-B successor construction for ONE compacted lane.
+
+        The engine's two-phase contract (`parallel/wave_common.py`): a
+        model exposing both ``step_valid`` and ``step_lane`` gets its
+        lanes validity-screened first, and only the ~5% survivors run
+        this full construction kernel.  ``step_lane``'s valid plane must
+        agree with ``step_valid`` on every lane — pinned over the entire
+        16,668-state reachable space by
+        tests/test_paxos_tpu.py::test_step_valid_matches_full_kernel_c2.
+        """
+        return self._deliver_lane(state, k)
 
     def _deliver_lane(self, state, k):
         """One Deliver lane: expand slot ``k``'s envelope (if occupied)."""

@@ -128,8 +128,9 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc,
 
     Returns :class:`WaveEval` with ``disc`` already folded (first-writer-
     wins against the incoming ``disc`` vector).  With ``allow_two_phase``
-    and a model exposing ``step_valid``, ``nexts`` comes back None — the
-    caller constructs successors itself on the compacted valid lanes.
+    and a model exposing BOTH ``step_valid`` and ``step_lane``, ``nexts``
+    comes back None — the caller constructs successors itself (via
+    ``step_lane``) on the compacted valid lanes.
     """
     import jax
     import jax.numpy as jnp
@@ -180,6 +181,7 @@ def wave_eval(cm, props, ev_indices, states, active, ids, eb_in, disc,
     two_phase = (
         allow_two_phase
         and hasattr(cm, "step_valid")
+        and hasattr(cm, "step_lane")
         and cm.boundary(states[0]) is None
     )
     if two_phase:

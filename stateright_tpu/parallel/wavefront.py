@@ -532,6 +532,12 @@ class TpuChecker(Checker):
     def _programs(self):
         key = (
             self._compiled.cache_key(),
+            # The two-phase gate is evaluated at trace time (wave_eval's
+            # hasattr checks) — it must key the program, or a model whose
+            # capability set changes (e.g. tests forcing the single-phase
+            # branch) would silently re-run the wrong compiled program.
+            hasattr(self._compiled, "step_valid")
+            and hasattr(self._compiled, "step_lane"),
             self._capacity,
             self._log_capacity,
             self._max_frontier,
