@@ -9,22 +9,34 @@ can never zero the headline): time-to-first-violation on the
 property-violating variant, and a 1-device-mesh `spawn_tpu_sharded` smoke so
 the shard_map program runs on real TPU hardware every round.
 
-Prints the headline JSON line the moment the TPU rate and host denominator
-are both known:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
-where value is unique-states/sec of the TPU wavefront checker (warm —
-program compile excluded; the compile is a one-time per-(model, shape) cost
-served by the program/persistent caches) and vs_baseline is the ratio to
-the host BFS measured on this machine.  If the optional phases succeed the
-full record is re-emitted as the final line with their keys added — both
-lines are valid records with identical headline values, so a parser taking
-either the first or the last JSON line gets the same score.
+Emit protocol (LAST JSON line is authoritative — the driver's parser takes
+it; every earlier line is a valid fallback record from an earlier phase):
+
+  phase 0  smoke: paxos c=2 (reference golden 16,668) on default knobs.
+           A minimal-but-valid record is emitted the moment it passes, so
+           ANY later crash — including in the headline warm-up, which
+           zeroed round 4 — still leaves a parseable artifact.
+  phase 1  headline: `paxos check 3` discovered with pure default engine
+           knobs (auto-tune does all sizing), then measured best-of-3 at
+           the discovered sizes.  Emitted as soon as the host denominator
+           exists.  If the two-phase expansion path fails, the run falls
+           back to the single-phase step kernel (and says so in the
+           record) rather than dying.
+  phase 2+ optional phases (reference suite, ttfv, sharded smoke) add
+           keys and re-emit; they can never zero earlier lines.
+
+Record shape: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+...} where value is unique-states/sec of the TPU wavefront checker (warm —
+program compile excluded; the compile is a one-time per-(model, shape)
+cost served by the program/persistent caches) and vs_baseline is the
+ratio to the host BFS measured on this machine.
 
 Robustness: every device run is wrapped in a bounded retry on transient
 tunnel errors (the round-2 score was lost to a single
-`remote_compile: read body closed` in an *optional* phase), and a unique-
-state-count mismatch vs the golden is FATAL — a wrong-answer run must not
-post a rate.
+`remote_compile: read body closed` in an *optional* phase); a unique-
+state-count mismatch vs the golden is FATAL for that phase's rate — a
+wrong-answer run must not post a number — and once any record has been
+emitted the process always exits 0 so the artifact survives.
 
 DENOMINATOR HONESTY: the host engine is this package's reference-style
 thread-pool BFS — pure Python, measured at `threads=os.cpu_count()` and
@@ -52,13 +64,9 @@ sys.path.insert(0, str(_REPO))
 # tests/test_cross_engine_pin.py, used here to detect regressions.
 GOLDEN_UNIQUE = 1_194_428
 GOLDEN_DEPTH = 28
+SMOKE_UNIQUE = 16_668  # reference examples/paxos.rs:328 (paxos check 2)
 HOST_TIME_SLICE = 60.0  # seconds of host BFS to establish the denominator
-# f=8192/dd=8 measured best on the v5e (221k uniq/s): per-chunk cost
-# scales ~linearly with max_frontier (no amortization win at 32k);
-# dedup_factor=8 halves the probe-round width vs 4 and the widest paxos3
-# levels still fit its 32k valid-lane buffer, while 16 overflows
-# (scratch profiling, round 3; see docs/TPU_PAXOS_DESIGN.md).
-TPU_KWARGS = dict(capacity=1 << 23, max_frontier=1 << 13, dedup_factor=8)
+MEASURED_REPEATS = 3  # reference bench.sh COUNT=3; value = best-of-N
 
 # Transient tunneled-device failures worth retrying (observed:
 # jax.errors.JaxRuntimeError INTERNAL "remote_compile: read body:
@@ -177,67 +185,181 @@ REFERENCE_SUITE = [
 ]
 
 
-def phase_reference_suite(record: dict) -> None:
-    """Run the reference's full bench list on device: a DISCOVERY run with
-    pure default engine knobs (auto-tune does all sizing — no hand-tuned
-    per-workload constants), then a measured run at the discovered sizes.
-    Each workload is golden-gated; one failure never hides the others."""
+def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
+    """THE measurement protocol, shared by the headline and every suite
+    workload so the two cannot drift: a timed default-knob discovery run
+    (auto-tune does all sizing), a (unique, depth) golden gate, then up
+    to MEASURED_REPEATS measured runs at ``tuned_kwargs()`` — each
+    re-gated — with big workloads (>120s) measured once.  Returns
+    ``(discovery_sec, tuned, samples)``; raises on any golden mismatch
+    or device error (a wrong answer must never post a rate)."""
     import gc
+
+    log(f"{label}: discovery run (default knobs, auto-tune sizing)...")
+    t0 = time.time()
+    ck = run_device(lambda: mk().checker().spawn_tpu())
+    discovery = time.time() - t0
+    tuned = ck.tuned_kwargs()
+    unique, depth = ck.unique_state_count(), ck.max_depth()
+    del ck
+    gc.collect()
+    if (unique, depth) != (want_unique, want_depth):
+        raise AssertionError(
+            f"{label}: discovery golden mismatch: unique={unique} "
+            f"depth={depth} != {want_unique}/{want_depth}"
+        )
+    log(f"{label}: discovery {discovery:.1f}s (incl. compile); "
+        f"measured runs {tuned}...")
+    samples = []
+    for rep in range(MEASURED_REPEATS):
+        ck, dt = run_device_timed(
+            lambda: mk().checker().spawn_tpu(**tuned)
+        )
+        unique, depth = ck.unique_state_count(), ck.max_depth()
+        del ck
+        gc.collect()
+        if (unique, depth) != (want_unique, want_depth):
+            raise AssertionError(
+                f"{label}: measured golden mismatch: unique={unique} "
+                f"depth={depth} != {want_unique}/{want_depth}"
+            )
+        samples.append(dt)
+        log(f"{label}: measured[{rep}]: {dt:.2f}s = "
+            f"{unique / dt:.0f} uniq/s")
+        # Big workloads (minutes each) stop at TWO samples: the first
+        # measured run traces+compiles the tuned shapes (discovery never
+        # compiled them — its growth path visits different sizes), so a
+        # single sample would include compile time the record claims to
+        # exclude; the second run is warm via the program cache and
+        # best-of-N drops the cold one.
+        if dt > 120.0 and rep >= 1:
+            break
+    return discovery, tuned, samples
+
+
+def _measure_suite_workload(spec, entry: dict) -> None:
+    """Run the shared protocol for ONE reference-suite workload; results
+    land in ``entry`` (golden mismatches become error entries, so one
+    wrong workload never hides the others)."""
+    name, mk, want_unique, want_depth = spec
+    try:
+        discovery, tuned, samples = discover_and_measure(
+            f"suite: {name}", mk, want_unique, want_depth
+        )
+    except AssertionError as exc:
+        entry["error"] = str(exc)
+        log(entry["error"])
+        return
+    best = min(samples)
+    entry["discovery_sec"] = round(discovery, 2)
+    entry["unique_states"] = want_unique
+    entry["depth"] = want_depth
+    entry["sec"] = round(best, 2)
+    entry["samples_sec"] = [round(s, 2) for s in samples]
+    entry["unique_states_per_sec"] = round(want_unique / best, 1)
+    log(
+        f"suite: {name}: {want_unique} unique, best of "
+        f"{len(samples)}: {best:.2f}s = "
+        f"{want_unique / best:.0f} uniq/s"
+    )
+
+
+def run_suite_workload(name: str) -> None:
+    """Child-process entry (``bench.py --suite-workload NAME``): run one
+    suite workload, print its entry as the last JSON line, always exit 0
+    (errors are data, not exit codes)."""
+    entry: dict = {}
+    try:
+        spec = next(s for s in REFERENCE_SUITE if s[0] == name)
+        _measure_suite_workload(spec, entry)
+    except Exception:
+        entry.setdefault("error", traceback.format_exc(limit=3))
+        log(f"suite child {name}: failed:\n{entry['error']}")
+    print(json.dumps({"suite_entry": entry}), flush=True)
+
+
+def phase_reference_suite(record: dict) -> None:
+    """Run the reference's full bench list on device, ONE SUBPROCESS PER
+    WORKLOAD: a TPU worker crash mid-workload (observed on the 61.5M-state
+    `2pc check 10` — the crashed worker poisons every later device call in
+    that process, retries included) must cost that workload only, never
+    the remaining phases.  A workload whose child reports a device crash
+    gets one fresh-process retry (a new process reconnects fine).
+
+    Concurrent clients verified on this tunnel (2026-07-31): a second
+    process ran a device computation while another held the chip
+    mid-run, so children initializing the runtime under a live parent
+    client is safe here."""
+    import subprocess
 
     suite: dict = {}
     record["reference_suite"] = suite
-    for name, mk, want_unique, want_depth in REFERENCE_SUITE:
-        entry: dict = {}
-        suite[name] = entry
-        try:
-            log(f"suite: {name}: discovery run (default knobs)...")
-            t0 = time.time()
-            ck = run_device(lambda: mk().checker().spawn_tpu())
-            entry["discovery_sec"] = round(time.time() - t0, 2)
-            tuned = ck.tuned_kwargs()
-            unique, depth = ck.unique_state_count(), ck.max_depth()
-            del ck
-            gc.collect()
-            if (unique, depth) != (want_unique, want_depth):
-                entry["error"] = (
-                    f"golden mismatch: unique={unique} depth={depth} != "
-                    f"{want_unique}/{want_depth}"
+    for spec in REFERENCE_SUITE:
+        name = spec[0]
+        for attempt in (1, 2):
+            log(f"suite: {name}: isolated child (attempt {attempt})...")
+            crashed = False
+            try:
+                proc = subprocess.run(
+                    [sys.executable, str(_REPO / "bench.py"),
+                     "--suite-workload", name],
+                    # 2pc check 10 from default knobs: ~21 min discovery
+                    # (measured 2026-07-31) + two comparable measured
+                    # runs (cold + warm).
+                    capture_output=True, text=True, timeout=7200,
                 )
-                log(f"suite: {name}: {entry['error']}")
-                continue
-            log(f"suite: {name}: measured run {tuned}...")
-            ck, dt = run_device_timed(
-                lambda: mk().checker().spawn_tpu(**tuned)
+                sys.stderr.write(proc.stderr)
+                lines = [
+                    ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("{")
+                ]
+                if proc.returncode != 0 or not lines:
+                    # The child exits 0 and always prints a JSON line —
+                    # unless the runtime killed it outright (SIGABRT from
+                    # a poisoned TPU worker): that's exactly the case the
+                    # fresh-process retry exists for.
+                    crashed = True
+                    suite[name] = {"error": (
+                        f"child died rc={proc.returncode} without a "
+                        f"result; stderr tail: {proc.stderr[-500:]}"
+                    )}
+                else:
+                    suite[name] = json.loads(lines[-1])["suite_entry"]
+            except subprocess.TimeoutExpired as te:
+                # Deterministic slowness, not a crash: a retry would burn
+                # another budget and cannot succeed.  Keep the child's log
+                # tail for diagnosis.
+                tail = te.stderr or ""
+                if isinstance(tail, bytes):
+                    tail = tail.decode(errors="replace")
+                suite[name] = {"error": (
+                    f"child timed out after {te.timeout:.0f}s; stderr "
+                    f"tail: {tail[-500:]}"
+                )}
+                log(f"suite: {name}: {suite[name]['error']}")
+                break
+            except Exception:
+                crashed = True
+                suite[name] = {"error": traceback.format_exc(limit=3)}
+                log(f"suite: {name}: child handling failed:\n"
+                    f"{suite[name]['error']}")
+            err = suite[name].get("error", "")
+            crashed = crashed or any(
+                m in err for m in _TRANSIENT_MARKERS + ("crashed",)
             )
-            unique, depth = ck.unique_state_count(), ck.max_depth()
-            del ck
-            gc.collect()
-            if (unique, depth) != (want_unique, want_depth):
-                entry["error"] = (
-                    f"golden mismatch (measured run): unique={unique} "
-                    f"depth={depth} != {want_unique}/{want_depth}"
-                )
-                log(f"suite: {name}: {entry['error']}")
-                continue
-            entry["unique_states"] = unique
-            entry["depth"] = depth
-            entry["sec"] = round(dt, 2)
-            entry["unique_states_per_sec"] = round(unique / dt, 1)
-            log(
-                f"suite: {name}: {unique} unique in {dt:.2f}s = "
-                f"{unique / dt:.0f} uniq/s"
-            )
-        except Exception:
-            entry["error"] = traceback.format_exc(limit=3)
-            log(f"suite: {name}: failed:\n{entry['error']}")
+            if not crashed:
+                break  # success, or a deterministic error a retry won't fix
 
 
 def emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
-def phase_ttfv(record: dict, threads: int) -> None:
-    """Time-to-first-violation on the never-decided variant (optional)."""
+def phase_ttfv(record: dict, threads: int, tuned: dict) -> None:
+    """Time-to-first-violation on the never-decided variant (optional).
+
+    Uses the headline run's auto-tuned engine sizes (same model shape) so
+    no hand-tuned constants are involved."""
     from stateright_tpu.core.has_discoveries import HasDiscoveries
 
     def spawn():
@@ -245,7 +367,7 @@ def phase_ttfv(record: dict, threads: int) -> None:
             paxos_model(3, never_decided=True)
             .checker()
             .finish_when(HasDiscoveries.ANY_FAILURES)
-            .spawn_tpu(**TPU_KWARGS)
+            .spawn_tpu(**tuned)
         )
 
     log("ttfv: warming violating-variant program...")
@@ -309,36 +431,126 @@ def phase_sharded_smoke(record: dict) -> None:
     )
     record["sharded_1dev_paxos2_sec"] = round(sharded_dt, 2)
     record["sharded_vs_single_overhead"] = round(sharded_dt / single_dt, 2)
+    acc = c.accounting()
+    record["sharded_accounting"] = {
+        "waves": acc["waves"],
+        "all_to_all_bytes_total": acc["all_to_all_bytes_total"],
+        "exchange_occupancy": round(acc["exchange_occupancy"], 4),
+        "unique_skew_max_over_mean": round(
+            acc["unique_skew_max_over_mean"], 4
+        ),
+    }
 
 
-def main() -> None:
-    import jax
+def _force_single_phase() -> bool:
+    """Disable the two-phase expansion path (engine falls back to the
+    single-phase step kernel).  Returns True if anything changed."""
+    from stateright_tpu.models.paxos_compiled import PaxosCompiled
 
-    threads = os.cpu_count() or 1
-    log(f"device: {jax.devices()[0]}; host threads: {threads}")
+    if hasattr(PaxosCompiled, "step_valid"):
+        del PaxosCompiled.step_valid
+        return True
+    return False
 
-    log("warming TPU program (trace + compile)...")
-    t0 = time.time()
-    run_device(lambda: paxos_model(3).checker().spawn_tpu(**TPU_KWARGS))
-    warmup = time.time() - t0
-    log(f"  warm-up run: {warmup:.1f}s")
 
-    checker, tpu_dt = run_device_timed(
-        lambda: paxos_model(3).checker().spawn_tpu(**TPU_KWARGS)
-    )
-    unique = checker.unique_state_count()
-    if unique != GOLDEN_UNIQUE or checker.max_depth() != GOLDEN_DEPTH:
-        # FATAL: a wrong-answer run must not post a throughput number.
-        log(
-            f"FATAL: unique={unique} depth={checker.max_depth()} != golden "
-            f"{GOLDEN_UNIQUE}/depth {GOLDEN_DEPTH}; refusing to emit a rate"
+def phase_smoke(threads: int) -> dict:
+    """Phase 0: tiny reference golden on default knobs + a minimal valid
+    record, emitted BEFORE the expensive headline warm-up is attempted
+    (the round-4 artifact was zeroed by a warm-up crash).  Even phase 0
+    gets the single-phase fallback: a deterministic two-phase regression
+    must still produce an artifact, not a zero-JSON exit."""
+    def smoke_run():
+        run_device(lambda: paxos_model(2).checker().spawn_tpu())  # compile
+        ck, dt = run_device_timed(
+            lambda: paxos_model(2).checker().spawn_tpu()
         )
-        sys.exit(1)
-    tpu_rate = unique / tpu_dt
-    log(
-        f"tpu: {unique} unique in {tpu_dt:.2f}s = {tpu_rate:.0f} uniq/s "
-        f"(states={checker.state_count()}, depth={checker.max_depth()})"
+        unique = ck.unique_state_count()
+        if unique != SMOKE_UNIQUE:
+            # Inside the fallback scope: a silently-wrong two-phase run
+            # must trigger the single-phase retry, same as a crash.
+            raise AssertionError(
+                f"smoke paxos2 unique={unique} != {SMOKE_UNIQUE}"
+            )
+        return ck, dt
+
+    fallback_reason = None
+    try:
+        ck, dt = smoke_run()
+    except Exception as exc:
+        # A deterministic worker crash surfaces as UNAVAILABLE — the same
+        # type as a transient tunnel blip — so transience cannot be
+        # decided from the exception alone.  After run_device's bounded
+        # retries are exhausted, ALWAYS try the single-phase fallback
+        # once: on a dead tunnel it fails the same way (nothing lost); on
+        # a real two-phase regression it saves the artifact.  The record
+        # carries the reason so a fallback run is never mistaken for a
+        # healthy two-phase measurement.
+        if not _force_single_phase():
+            raise
+        fallback_reason = f"{type(exc).__name__}: {exc}"[:300]
+        log("smoke: device run failed; retrying single-phase:")
+        log(traceback.format_exc(limit=5))
+        ck, dt = smoke_run()
+    unique = ck.unique_state_count()
+    t0 = time.time()
+    host = (
+        paxos_model(2).checker().threads(threads).timeout(120).spawn_bfs()
+        .join()
     )
+    host_dt = time.time() - t0
+    host_rate = host.unique_state_count() / host_dt
+    rate = unique / dt
+    log(f"smoke: paxos2 tpu {unique} unique in {dt:.2f}s (warm) = "
+        f"{rate:.0f} uniq/s; host {host_rate:.0f} uniq/s")
+    record = {
+        "metric": "paxos2_smoke_unique_states_per_sec",
+        "value": round(rate, 1),
+        "unit": "unique states/sec",
+        "vs_baseline": round(rate / host_rate, 2),
+        "phase": "smoke0",
+        "note": (
+            "fallback record emitted before the headline phases; a later "
+            "line (paxos3 headline) supersedes this one"
+        ),
+    }
+    if fallback_reason:
+        record["single_phase_reason"] = fallback_reason
+    emit(record)
+    return record
+
+
+def phase_headline(record: dict, threads: int) -> dict:
+    """Phase 1: `paxos check 3` — default-knob auto-tune discovery, then
+    best-of-N measured at the discovered sizes.  Falls back to the
+    single-phase step kernel if the two-phase path fails.  Returns the
+    tuned kwargs for later phases."""
+    from stateright_tpu.models.paxos_compiled import PaxosCompiled
+
+    # False already here if the smoke phase had to fall back.
+    two_phase = hasattr(PaxosCompiled, "step_valid")
+    single_phase_reason = record.get("single_phase_reason")
+    try:
+        discovery, tuned, samples = discover_and_measure(
+            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
+        )
+    except Exception as exc:
+        # Deterministic worker crashes surface as UNAVAILABLE, the same
+        # type as transient tunnel blips, so transience cannot be decided
+        # here: after the bounded retries, always try single-phase once
+        # (a dead tunnel fails identically; a two-phase regression still
+        # yields a headline).  The record says why, so a fallback run is
+        # never mistaken for a healthy two-phase measurement.
+        if not _force_single_phase():
+            raise
+        two_phase = False
+        single_phase_reason = f"{type(exc).__name__}: {exc}"[:300]
+        log("headline: device run failed; retrying single-phase:")
+        log(traceback.format_exc(limit=5))
+        discovery, tuned, samples = discover_and_measure(
+            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
+        )
+    best = min(samples)
+    tpu_rate = GOLDEN_UNIQUE / best
 
     log(f"host BFS denominator ({HOST_TIME_SLICE:.0f}s slice, "
         f"threads={threads})...")
@@ -358,7 +570,8 @@ def main() -> None:
         f"{host_rate:.0f} uniq/s"
     )
 
-    record = {
+    record.clear()
+    record.update({
         "metric": "paxos3_unique_states_per_sec",
         "value": round(tpu_rate, 1),
         "unit": "unique states/sec",
@@ -368,32 +581,61 @@ def main() -> None:
             "this package's thread-pool BFS (pure Python, GIL-bound)"
         ),
         "denominator_threads": threads,
-        "tpu_unique_states": unique,
-        "tpu_wallclock_sec": round(tpu_dt, 2),
-        "tpu_warmup_sec": round(warmup, 1),
-    }
+        "tpu_unique_states": GOLDEN_UNIQUE,
+        "tpu_wallclock_sec": round(best, 2),
+        "samples_sec": [round(s, 2) for s in samples],
+        "tpu_warmup_sec": round(discovery, 1),
+        "tuned_kwargs": {k: int(v) for k, v in tuned.items()},
+        "two_phase": two_phase,
+    })
+    if single_phase_reason:
+        record["single_phase_reason"] = single_phase_reason
     # The score of record: emitted the moment it exists, so no later phase
     # (or crash) can zero it.
     emit(record)
+    return tuned
+
+
+def main() -> None:
+    import jax
+
+    threads = os.cpu_count() or 1
+    log(f"device: {jax.devices()[0]}; host threads: {threads}")
+
+    record = phase_smoke(threads)
+
+    # From here on a record exists: any failure must exit 0 so the
+    # artifact survives (the last emitted line stays authoritative).
+    try:
+        tuned = phase_headline(record, threads)
+    except Exception:
+        log("headline failed (smoke record stands):")
+        log(traceback.format_exc())
+        return
 
     # Optional phases — each failure is logged and skipped, never fatal.
-    extras_ok = 0
+    # The in-process phases (ttfv, sharded) run BEFORE the reference suite:
+    # the suite's big workloads are the ones that have crashed the TPU
+    # worker, and although each now runs in its own subprocess, keeping
+    # the parent's device use front-loaded is free insurance.
     for phase in (
-        phase_reference_suite,
-        lambda r: phase_ttfv(r, threads),
+        lambda r: phase_ttfv(r, threads, tuned),
         phase_sharded_smoke,
+        phase_reference_suite,
     ):
         try:
             phase(record)
-            extras_ok += 1
+            # Re-emit after EVERY phase: same headline values, extra keys
+            # accreted — if the driver kills the bench mid-suite, the last
+            # line still carries every phase that finished.
+            emit(record)
         except Exception:  # noqa: BLE001 - optional phase, log + continue
             log("optional phase failed (headline already emitted):")
             log(traceback.format_exc())
-    if extras_ok:
-        # Final line: same headline values, extra keys added; parsers that
-        # take the last JSON line get the enriched record.
-        emit(record)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--suite-workload":
+        run_suite_workload(sys.argv[2])
+    else:
+        main()

@@ -73,6 +73,16 @@ STAT_DISC = 8  # disc[P] rides at [STAT_DISC : STAT_DISC + n_props]
 # overflow surfaces as the ordinary loud RuntimeError.
 _MAX_TABLE_CAPACITY = 1 << 28
 _ROW_LOG_BYTE_BUDGET = 8 << 30
+# Empirical device limit on the per-wave compact/dedup buffer width
+# U = unique_buffer_size(max_frontier * max_actions, dedup_factor): on the
+# v5e a 1.7M-lane buffer (2pc rm=10 at f=2^15, dd=1) reliably CRASHES the
+# TPU worker process mid-wave ("kernel fault", 2026-07-31 isolation: both
+# 426K-lane configs around it run to their graceful overflow flags), so
+# when auto-tune relaxes dedup_factor it also halves max_frontier until U
+# fits this band.  Halving the frontier alone cannot fix a dedup overflow
+# (valid density is scale-free), but dd=1 can never overflow, so dd=1
+# plus a clamped frontier always terminates the growth sequence.
+_MAX_UNIQUE_BUFFER = 1 << 19
 
 
 class _OverflowRetry(Exception):
@@ -86,7 +96,10 @@ class _OverflowRetry(Exception):
 
 
 def _resize_flat(arr, new_len: int, fill):
-    """Grow a flat device array, preserving the prefix (auto-tune path).
+    """Resize a flat device array, preserving the (new-length-bounded)
+    prefix — the auto-tune path.  Shrink happens when a dedup-overflow
+    growth halves ``max_frontier`` and with it the append-block pad; the
+    committed log prefix is always shorter than the new length.
 
     Copy-growth unavoidably holds old + new live at once (donation cannot
     alias buffers of different sizes); the ×2 row-log growth step keeps
@@ -95,6 +108,8 @@ def _resize_flat(arr, new_len: int, fill):
     import jax
     import jax.numpy as jnp
 
+    if new_len <= arr.shape[0]:
+        return arr[:new_len]
     out = jnp.full((new_len,), fill, arr.dtype)
     return jax.lax.dynamic_update_slice(out, arr, (0,))
 
@@ -162,6 +177,44 @@ class TpuChecker(Checker):
         self._dedup_factor = dedup_factor
         self._auto_tune = bool(auto_tune)
         self._max_frontier = max_frontier
+        # Spawn-time guard on the compact/dedup buffer width: configs past
+        # _MAX_UNIQUE_BUFFER hard-CRASH the TPU worker mid-wave instead of
+        # flagging (see the constant's comment), so a requested geometry in
+        # the crash band is clamped here — same rule the auto-tune growth
+        # path applies — with a logged warning.
+        from .hashset import unique_buffer_size
+
+        a = self._compiled.max_actions
+        clamped = False
+        while (
+            self._max_frontier > 2048
+            and unique_buffer_size(self._max_frontier * a, self._dedup_factor)
+            > _MAX_UNIQUE_BUFFER
+        ):
+            self._max_frontier //= 2
+            clamped = True
+        if (
+            unique_buffer_size(self._max_frontier * a, self._dedup_factor)
+            > _MAX_UNIQUE_BUFFER
+        ):
+            # Over budget even at the floor frontier (max_actions > 256):
+            # refuse loudly, like the _grow path — proceeding means a
+            # worker crash, not an overflow flag.
+            raise ValueError(
+                f"chunk geometry (max_frontier={self._max_frontier}, "
+                f"max_actions={a}, dedup_factor={dedup_factor}) exceeds "
+                "the device-safe compact-buffer band even at the floor "
+                "frontier; raise dedup_factor"
+            )
+        if clamped:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "spawn_tpu: max_frontier clamped to %d (max_actions=%d, "
+                "dedup_factor=%d): the requested chunk geometry exceeds "
+                "the device-safe compact-buffer band",
+                self._max_frontier, a, dedup_factor,
+            )
         if waves_per_call is None:
             from .wave_common import default_waves_per_call
 
@@ -633,10 +686,33 @@ class TpuChecker(Checker):
             self._log_capacity = min(self._log_capacity * 2, log_cap_bound)
             return f"log_capacity={self._log_capacity}"
         if flag & 4:
+            from .hashset import unique_buffer_size
+
             if self._dedup_factor <= 1:
                 return None
             self._dedup_factor = max(1, self._dedup_factor // 4)
-            return f"dedup_factor={self._dedup_factor}"
+            grown = [f"dedup_factor={self._dedup_factor}"]
+            # Keep U inside the device-safe band (_MAX_UNIQUE_BUFFER):
+            # relaxing dd widens the buffer ×4, and past ~2^19 lanes the
+            # worker hard-crashes instead of flagging.
+            a = self._compiled.max_actions
+            while (
+                self._max_frontier > 2048
+                and unique_buffer_size(
+                    self._max_frontier * a, self._dedup_factor
+                ) > _MAX_UNIQUE_BUFFER
+            ):
+                self._max_frontier //= 2
+                grown.append(f"max_frontier={self._max_frontier}")
+            if (
+                unique_buffer_size(self._max_frontier * a, self._dedup_factor)
+                > _MAX_UNIQUE_BUFFER
+            ):
+                # Even the floor frontier cannot keep the buffer in the
+                # safe band (max_actions > 256): refuse loudly rather
+                # than proceed into the worker-crash band.
+                return None
+            return "; ".join(grown)
         return None
 
     def _check_once(self, deadline=None) -> None:
@@ -899,7 +975,9 @@ class TpuChecker(Checker):
         states (hashset.py's unique-buffer size), and appends are whole
         U-blocks whose tail garbage must land in bounds."""
         b = self._max_frontier * self._compiled.max_actions
-        u = max(min(b, 1 << 14), b // self._dedup_factor)
+        from .hashset import unique_buffer_size
+
+        u = unique_buffer_size(b, self._dedup_factor)
         return max(self._max_frontier, u)
 
     def _snapshot_key(self) -> str:

@@ -179,6 +179,7 @@ def test_ordered_step_differential_full_reachable():
     assert len(seen) == 620
 
 
+@pytest.mark.slow
 def test_spawn_tpu_abd_ordered_matches_host():
     """`linearizable-register check 2` on the ordered fabric, end to end
     on the device engine."""
@@ -299,6 +300,7 @@ def test_duplicate_inflight_send_step_differential_abd():
     _dup_send_differential(model, AbdCompiled(model), net0=3)
 
 
+@pytest.mark.slow
 def test_duplicate_inflight_send_step_differential_paxos():
     from stateright_tpu.models.paxos import PaxosModelCfg
     from stateright_tpu.models.paxos_compiled import PaxosCompiled

@@ -17,6 +17,7 @@ from stateright_tpu.models.lww_register import build_model  # noqa: E402
 from stateright_tpu.ops.fingerprint import fingerprint  # noqa: E402
 
 
+@pytest.mark.slow
 def test_step_differential_to_depth_3():
     """Successors (full rows), validity, flags, and the eventually-
     consistent predicate vs the host model over the 706 states within 3
@@ -65,6 +66,7 @@ def test_step_differential_to_depth_3():
     assert len(seen) == 706
 
 
+@pytest.mark.slow
 def test_spawn_tpu_lww_depth5_matches_host():
     """Depth-bounded engine parity (the reference checks this model only
     depth-bounded, examples/lww-register.rs:190-196)."""

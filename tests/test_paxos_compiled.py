@@ -65,6 +65,7 @@ def test_roundtrip_full_reachable_set_c1(reachable_c1):
         assert cm.decode(cm.encode(s)) == s
 
 
+@pytest.mark.slow
 def test_roundtrip_full_reachable_set_c2(reachable_c2):
     cm = PaxosCompiled(paxos_model(2))
     assert len(reachable_c2) == 16_668  # reference examples/paxos.rs:328

@@ -39,6 +39,7 @@ def raft_model():
     return RaftModelCfg(server_count=3).into_model()
 
 
+@pytest.mark.slow
 def test_step_differential_to_depth_4():
     """Successors, rows, flags, and properties vs host over the 1,390
     states within 4 actions of init (elections, votes, crash/recover, and
@@ -89,6 +90,7 @@ def test_step_differential_to_depth_4():
     assert len(seen) == 1390
 
 
+@pytest.mark.slow
 def test_spawn_tpu_raft_depth6_matches_host():
     """The host suite's determinism pin (4,933 states by depth 6) through
     the device engine, discovery sets included."""
@@ -151,6 +153,7 @@ def test_spawn_tpu_raft_depth9_device():
     tpu.assert_no_discovery("State Machine Safety")
 
 
+@pytest.mark.slow
 def test_spawn_tpu_simulation_raft():
     """Device Monte-carlo over the crash/recover model: random walks are
     depth-bounded like the reference's default check (deep walks would

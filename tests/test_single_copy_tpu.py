@@ -138,6 +138,7 @@ def test_two_servers_violation_found_on_device():
     assert path.last_state().history.serialized_history() is None
 
 
+@pytest.mark.slow
 def test_spawn_tpu_single_copy_c3_matches_host():
     """3 clients / 1 server — first config past the round-2 client cap."""
     model = sc_model(3, 1)

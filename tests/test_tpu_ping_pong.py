@@ -52,6 +52,7 @@ def test_ping_pong_lossy_duplicating_max1():
     assert "must exceed max" in d
 
 
+@pytest.mark.slow
 def test_ping_pong_lossy_duplicating_max5():
     _parity(5, True, 4094)  # src/actor/model.rs:1055
 

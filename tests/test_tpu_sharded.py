@@ -19,6 +19,7 @@ def _mesh(n):
     return jax.sharding.Mesh(np.array(devices[:n]), ("shards",))
 
 
+@pytest.mark.slow
 def test_twophase3_sharded_parity_8_devices():
     model = TwoPhaseSys(rm_count=3)
     host = model.checker().spawn_bfs().join()
@@ -48,6 +49,7 @@ def test_eventually_sharded_parity():
     assert sh.discoveries()["reaches limit"].last_state() == model.trap_state
 
 
+@pytest.mark.slow
 def test_sharded_levels_span_multiple_chunks():
     """2pc(5): 8,832 states whose peak level (~2,000 wide globally) spans
     several 64-state chunks per shard — full parity with the host oracle
@@ -65,6 +67,7 @@ def test_sharded_levels_span_multiple_chunks():
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
 
 
+@pytest.mark.slow
 def test_sharded_extreme_skew_tiny_model():
     """11 states spread over 8 shards: most shards run empty chunks most
     levels (hash-random ownership skew at its worst); counts and
@@ -95,6 +98,7 @@ def test_sharded_extreme_skew_tiny_model():
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
 
 
+@pytest.mark.slow
 def test_sharded_paxos_golden():
     """The flagship model through the multi-chip engine: paxos check 2 on
     an 8-device mesh reproduces the reference golden 16,668

@@ -154,6 +154,7 @@ def test_twophase5_golden_tpu():
     assert tpu.unique_state_count() == 8832
 
 
+@pytest.mark.slow
 def test_levels_wider_than_chunk_match_host():
     """A BFS level far wider than max_frontier is processed in chunks from
     the slot queue instead of failing; counts, depth, and discoveries still
@@ -346,6 +347,7 @@ def test_checkpoint_resume_matches_straight_run(tmp_path):
         ).join()
 
 
+@pytest.mark.slow
 def test_auto_tune_grows_overfull_table():
     """A capacity far below the state count completes anyway: the engine
     restarts with a grown table instead of failing into a hand-tuning
@@ -361,6 +363,7 @@ def test_auto_tune_grows_overfull_table():
         ).join()
 
 
+@pytest.mark.slow
 def test_auto_tune_grows_full_row_log():
     """log_capacity sizes the row log independently of the table; an
     undersized log grows on retry, and without auto_tune fails loudly
@@ -419,3 +422,83 @@ def test_twophase10_depth_bounded_differential():
     assert host.state_count() == tpu.state_count()
     assert tpu.max_depth() == host.max_depth() == 7
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def test_auto_tune_dedup_growth_clamps_frontier():
+    """Relaxing dedup_factor must keep the compact/dedup buffer inside the
+    device-safe band by halving max_frontier: a 1.7M-lane buffer (2pc
+    rm=10 at f=2^15, dd=1) crashes the TPU worker outright, while both
+    neighboring 426K-lane configs run to graceful overflow flags
+    (isolation matrix, 2026-07-31)."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.parallel.hashset import unique_buffer_size
+    from stateright_tpu.parallel.wavefront import (
+        _MAX_UNIQUE_BUFFER, TpuChecker,
+    )
+
+    ck = TpuChecker.__new__(TpuChecker)  # knob logic only; no run thread
+    ck._compiled = TwoPhaseSys(rm_count=10).compiled()
+    ck._capacity = 1 << 20
+    ck._log_capacity = 1 << 20
+    ck._log_capacity_explicit = False
+    ck._dedup_factor = 4
+    ck._max_frontier = 1 << 15
+    msg = ck._grow(4)
+    assert ck._dedup_factor == 1
+    assert "max_frontier" in msg
+    assert (
+        unique_buffer_size(
+            ck._max_frontier * ck._compiled.max_actions, ck._dedup_factor
+        )
+        <= _MAX_UNIQUE_BUFFER
+    )
+    # A small model's buffer already fits: no frontier change.
+    ck._compiled = TwoPhaseSys(rm_count=3).compiled()
+    ck._dedup_factor = 4
+    ck._max_frontier = 1 << 13
+    msg = ck._grow(4)
+    assert ck._dedup_factor == 1
+    assert "max_frontier" not in msg
+
+
+def test_grow_refuses_when_floor_frontier_still_over_budget():
+    """max_actions > 256 cannot fit the safe band even at the floor
+    frontier: _grow must refuse (None -> loud RuntimeError upstream), not
+    proceed into the worker-crash band."""
+    from stateright_tpu.parallel.wavefront import TpuChecker
+
+    class WideCM:
+        max_actions = 512
+        state_width = 2
+
+    ck = TpuChecker.__new__(TpuChecker)
+    ck._compiled = WideCM()
+    ck._capacity = 1 << 20
+    ck._log_capacity = 1 << 20
+    ck._log_capacity_explicit = False
+    ck._dedup_factor = 4
+    ck._max_frontier = 1 << 15
+    assert ck._grow(4) is None
+
+
+def test_spawn_clamps_crash_band_geometry():
+    """A requested (max_frontier, dedup_factor) in the worker-crash band
+    is clamped at spawn, not run as-is."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.parallel.hashset import unique_buffer_size
+    from stateright_tpu.parallel.wavefront import _MAX_UNIQUE_BUFFER
+
+    ck = (
+        TwoPhaseSys(rm_count=10)
+        .checker()
+        .target_max_depth(1)
+        .spawn_tpu(max_frontier=1 << 15, dedup_factor=1)
+    )
+    ck.join()
+    assert ck._max_frontier < (1 << 15)
+    assert (
+        unique_buffer_size(
+            ck._max_frontier * ck._compiled.max_actions, 1
+        )
+        <= _MAX_UNIQUE_BUFFER
+    )
