@@ -36,6 +36,25 @@ from .compiled import CompiledModel, compiled_model_for
 
 NO_GID = 0xFFFFFFFF
 
+# One u32 stats vector per shard carries every host-visible scalar (the
+# single-chip engine's STAT_* pattern, wavefront.py): tunneled readbacks
+# cost ~100-170ms EACH, so per-call scalars travel in one transfer.
+(
+    S_LEVEL_START,
+    S_LEVEL_END,
+    S_TAIL,
+    S_SC_LO,
+    S_SC_HI,
+    S_UNIQUE_G,
+    S_UNIQUE_L,
+    S_CAND_LO,
+    S_CAND_HI,
+    S_DEPTH,
+    S_FLAGS,
+    S_WAVES_LEFT,
+) = range(12)
+S_DISC = 12  # disc[P] rides at [S_DISC : S_DISC + n_props]
+
 # Compiled shard_map programs shared across checker instances, exactly like
 # the single-chip engine's cache (wavefront.py): without it every
 # spawn_tpu_sharded() pays tens of seconds of re-trace + re-lower +
@@ -122,6 +141,9 @@ class ShardedTpuChecker(Checker):
             )
         self._chunk = chunk_size
         self._dedup_factor = dedup_factor
+        from .wave_common import default_waves_per_call
+
+        self._waves_per_call = default_waves_per_call(options)
         self._properties = self._model.properties()
         self._ev_indices = [
             i
@@ -307,51 +329,62 @@ class ShardedTpuChecker(Checker):
             cand_hi = cand_hi + (new_cand_lo < cand_lo).astype(u)
             cand_lo = new_cand_lo
 
-            # Bucket the representatives by owner shard; exchange over ICI.
-            owner = _owner_mix(u_hi, u_lo) % u(n)
-            key = jnp.where(u_valid, owner, u(n))
-            order = jnp.argsort(key, stable=True)
-            key_s = key[order]
-            # Bucket sizes as n+1 dense reductions — NOT a scatter-add:
-            # every lane collides into one of n+1 cells, and TPU scatter
-            # serializes colliding updates (profiled at seconds per chunk).
-            counts = jnp.stack(
-                [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
-            )
-            offsets = jnp.concatenate(
-                [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
-            )
-            pos = jnp.arange(u_sz, dtype=u) - offsets[key_s]
-            dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
+            if n == 1:
+                # One-shard mesh: every key's owner is self, so the whole
+                # bucket/sort/all_to_all exchange is an identity — elide
+                # it at trace time and reuse the already-computed keys
+                # (this is most of the former 1-device overhead vs the
+                # single-chip engine).
+                rw, rg, reb, rv = rows_u, gid_u, eb_u, u_valid
+                rhi, rlo = u_hi, u_lo
+            else:
+                # Bucket the representatives by owner shard; exchange
+                # over ICI.
+                owner = _owner_mix(u_hi, u_lo) % u(n)
+                key = jnp.where(u_valid, owner, u(n))
+                order = jnp.argsort(key, stable=True)
+                key_s = key[order]
+                # Bucket sizes as n+1 dense reductions — NOT a
+                # scatter-add: every lane collides into one of n+1 cells,
+                # and TPU scatter serializes colliding updates (profiled
+                # at seconds per chunk).
+                counts = jnp.stack(
+                    [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
+                )
+                offsets = jnp.concatenate(
+                    [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
+                )
+                pos = jnp.arange(u_sz, dtype=u) - offsets[key_s]
+                dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
 
-            # Pack the row + its parent gid, ebits, and validity into one
-            # [n, U, W+3] buffer so a SINGLE all_to_all (one collective
-            # launch per chunk, not four) carries the whole exchange —
-            # the docstring's W+3 layout.
-            payload = jnp.concatenate(
-                [
-                    rows_u,
-                    gid_u[:, None],
-                    eb_u[:, None],
-                    u_valid.astype(u)[:, None],
-                ],
-                axis=1,
-            )
-            send = jnp.zeros((n, u_sz, w + 3), u)
-            send = send.at[dst, pos].set(payload[order], mode="drop")
-            recv = jax.lax.all_to_all(
-                send, "shards", split_axis=0, concat_axis=0, tiled=False
-            )
+                # Pack the row + its parent gid, ebits, and validity into
+                # one [n, U, W+3] buffer so a SINGLE all_to_all (one
+                # collective launch per chunk, not four) carries the whole
+                # exchange — the docstring's W+3 layout.
+                payload = jnp.concatenate(
+                    [
+                        rows_u,
+                        gid_u[:, None],
+                        eb_u[:, None],
+                        u_valid.astype(u)[:, None],
+                    ],
+                    axis=1,
+                )
+                send = jnp.zeros((n, u_sz, w + 3), u)
+                send = send.at[dst, pos].set(payload[order], mode="drop")
+                recv = jax.lax.all_to_all(
+                    send, "shards", split_axis=0, concat_axis=0, tiled=False
+                )
 
-            # Local insert — the owner's insert IS the global dedup; the
-            # compact form keeps the store/parent/queue scatters
-            # proportional to distinct received keys.
-            flatrecv = recv.reshape(n * u_sz, w + 3)
-            rw = flatrecv[:, :w]
-            rg = flatrecv[:, w]
-            reb = flatrecv[:, w + 1]
-            rv = flatrecv[:, w + 2] != u(0)
-            rhi, rlo = device_fp64(rw[:, :fpw])
+                # Local insert — the owner's insert IS the global dedup;
+                # the compact form keeps the store/parent/queue scatters
+                # proportional to distinct received keys.
+                flatrecv = recv.reshape(n * u_sz, w + 3)
+                rw = flatrecv[:, :w]
+                rg = flatrecv[:, w]
+                reb = flatrecv[:, w + 1]
+                rv = flatrecv[:, w + 2] != u(0)
+                rhi, rlo = device_fp64(rw[:, :fpw])
             # dedup_factor=1: the receive batch is already per-sender
             # deduped, so its distinct-key count can approach the full
             # batch (disjoint keys per shard) — a divided buffer here
@@ -425,11 +458,14 @@ class ShardedTpuChecker(Checker):
         def cond(carry):
             return carry[-1]
 
-        def run_shard(
-            key_hi, key_lo, store, parent, ebits, queue, level_start,
-            level_end, tail, sc_lo, sc_hi, unique_g, unique_l, cand_lo,
-            cand_hi, depth, disc, waves,
-        ):
+        waves_per_call = self._waves_per_call
+
+        def run_shard(key_hi, key_lo, store, parent, ebits, queue, stats):
+            # stats: one [S_DISC + P] u32 vector per shard — every
+            # host-visible scalar in ONE readback (wavefront's STAT_*
+            # pattern; a tunneled readback costs ~100-170ms EACH).  The
+            # waves budget is a program constant, so calls need no
+            # per-call upload either.
             carry = (
                 key_hi,
                 key_lo,
@@ -437,19 +473,19 @@ class ShardedTpuChecker(Checker):
                 parent,
                 ebits,
                 queue,
-                level_start[0],
-                level_end[0],
-                tail[0],
-                sc_lo[0],
-                sc_hi[0],
-                unique_g[0],
-                unique_l[0],
-                cand_lo[0],
-                cand_hi[0],
-                depth[0],
-                disc,
-                waves[0].astype(jnp.int32),
-                u(0),
+                stats[S_LEVEL_START],
+                stats[S_LEVEL_END],
+                stats[S_TAIL],
+                stats[S_SC_LO],
+                stats[S_SC_HI],
+                stats[S_UNIQUE_G],
+                stats[S_UNIQUE_L],
+                stats[S_CAND_LO],
+                stats[S_CAND_HI],
+                stats[S_DEPTH],
+                stats[S_DISC:],
+                jnp.int32(waves_per_call),
+                stats[S_FLAGS],
                 jnp.zeros((), jnp.bool_),
             )
             carry = carry[:-1] + (
@@ -459,6 +495,27 @@ class ShardedTpuChecker(Checker):
                 ),
             )
             out = jax.lax.while_loop(cond, body, carry)
+            stats_out = jnp.concatenate(
+                [
+                    jnp.stack(
+                        [
+                            out[6],
+                            out[7],
+                            out[8],
+                            out[9],
+                            out[10],
+                            out[11],
+                            out[12],
+                            out[13],
+                            out[14],
+                            out[15],
+                            out[18],
+                            out[17].astype(u),
+                        ]
+                    ),
+                    out[16],
+                ]
+            )
             return (
                 out[0],
                 out[1],
@@ -466,31 +523,19 @@ class ShardedTpuChecker(Checker):
                 out[3],
                 out[4],
                 out[5],
-                out[6][None],
-                out[7][None],
-                out[8][None],
-                out[9][None],
-                out[10][None],
-                out[11][None],
-                out[12][None],
-                out[13][None],
-                out[14][None],
-                out[15][None],
-                out[16],
-                out[17][None],
-                out[18][None],
+                stats_out,
             )
 
         shard = P("shards")
-        specs = (shard,) * 18
+        specs = (shard,) * 7
         run = jax.jit(
             jax.shard_map(
                 run_shard,
                 mesh=self._mesh,
                 in_specs=specs,
-                out_specs=(shard,) * 19,
+                out_specs=(shard,) * 7,
             ),
-            donate_argnums=(0, 1, 2, 3, 4, 5),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
         )
         return run
 
@@ -504,6 +549,7 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._waves_per_call,  # baked into run() as a constant
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
             tuple(p.expectation for p in self._properties),
             (
@@ -675,30 +721,23 @@ class ShardedTpuChecker(Checker):
         self._state_count = n_init
         self._unique_count = int(seed_counts_h.sum())
 
-        from .wave_common import default_waves_per_call
-
-        waves_per_call = default_waves_per_call(opts)
+        waves_per_call = self._waves_per_call
 
         run = self._programs()
 
-        def shard_scalars(values):
-            return jax.device_put(
-                jnp.asarray(np.asarray(values, np.uint32)), shard
-            )
-
-        level_start = shard_scalars(np.zeros(n))
-        level_end = shard_scalars(seed_counts_h)
-        tail = shard_scalars(seed_counts_h)
-        sc_lo = shard_scalars([n_init] * n)
-        sc_hi = shard_scalars(np.zeros(n))
-        unique_g = shard_scalars([self._unique_count] * n)
-        unique_l = shard_scalars(seed_counts_h)
-        cand_lo = shard_scalars(np.zeros(n))
-        cand_hi = shard_scalars(np.zeros(n))
-        depth = shard_scalars(np.zeros(n))
-        disc = jax.device_put(
-            jnp.full((n * len(props),), NO_GID, jnp.uint32), shard
-        )
+        # One stats vector per shard (S_* layout): every per-call scalar
+        # travels in ONE transfer each way — and after the first call the
+        # input stats is the donated output of the previous one, so the
+        # steady-state loop costs one dispatch + one readback.
+        k_stats = S_DISC + len(props)
+        stats_np = np.zeros((n, k_stats), np.uint32)
+        stats_np[:, S_LEVEL_END] = seed_counts_h
+        stats_np[:, S_TAIL] = seed_counts_h
+        stats_np[:, S_SC_LO] = n_init
+        stats_np[:, S_UNIQUE_G] = self._unique_count
+        stats_np[:, S_UNIQUE_L] = seed_counts_h
+        stats_np[:, S_DISC:] = NO_GID
+        stats = jax.device_put(jnp.asarray(stats_np.reshape(-1)), shard)
 
         waves_total = 0
         while True:
@@ -709,19 +748,7 @@ class ShardedTpuChecker(Checker):
                 parent,
                 ebits,
                 queue,
-                level_start,
-                level_end,
-                tail,
-                sc_lo,
-                sc_hi,
-                unique_g,
-                unique_l,
-                cand_lo,
-                cand_hi,
-                depth,
-                disc,
-                waves_left,
-                flags,
+                stats,
             ) = run(
                 key_hi,
                 key_lo,
@@ -729,33 +756,23 @@ class ShardedTpuChecker(Checker):
                 parent,
                 ebits,
                 queue,
-                level_start,
-                level_end,
-                tail,
-                sc_lo,
-                sc_hi,
-                unique_g,
-                unique_l,
-                cand_lo,
-                cand_hi,
-                depth,
-                disc,
-                shard_scalars([waves_per_call] * n),
+                stats,
             )
+            stats_h = np.asarray(stats).reshape(n, k_stats).astype(np.int64)
             waves_total += waves_per_call - int(
-                np.asarray(waves_left)[0].astype(np.int32)
+                stats_h[0, S_WAVES_LEFT].astype(np.int32)
             )
-            ls_h = np.asarray(level_start).astype(np.int64)
-            le_h = np.asarray(level_end).astype(np.int64)
-            remaining_h = int((le_h - ls_h).sum())
-            depth_h = int(np.asarray(depth)[0])
-            flags_h = int(np.asarray(flags)[0])
-            disc_h = np.asarray(disc).reshape(n, len(props))
+            remaining_h = int(
+                (stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START]).sum()
+            )
+            depth_h = int(stats_h[0, S_DEPTH])
+            flags_h = int(stats_h[0, S_FLAGS])
+            disc_h = stats_h[:, S_DISC:]
             with self._lock:
                 self._state_count = (
-                    int(np.asarray(sc_hi)[0]) << 32
-                ) | int(np.asarray(sc_lo)[0])
-                self._unique_count = int(np.asarray(unique_g)[0])
+                    int(stats_h[0, S_SC_HI]) << 32
+                ) | int(stats_h[0, S_SC_LO])
+                self._unique_count = int(stats_h[0, S_UNIQUE_G])
                 self._max_depth = depth_h + (1 if remaining_h else 0)
                 for d in range(n):
                     for p, prop in enumerate(props):
@@ -814,19 +831,26 @@ class ShardedTpuChecker(Checker):
         b = f * cm.max_actions
         u_sz = unique_buffer_size(b, self._dedup_factor)
         cand_h = (
-            np.asarray(cand_hi).astype(np.int64) << 32
-        ) | np.asarray(cand_lo).astype(np.int64)
-        uniq_h = np.asarray(unique_l).astype(np.int64)
+            stats_h[:, S_CAND_HI].astype(np.int64) << 32
+        ) | stats_h[:, S_CAND_LO].astype(np.int64)
+        uniq_h = stats_h[:, S_UNIQUE_L].astype(np.int64)
         self._accounting = {
             "shards": n,
             "waves": waves_total,
             "chunk_size": f,
             "exchange_lanes_per_shard": u_sz,
-            "all_to_all_bytes_per_wave_per_shard": int(
-                n * u_sz * (cm.state_width + 3) * 4
+            # On a 1-shard mesh the whole exchange is elided at trace
+            # time (owner is always self), so no bytes move at all.
+            "exchange_elided": n == 1,
+            "all_to_all_bytes_per_wave_per_shard": (
+                0 if n == 1
+                else int(n * u_sz * (cm.state_width + 3) * 4)
             ),
-            "all_to_all_bytes_total": int(
-                waves_total * n * n * u_sz * (cm.state_width + 3) * 4
+            "all_to_all_bytes_total": (
+                0 if n == 1
+                else int(
+                    waves_total * n * n * u_sz * (cm.state_width + 3) * 4
+                )
             ),
             "candidates_sent_per_shard": cand_h.tolist(),
             # Fraction of TRANSMITTED lanes carrying a real candidate:
@@ -834,9 +858,11 @@ class ShardedTpuChecker(Checker):
             # per destination), so the denominator is waves * n^2 * u_sz
             # across the mesh — occupancy * all_to_all_bytes_total =
             # useful bytes.
+            # 0.0 when elided: nothing is transmitted, so the identity
+            # occupancy × all_to_all_bytes_total = useful bytes holds.
             "exchange_occupancy": (
                 float(cand_h.sum() / (waves_total * n * n * u_sz))
-                if waves_total
+                if waves_total and n > 1
                 else 0.0
             ),
             "unique_per_shard": uniq_h.tolist(),

@@ -131,3 +131,30 @@ def test_sharded_paxos_golden():
     assert tpu.state_count() == host.state_count()
     assert tpu.max_depth() == host.max_depth()
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def test_one_shard_mesh_elides_exchange_and_matches_host():
+    """The 1-shard mesh traces the exchange-elided branch (no bucket/
+    sort/all_to_all) — it must still match the host oracle exactly and
+    say so in the accounting."""
+    import jax
+    import numpy as np
+
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices("cpu")[:1]), ("shards",))
+    model = TwoPhaseSys(rm_count=3)
+    host = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    c = (
+        model.checker()
+        .spawn_tpu_sharded(mesh=mesh, capacity=1 << 13, chunk_size=1 << 6)
+        .join()
+    )
+    assert c.unique_state_count() == host.unique_state_count() == 288
+    assert c.state_count() == host.state_count()
+    assert c.max_depth() == host.max_depth()
+    assert sorted(c.discoveries()) == sorted(host.discoveries())
+    acc = c.accounting()
+    assert acc["exchange_elided"] is True
+    assert acc["all_to_all_bytes_total"] == 0
+    assert acc["exchange_occupancy"] == 0.0
