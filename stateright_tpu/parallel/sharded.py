@@ -107,23 +107,27 @@ class ShardedTpuChecker(Checker):
         if self._slot_bits + max(self._n - 1, 1).bit_length() >= 32:
             raise ValueError("capacity too large for 32-bit global ids")
         # Same spawn-time crash-band guard as the single-chip engine
-        # (wavefront._MAX_UNIQUE_BUFFER): the per-shard compact/prededup
-        # buffer past ~2^19 lanes hard-crashes the TPU worker mid-wave,
-        # and this engine has no auto-tune retry to recover — clamp the
-        # chunk here, loudly.
+        # (wavefront.max_safe_unique_lanes): buffers past the validated
+        # band hard-crash the TPU worker mid-wave, and this engine has no
+        # auto-tune retry to recover — clamp the chunk here, loudly.
+        # The binding buffer is the POST-EXCHANGE insert over n*u_sz
+        # receive lanes (each shard receives one u_sz bucket from every
+        # peer), so the per-shard u_sz is bounded at cap/n; the payload
+        # rides w+3 words per lane, which the width-dependent cap uses.
         from .hashset import unique_buffer_size
-        from .wavefront import _MAX_UNIQUE_BUFFER
+        from .wavefront import max_safe_unique_lanes
 
         a = self._compiled.max_actions
+        u_cap = max_safe_unique_lanes(self._compiled.state_width + 3)
         clamped = False
         while (
             chunk_size > 2048
-            and unique_buffer_size(chunk_size * a, dedup_factor)
-            > _MAX_UNIQUE_BUFFER
+            and self._n * unique_buffer_size(chunk_size * a, dedup_factor)
+            > u_cap
         ):
             chunk_size //= 2
             clamped = True
-        if unique_buffer_size(chunk_size * a, dedup_factor) > _MAX_UNIQUE_BUFFER:
+        if self._n * unique_buffer_size(chunk_size * a, dedup_factor) > u_cap:
             raise ValueError(
                 f"chunk geometry (chunk_size={chunk_size}, max_actions="
                 f"{a}, dedup_factor={dedup_factor}) exceeds the device-"

@@ -73,16 +73,30 @@ STAT_DISC = 8  # disc[P] rides at [STAT_DISC : STAT_DISC + n_props]
 # overflow surfaces as the ordinary loud RuntimeError.
 _MAX_TABLE_CAPACITY = 1 << 28
 _ROW_LOG_BYTE_BUDGET = 8 << 30
-# Empirical device limit on the per-wave compact/dedup buffer width
-# U = unique_buffer_size(max_frontier * max_actions, dedup_factor): on the
-# v5e a 1.7M-lane buffer (2pc rm=10 at f=2^15, dd=1) reliably CRASHES the
-# TPU worker process mid-wave ("kernel fault", 2026-07-31 isolation: both
-# 426K-lane configs around it run to their graceful overflow flags), so
-# when auto-tune relaxes dedup_factor it also halves max_frontier until U
-# fits this band.  Halving the frontier alone cannot fix a dedup overflow
-# (valid density is scale-free), but dd=1 can never overflow, so dd=1
-# plus a clamped frontier always terminates the growth sequence.
-_MAX_UNIQUE_BUFFER = 1 << 19
+# Empirical device limits on the per-wave compact/dedup buffer
+# U = unique_buffer_size(max_frontier * max_actions, dedup_factor): the
+# v5e worker hard-CRASHES mid-wave ("kernel fault") instead of flagging
+# when the buffer is too big, and the band depends on the state width.
+# Validated safe / crash points (2026-07-31):
+#   w=2  (2pc rm=10):  426K lanes safe, 1.7M lanes crash
+#   w=42 (paxos c=3):  262K lanes safe (the headline's steady geometry)
+#   w=77 (paxos c=6):  65K lanes safe, 524K lanes crash
+# Two caps reproduce all five points: lanes <= the validated 426K AND
+# lane-words (U*w) <= the validated 11M.  When auto-tune relaxes
+# dedup_factor it halves max_frontier until U fits; halving the frontier
+# alone cannot fix a dedup overflow (valid density is scale-free), but
+# dd=1 can never overflow, so dd=1 plus a clamped frontier always
+# terminates the growth sequence.
+_MAX_UNIQUE_BUFFER = 425_984
+_MAX_UNIQUE_LANE_WORDS = 11_010_048
+
+
+def max_safe_unique_lanes(state_width: int) -> int:
+    """The device-safe cap on the compact/dedup buffer's lane count for
+    a model of this state width (see the validated points above)."""
+    return min(
+        _MAX_UNIQUE_BUFFER, _MAX_UNIQUE_LANE_WORDS // max(state_width, 1)
+    )
 
 
 class _OverflowRetry(Exception):
@@ -185,17 +199,18 @@ class TpuChecker(Checker):
         from .hashset import unique_buffer_size
 
         a = self._compiled.max_actions
+        u_cap = max_safe_unique_lanes(self._compiled.state_width)
         clamped = False
         while (
             self._max_frontier > 2048
             and unique_buffer_size(self._max_frontier * a, self._dedup_factor)
-            > _MAX_UNIQUE_BUFFER
+            > u_cap
         ):
             self._max_frontier //= 2
             clamped = True
         if (
             unique_buffer_size(self._max_frontier * a, self._dedup_factor)
-            > _MAX_UNIQUE_BUFFER
+            > u_cap
         ):
             # Over budget even at the floor frontier (max_actions > 256):
             # refuse loudly, like the _grow path — proceeding means a
@@ -671,10 +686,18 @@ class TpuChecker(Checker):
             # A DEFAULTED log tracks the table (unique states need both a
             # slot and a position — growing one without the other just
             # schedules the next overflow); an explicit one is the user's
-            # memory geometry and only grows on its own flag.
+            # memory geometry and only grows on its own flag.  The drag is
+            # ×2 like the log's own growth step, NOT straight to
+            # capacity/2: a row-log position costs 4·state_width bytes, so
+            # at w=77 a capacity/2 drag after the ×16 table jump would
+            # allocate gigabytes past what the run needs and risk HBM
+            # exhaustion in the copy-growth transient.
             if not self._log_capacity_explicit:
                 self._log_capacity = min(
-                    max(self._log_capacity, self._capacity // 2),
+                    max(
+                        self._log_capacity,
+                        min(self._capacity // 2, self._log_capacity * 2),
+                    ),
                     log_cap_bound,
                 )
             return f"capacity={self._capacity} log_capacity={self._log_capacity}"
@@ -696,17 +719,18 @@ class TpuChecker(Checker):
             # relaxing dd widens the buffer ×4, and past ~2^19 lanes the
             # worker hard-crashes instead of flagging.
             a = self._compiled.max_actions
+            u_cap = max_safe_unique_lanes(self._compiled.state_width)
             while (
                 self._max_frontier > 2048
                 and unique_buffer_size(
                     self._max_frontier * a, self._dedup_factor
-                ) > _MAX_UNIQUE_BUFFER
+                ) > u_cap
             ):
                 self._max_frontier //= 2
                 grown.append(f"max_frontier={self._max_frontier}")
             if (
                 unique_buffer_size(self._max_frontier * a, self._dedup_factor)
-                > _MAX_UNIQUE_BUFFER
+                > u_cap
             ):
                 # Even the floor frontier cannot keep the buffer in the
                 # safe band (max_actions > 256): refuse loudly rather
@@ -901,6 +925,17 @@ class TpuChecker(Checker):
                     grown = []
                     for bit in (1, 2, 4):
                         if flags_h & bit:
+                            if bit == 2 and self._log_capacity > qcap:
+                                # A simultaneous table growth (bit 1,
+                                # processed above) already dragged the
+                                # log past the tripped size — the flag
+                                # is addressed; raising here would kill
+                                # a run whose log just grew.
+                                grown.append(
+                                    f"log_capacity={self._log_capacity}"
+                                    " (dragged)"
+                                )
+                                continue
                             g = self._grow(bit) if self._auto_tune else None
                             if g is None:
                                 raise RuntimeError(msgs[bit])

@@ -433,7 +433,7 @@ def test_auto_tune_dedup_growth_clamps_frontier():
     from stateright_tpu.models.twophase import TwoPhaseSys
     from stateright_tpu.parallel.hashset import unique_buffer_size
     from stateright_tpu.parallel.wavefront import (
-        _MAX_UNIQUE_BUFFER, TpuChecker,
+        TpuChecker, max_safe_unique_lanes,
     )
 
     ck = TpuChecker.__new__(TpuChecker)  # knob logic only; no run thread
@@ -450,7 +450,7 @@ def test_auto_tune_dedup_growth_clamps_frontier():
         unique_buffer_size(
             ck._max_frontier * ck._compiled.max_actions, ck._dedup_factor
         )
-        <= _MAX_UNIQUE_BUFFER
+        <= max_safe_unique_lanes(ck._compiled.state_width)
     )
     # A small model's buffer already fits: no frontier change.
     ck._compiled = TwoPhaseSys(rm_count=3).compiled()
@@ -486,7 +486,7 @@ def test_spawn_clamps_crash_band_geometry():
     is clamped at spawn, not run as-is."""
     from stateright_tpu.models.twophase import TwoPhaseSys
     from stateright_tpu.parallel.hashset import unique_buffer_size
-    from stateright_tpu.parallel.wavefront import _MAX_UNIQUE_BUFFER
+    from stateright_tpu.parallel.wavefront import max_safe_unique_lanes
 
     ck = (
         TwoPhaseSys(rm_count=10)
@@ -500,5 +500,26 @@ def test_spawn_clamps_crash_band_geometry():
         unique_buffer_size(
             ck._max_frontier * ck._compiled.max_actions, 1
         )
-        <= _MAX_UNIQUE_BUFFER
+        <= max_safe_unique_lanes(ck._compiled.state_width)
     )
+
+
+def test_table_growth_drags_log_x2_not_to_half_capacity():
+    """The defaulted row log follows a table growth by ×2 (its own growth
+    step), NOT straight to capacity/2: at 4·state_width bytes a position,
+    a capacity/2 drag after the ×16 table jump can allocate gigabytes
+    past what the run needs (w=77: observed as an HBM-pressure risk on
+    `paxos check 6`)."""
+    import bench
+    from stateright_tpu.parallel.wavefront import TpuChecker
+
+    ck = TpuChecker.__new__(TpuChecker)
+    ck._compiled = bench.paxos_model(6).compiled()
+    ck._capacity = 1 << 24
+    ck._log_capacity = 1 << 23
+    ck._log_capacity_explicit = False
+    ck._dedup_factor = 4
+    ck._max_frontier = 8192
+    msg = ck._grow(1)
+    assert ck._capacity == 1 << 28
+    assert ck._log_capacity == 1 << 24, msg  # ×2 drag
