@@ -74,6 +74,17 @@ def _owner_mix(hi, lo):
     return _fmix32(lo ^ _rotl(hi, 7) ^ jnp.uint32(0xA511E9B3))
 
 
+def _owner_mix_host(hi: int, lo: int) -> int:
+    """Bit-identical host evaluation of :func:`_owner_mix` (one int at a
+    time), so seeding needs no device round trip to place init states —
+    pinned against the device mix by
+    tests/test_tpu_sharded.py::test_owner_mix_host_matches_device."""
+    from ..ops.fingerprint import _fmix32
+
+    M = 0xFFFFFFFF
+    return _fmix32((lo ^ (((hi << 7) | (hi >> 25)) & M) ^ 0xA511E9B3) & M)
+
+
 class ShardedTpuChecker(Checker):
     """Wavefront checker running one program per mesh device via shard_map."""
 
@@ -571,20 +582,31 @@ class ShardedTpuChecker(Checker):
 
     def _seed_program(self, seed_w: int):
         """Init-state seeding program, cached like the run program (the
-        trace + lower alone costs seconds per checker otherwise)."""
+        trace + lower alone costs seconds per checker otherwise).
+
+        Mints EVERY device buffer internally (table planes, store, parent,
+        ebits, queue) and emits the run loop's stats vector, so the whole
+        spawn costs one upload (the packed per-shard init rows) + one
+        dispatch — on a tunneled device each separate allocation dispatch
+        or readback is a ~150 ms round trip, which dominated the 1-device
+        overhead smoke.  A seed insert overflow surfaces as flag 16 in
+        the stats vector; the run program's go-gate refuses to start on
+        nonzero flags, and the host loop raises the seeding error."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from ..ops.device_fp import device_fp64
-        from .hashset import HashSet, insert_batch
+        from .hashset import insert_batch
 
         cm = self._compiled
         cap_s = self._cap_s
         f = self._chunk
         qcap = cap_s
-        fpw = cm.fp_words or cm.state_width
+        w = cm.state_width
+        fpw = cm.fp_words or w
         eb0 = (1 << len(self._ev_indices)) - 1
+        n_props = len(self._properties)
         key = (
             "seed",
             cm.cache_key(),
@@ -592,35 +614,75 @@ class ShardedTpuChecker(Checker):
             f,
             seed_w,
             eb0,
+            n_props,
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
         )
 
-        def seed_shard(key_hi, key_lo, store, ebits, states, valid):
+        def seed_shard(packed):
+            from .hashset import HashSet
             from .wave_common import compact
 
-            sts = states[0]
-            val = valid[0]
+            u = jnp.uint32
+
+            def pv(x):
+                # Buffers minted INSIDE the shard_map body are typed
+                # shard-invariant; mark them varying so they can join
+                # while_loop carries with the (varying) seeded keys.
+                return jax.lax.pcast(x, "shards", to="varying")
+
+            sts = packed[0, :, :w]
+            val = packed[0, :, w] != u(0)
+            table = HashSet(
+                key_hi=pv(jnp.zeros((cap_s,), u)),
+                key_lo=pv(jnp.zeros((cap_s,), u)),
+            )
+            store = pv(jnp.zeros((cap_s, w), u))
+            parent = pv(jnp.full((cap_s,), u(NO_GID)))
+            ebits_buf = pv(jnp.zeros((cap_s,), u))
             hi, lo = device_fp64(sts[:, :fpw])
             table, slot, is_new, probe_ok, dd_overflow = insert_batch(
-                HashSet(key_hi, key_lo), hi, lo, val
+                table, hi, lo, val
             )
-            sslot = jnp.where(is_new, slot, jnp.uint32(cap_s))
+            sslot = jnp.where(is_new, slot, u(cap_s))
             store = store.at[sslot].set(sts, mode="drop")
-            ebits = ebits.at[sslot].set(jnp.uint32(eb0), mode="drop")
-            n_new = jnp.sum(is_new, dtype=jnp.uint32)
-            queue = jnp.zeros((qcap + f,), jnp.uint32)
+            ebits_buf = ebits_buf.at[sslot].set(u(eb0), mode="drop")
+            n_new = jnp.sum(is_new, dtype=u)
+            queue = pv(jnp.zeros((qcap + f,), u))
             queue = queue.at[: is_new.shape[0]].set(
                 compact(is_new, slot, is_new.shape[0])
             )
             ok = probe_ok & ~dd_overflow
+            sc = jax.lax.psum(jnp.sum(val, dtype=u), "shards")
+            unique_g = jax.lax.psum(n_new, "shards")
+            seed_fail = jax.lax.psum((~ok).astype(u), "shards")
+            zero = pv(jnp.zeros((), u))
+            stats = jnp.concatenate(
+                [
+                    jnp.stack([
+                        zero,  # level_start
+                        n_new,  # level_end
+                        n_new,  # tail
+                        sc,  # sc_lo
+                        zero,  # sc_hi
+                        unique_g,
+                        n_new,  # unique_l
+                        zero,  # cand_lo
+                        zero,  # cand_hi
+                        zero,  # depth
+                        jnp.where(seed_fail > u(0), u(16), zero),  # flags
+                        zero,  # waves_left
+                    ]),
+                    pv(jnp.full((n_props,), u(NO_GID))),
+                ]
+            )
             return (
                 table.key_hi,
                 table.key_lo,
                 store,
-                ebits,
+                parent,
+                ebits_buf,
                 queue,
-                n_new[None],
-                ok[None],
+                stats,
             )
 
         def build():
@@ -629,10 +691,9 @@ class ShardedTpuChecker(Checker):
                 jax.shard_map(
                     seed_shard,
                     mesh=self._mesh,
-                    in_specs=(sp, sp, sp, sp, sp, sp),
-                    out_specs=(sp, sp, sp, sp, sp, sp, sp),
-                ),
-                donate_argnums=(0, 1, 2, 3),
+                    in_specs=(sp,),
+                    out_specs=(sp,) * 7,
+                )
             )
 
         from .wave_common import cached_program
@@ -674,75 +735,47 @@ class ShardedTpuChecker(Checker):
 
         shard = NamedSharding(self._mesh, P("shards"))
 
-        def sharded_zeros(shape, dtype, fill=0):
-            arr = jnp.full(shape, fill, dtype)
-            return jax.device_put(arr, shard)
+        # Seed init states host-side: fingerprints and owners computed on
+        # the HOST (bit-identical by the pinned host/device fp parity), so
+        # the whole spawn is one upload + one seed dispatch — the seed
+        # program mints every device buffer and the run loop's stats
+        # vector itself.
+        from ..ops.fingerprint import fp64_words
 
-        key_hi = sharded_zeros((n * cap_s,), jnp.uint32)
-        key_lo = sharded_zeros((n * cap_s,), jnp.uint32)
-        store = sharded_zeros((n * cap_s, cm.state_width), jnp.uint32)
-        parent = sharded_zeros((n * cap_s,), jnp.uint32, NO_GID)
-        ebits = sharded_zeros((n * cap_s,), jnp.uint32)
-
-        # Seed init states host-side: compute owners with the same mix and
-        # place each init state in its owner's slice of a seeding program.
         init = cm.init_packed()
         n_init = init.shape[0]
         fpw = cm.fp_words or cm.state_width
-        ih, il = (
-            np.asarray(x) for x in device_fp64(jnp.asarray(init[:, :fpw]))
+        fps = [fp64_words(row[:fpw].tolist()) for row in init]
+        owner = np.array(
+            [
+                _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF) % n
+                for fp in fps
+            ],
+            np.uint32,
         )
-        owner = np.asarray(
-            _owner_mix(jnp.asarray(ih), jnp.asarray(il))
-        ) % np.uint32(n)
-        eb0 = (1 << len(self._ev_indices)) - 1
 
-        # Per-shard seed batches, padded to a common width.
+        # Per-shard seed batches, padded to a common width; validity rides
+        # as one extra word column so the upload is a single array.
         seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
-        seed_states = np.zeros((n, seed_w, cm.state_width), np.uint32)
-        seed_valid = np.zeros((n, seed_w), bool)
+        packed_np = np.zeros((n, seed_w, cm.state_width + 1), np.uint32)
         for d in range(n):
             idx = np.flatnonzero(owner == d)
-            seed_states[d, : len(idx)] = init[idx]
-            seed_valid[d, : len(idx)] = True
+            packed_np[d, : len(idx), : cm.state_width] = init[idx]
+            packed_np[d, : len(idx), cm.state_width] = 1
 
         seed = self._seed_program(int(seed_w))
-        key_hi, key_lo, store, ebits, queue, seed_counts, seed_ok = seed(
-            key_hi,
-            key_lo,
-            store,
-            ebits,
-            jax.device_put(jnp.asarray(seed_states), shard),
-            jax.device_put(jnp.asarray(seed_valid), shard),
+        key_hi, key_lo, store, parent, ebits, queue, stats = seed(
+            jax.device_put(jnp.asarray(packed_np), shard)
         )
-        if not np.asarray(seed_ok).all():
-            raise RuntimeError(
-                "init-state seeding overflowed the insert buffers; raise "
-                "capacity or lower dedup_factor"
-            )
-        seed_counts_h = np.asarray(seed_counts).reshape(n).astype(np.uint32)
 
         self._state_count = n_init
-        self._unique_count = int(seed_counts_h.sum())
+        self._unique_count = len(set(fps))
 
         waves_per_call = self._waves_per_call
 
         run = self._programs()
 
-        # One stats vector per shard (S_* layout): every per-call scalar
-        # travels in ONE transfer each way — and after the first call the
-        # input stats is the donated output of the previous one, so the
-        # steady-state loop costs one dispatch + one readback.
         k_stats = S_DISC + len(props)
-        stats_np = np.zeros((n, k_stats), np.uint32)
-        stats_np[:, S_LEVEL_END] = seed_counts_h
-        stats_np[:, S_TAIL] = seed_counts_h
-        stats_np[:, S_SC_LO] = n_init
-        stats_np[:, S_UNIQUE_G] = self._unique_count
-        stats_np[:, S_UNIQUE_L] = seed_counts_h
-        stats_np[:, S_DISC:] = NO_GID
-        stats = jax.device_put(jnp.asarray(stats_np.reshape(-1)), shard)
-
         waves_total = 0
         while True:
             (
@@ -783,6 +816,11 @@ class ShardedTpuChecker(Checker):
                         g = int(disc_h[d, p])
                         if g != NO_GID:
                             self._discovery_gids.setdefault(prop.name, g)
+            if flags_h & 16:
+                raise RuntimeError(
+                    "init-state seeding overflowed the insert buffers; "
+                    "raise capacity or lower dedup_factor"
+                )
             if flags_h & 1:
                 raise RuntimeError(
                     f"sharded fingerprint table overfull (per-shard "

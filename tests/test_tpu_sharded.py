@@ -158,3 +158,24 @@ def test_one_shard_mesh_elides_exchange_and_matches_host():
     assert acc["exchange_elided"] is True
     assert acc["all_to_all_bytes_total"] == 0
     assert acc["exchange_occupancy"] == 0.0
+
+
+def test_owner_mix_host_matches_device():
+    """Seeding routes init states by the HOST owner mix while the run
+    loop's exchange routes by the DEVICE mix — a divergence would seed
+    states into the wrong shard's table and silently duplicate
+    exploration, so the two are pinned bit-identical here."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stateright_tpu.parallel.sharded import _owner_mix, _owner_mix_host
+
+    rng = np.random.default_rng(11)
+    hi = rng.integers(0, 2**32, size=4096, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, size=4096, dtype=np.uint32)
+    dev = np.asarray(_owner_mix(jnp.asarray(hi), jnp.asarray(lo)))
+    host = np.array(
+        [_owner_mix_host(int(h), int(l)) for h, l in zip(hi, lo)],
+        np.uint32,
+    )
+    assert np.array_equal(dev, host)
