@@ -716,9 +716,6 @@ class ShardedTpuChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        from ..ops.device_fp import device_fp64
-        from .hashset import insert_batch
-
         opts = self._options
         cm = self._compiled
         props = self._properties
