@@ -719,11 +719,19 @@ class TpuChecker(Checker):
 
             if self._dedup_factor <= 1:
                 return None
-            self._dedup_factor = max(1, self._dedup_factor // 4)
+            # Straight to the always-safe 1, not stepwise: the intermediate
+            # stop (dd=2 at a doubled frontier) measured as a NEW
+            # worker-crash geometry on the 61.5M-state 2pc run (f=2^14/
+            # dd=2 crashed twice where f=2^13/dd=1 — same U lanes —
+            # completes; the common thread across all observed crashes is
+            # per-call device time: waves_per_call x per-wave cost beyond
+            # ~80s kills the tunneled worker, and halving the frontier
+            # below keeps the validated-safe call cadence).
+            self._dedup_factor = 1
             grown = [f"dedup_factor={self._dedup_factor}"]
-            # Keep U inside the device-safe band (_MAX_UNIQUE_BUFFER):
-            # relaxing dd widens the buffer ×4, and past ~2^19 lanes the
-            # worker hard-crashes instead of flagging.
+            # Keep U inside the device-safe band: relaxing dd to 1
+            # widens the buffer up to ×dd (the whole batch), and past the
+            # validated band the worker hard-crashes instead of flagging.
             a = self._compiled.max_actions
             u_cap = max_safe_unique_lanes(self._compiled.state_width)
             while (
