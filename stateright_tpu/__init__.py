@@ -17,7 +17,13 @@ from .core.checker import Checker, CheckerBuilder
 from .core.path import Path, NondeterminismError
 from .core.has_discoveries import HasDiscoveries
 from .core.visitor import CheckerVisitor, PathRecorder, StateRecorder
-from .core.report import ReportData, ReportDiscovery, Reporter, WriteReporter
+from .core.report import (
+    JournalReporter,
+    ReportData,
+    ReportDiscovery,
+    Reporter,
+    WriteReporter,
+)
 from .ops.fingerprint import fingerprint
 
 __all__ = [
@@ -32,6 +38,7 @@ __all__ = [
     "CheckerVisitor",
     "PathRecorder",
     "StateRecorder",
+    "JournalReporter",
     "ReportData",
     "ReportDiscovery",
     "Reporter",

@@ -38,7 +38,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
         lines.append(f"  check-sym [{n_meta}]{net}")
     lines.append(f"  check-simulation [{n_meta}] [SEED]{net}")
     if spec.tpu:
-        lines.append(f"  check-tpu [{n_meta}]{net}")
+        lines.append(f"  check-tpu [{n_meta}]{net}"
+                     " [--supervise] [--checkpoint-dir DIR] [--resume]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     if spec.spawn is not None:
         lines.append("  spawn")
@@ -87,6 +88,42 @@ def _parse_n(args, default):
     return default
 
 
+def _extract_runtime_flags(args):
+    """Pull the supervised-run flags out of the positional stream (they
+    may appear anywhere after the subcommand).  Returns
+    ``(positional_args, supervise, checkpoint_dir, resume)`` or raises
+    ``ValueError`` on a malformed flag."""
+    supervise = False
+    resume = False
+    ckpt_dir = None
+    out = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--supervise":
+            supervise = True
+        elif a == "--resume":
+            resume = True
+        elif a == "--checkpoint-dir":
+            i += 1
+            if i >= len(args):
+                raise ValueError("--checkpoint-dir requires a directory")
+            ckpt_dir = args[i]
+        elif a.startswith("--checkpoint-dir="):
+            ckpt_dir = a.split("=", 1)[1]
+            if not ckpt_dir:
+                # An empty value (e.g. --checkpoint-dir=$DIR with DIR
+                # unset) would resolve to the CWD, where a non-resume
+                # supervised run DELETES run-artifact-named files.
+                raise ValueError(
+                    "--checkpoint-dir requires a non-empty directory"
+                )
+        else:
+            out.append(a)
+        i += 1
+    return out, supervise, ckpt_dir, resume
+
+
 def _parse_network(args, spec):
     """Consume the NETWORK positional (front of the remaining args).  An
     unknown name is an error, like the reference's FromStr parse
@@ -111,6 +148,90 @@ def _build(spec, n, network):
     return spec.build(n, network)
 
 
+def _checkpointed_tpu_kwargs(ckpt_dir: str, resume: bool) -> dict:
+    """Engine kwargs pointing the journal/checkpoint hooks into a run
+    directory (the supervised child's layout, also usable stand-alone):
+    journal.jsonl telemetry, checkpoint.npz snapshots, a relax.json
+    geometry override left by the supervisor's backoff, and resume from
+    the latest checkpoint when asked."""
+    from .runtime.supervisor import (
+        CHECKPOINT_FILE, JOURNAL_FILE, RELAX_FILE, load_json_or_default,
+    )
+
+    run_dir = os.path.abspath(ckpt_dir)
+    os.makedirs(run_dir, exist_ok=True)
+    # A torn relax.json degrades to no overrides, never a crash.
+    kwargs: dict = dict(
+        load_json_or_default(os.path.join(run_dir, RELAX_FILE), {})
+    )
+    ckpt = os.path.join(run_dir, CHECKPOINT_FILE)
+    kwargs["journal"] = os.path.join(run_dir, JOURNAL_FILE)
+    kwargs["checkpoint_path"] = ckpt
+    if resume and os.path.exists(ckpt):
+        kwargs["resume_from"] = ckpt
+    return kwargs
+
+
+def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
+                    resume: bool) -> int:
+    """Parent mode for ``check-tpu --supervise``: re-invoke this model
+    module's own CLI as the supervised child (with ``--checkpoint-dir``/
+    ``--resume``), watch its journal for death and hangs, and restart it
+    from the latest checkpoint until the check completes."""
+    from .runtime.supervisor import (
+        RunSupervisor, SupervisorConfig, SupervisorError,
+    )
+
+    run_dir = os.path.abspath(ckpt_dir)
+    # The model module's runnable name: the build callable's __module__,
+    # EXCEPT when this process was started as `python -m <module>` — then
+    # the lambda lives in __main__ and the real dotted name is on
+    # __main__.__spec__ (set by runpy).
+    module = spec.build.__module__
+    if module == "__main__":
+        main_spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+        if main_spec is not None and main_spec.name:
+            module = main_spec.name
+    if module == "__main__":
+        print(
+            "--supervise requires running the model module via "
+            "`python -m stateright_tpu.models.<name>` (the supervisor "
+            "re-invokes that module as the child)",
+            file=sys.stderr,
+        )
+        return 2
+    child = [sys.executable, "-m", module, "check-tpu", str(n)]
+    if network is not None:
+        child.append(network.kind)
+    child += ["--checkpoint-dir", run_dir, "--resume"]
+    sup = RunSupervisor(
+        SupervisorConfig(
+            run_dir=run_dir,
+            resume=resume,
+            inherit_output=True,
+            call_deadline_sec=600.0,
+        ),
+        child_argv=child,
+        # Seed the geometry backoff with the child's ACTUAL engine knobs:
+        # the policy only relaxes knobs it can see, so without these the
+        # frontier/waves steps could never fire in CLI mode.
+        engine_kwargs=dict(spec.tpu_kwargs),
+    )
+    try:
+        result = sup.run()
+    except SupervisorError as e:
+        print(e, file=sys.stderr)
+        return 1
+    if not result.get("completed", True):
+        print(
+            "supervised run hit its wall deadline; partial progress is "
+            f"checkpointed in {run_dir}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def example_main(spec: CliSpec, argv=None) -> int:
     from .core.report import WriteReporter
 
@@ -119,6 +240,26 @@ def example_main(spec: CliSpec, argv=None) -> int:
         print(_usage(spec.name, spec))
         return 0
     sub = args.pop(0)
+    try:
+        args, supervise, ckpt_dir, resume = _extract_runtime_flags(args)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if (supervise or ckpt_dir or resume) and sub != "check-tpu":
+        print(
+            "--supervise/--checkpoint-dir/--resume require the check-tpu "
+            "subcommand (the host engines have no snapshot support)",
+            file=sys.stderr,
+        )
+        return 2
+    if supervise and ckpt_dir is None:
+        print("--supervise requires --checkpoint-dir DIR", file=sys.stderr)
+        return 2
+    if resume and ckpt_dir is None:
+        # Silently starting from scratch would discard exactly the
+        # progress the flag was meant to continue.
+        print("--resume requires --checkpoint-dir DIR", file=sys.stderr)
+        return 2
     threads = os.cpu_count() or 1
 
     if sub in ("check", "check-bfs", "check-dfs", "check-sym", "check-tpu"):
@@ -129,6 +270,12 @@ def example_main(spec: CliSpec, argv=None) -> int:
             print(e, file=sys.stderr)
             return 2
         _reject_leftovers(args, spec)
+        if supervise:
+            if not spec.tpu:
+                print(f"{spec.name} has no compiled TPU form",
+                      file=sys.stderr)
+                return 2
+            return _run_supervised(spec, n, network, ckpt_dir, resume)
         model = _build(spec, n, network)
         print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
               + (f", network={network.kind}" if network is not None else ""))
@@ -150,7 +297,10 @@ def example_main(spec: CliSpec, argv=None) -> int:
             if not spec.tpu:
                 print(f"{spec.name} has no compiled TPU form", file=sys.stderr)
                 return 2
-            checker = builder.spawn_tpu(**spec.tpu_kwargs)
+            tpu_kwargs = dict(spec.tpu_kwargs)
+            if ckpt_dir is not None:
+                tpu_kwargs.update(_checkpointed_tpu_kwargs(ckpt_dir, resume))
+            checker = builder.spawn_tpu(**tpu_kwargs)
         else:
             checker = builder.spawn_bfs()
         checker.join_and_report(WriteReporter(sys.stdout))

@@ -38,6 +38,43 @@ class Reporter:
         return 1.0
 
 
+class JournalReporter(Reporter):
+    """Reporter writing the checking progress stream into a telemetry
+    :class:`~stateright_tpu.runtime.journal.Journal` instead of a text
+    stream — the machine-readable sibling of :class:`WriteReporter`, so a
+    supervised run's artifact carries the same data the reference's text
+    protocol would print (``progress`` events while checking, one
+    ``done`` event, one ``discovery`` event per discovery)."""
+
+    def __init__(self, journal, delay: float = 1.0):
+        from ..runtime.journal import as_journal
+
+        self._journal = as_journal(journal)
+        self._delay = delay
+
+    def delay(self) -> float:
+        return self._delay
+
+    def report_checking(self, data: ReportData) -> None:
+        self._journal.append(
+            "done" if data.done else "progress",
+            states=data.total_states,
+            unique=data.unique_states,
+            depth=data.max_depth,
+            sec=round(data.duration, 3),
+        )
+
+    def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:
+        for name in sorted(discoveries):
+            d = discoveries[name]
+            self._journal.append(
+                "discovery",
+                name=name,
+                classification=d.classification,
+                fingerprint_path=d.path.encode(model),
+            )
+
+
 class WriteReporter(Reporter):
     def __init__(self, writer: TextIO, delay: float = 1.0):
         self._writer = writer

@@ -65,6 +65,36 @@ _PROGRAM_CACHE: dict = {}
 _PROGRAM_CACHE_MAX = 16
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map: ``jax.shard_map`` where it exists
+    (newer jax), else ``jax.experimental.shard_map`` (0.4.x) with
+    replication checking off — the 0.4.x checker predates the
+    varying-type system this engine's seed program is written against."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def _pcast_varying(x):
+    """Mark a shard-invariant value varying (``jax.lax.pcast``) on jax
+    versions with the varying-manual-axes type system; identity on 0.4.x,
+    which has no such typing."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, "shards", to="varying")
+    return x
+
+
 def _owner_mix(hi, lo):
     import jax.numpy as jnp
 
@@ -96,7 +126,19 @@ class ShardedTpuChecker(Checker):
         chunk_size: int = 1 << 11,
         dedup_factor: int = 4,
         compiled: Optional[CompiledModel] = None,
+        resume_from: Optional[str] = None,
+        journal=None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every_waves: Optional[int] = None,
+        checkpoint_every_sec: Optional[float] = None,
     ):
+        """Same checkpoint/journal hooks as the single-chip engine
+        (wavefront.py): ``journal`` streams wave-level telemetry as JSON
+        lines, ``checkpoint_path`` + a cadence knob write periodic
+        atomic mid-run snapshots, and ``resume_from`` continues a saved
+        run.  A sharded snapshot is bound to the MESH SIZE (global ids
+        encode the owner shard), but adopts the snapshot's per-shard
+        capacity and chunk geometry as data."""
         super().__init__(options.model)
         import jax
 
@@ -176,6 +218,20 @@ class ShardedTpuChecker(Checker):
         self._tables_dev: Optional[tuple] = None
         self._discoveries_cache: Optional[Dict[str, Path]] = None
         self._accounting: dict = {}
+        self._resume_from = resume_from
+        from ..runtime.journal import as_journal
+
+        self._journal = as_journal(journal)
+        self._checkpoint_path = checkpoint_path
+        self._ckpt_every_waves = checkpoint_every_waves
+        self._ckpt_every_sec = checkpoint_every_sec
+        if (
+            checkpoint_path is not None
+            and checkpoint_every_waves is None
+            and checkpoint_every_sec is None
+        ):
+            self._ckpt_every_sec = 30.0
+        self._carry_dev: Optional[dict] = None  # full run state at stop
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -544,7 +600,7 @@ class ShardedTpuChecker(Checker):
         shard = P("shards")
         specs = (shard,) * 7
         run = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 run_shard,
                 mesh=self._mesh,
                 in_specs=specs,
@@ -628,7 +684,8 @@ class ShardedTpuChecker(Checker):
                 # Buffers minted INSIDE the shard_map body are typed
                 # shard-invariant; mark them varying so they can join
                 # while_loop carries with the (varying) seeded keys.
-                return jax.lax.pcast(x, "shards", to="varying")
+                # (Identity on 0.4.x jax, which has no varying typing.)
+                return _pcast_varying(x)
 
             sts = packed[0, :, :w]
             val = packed[0, :, w] != u(0)
@@ -688,7 +745,7 @@ class ShardedTpuChecker(Checker):
         def build():
             sp = P("shards")
             return jax.jit(
-                jax.shard_map(
+                _shard_map(
                     seed_shard,
                     mesh=self._mesh,
                     in_specs=(sp,),
@@ -720,8 +777,6 @@ class ShardedTpuChecker(Checker):
         cm = self._compiled
         props = self._properties
         n = self._n
-        cap_s = self._cap_s
-        f = self._chunk
         deadline = (
             _time.monotonic() + opts._timeout if opts._timeout is not None else None
         )
@@ -731,50 +786,114 @@ class ShardedTpuChecker(Checker):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         shard = NamedSharding(self._mesh, P("shards"))
+        k_stats = S_DISC + len(props)
 
-        # Seed init states host-side: fingerprints and owners computed on
-        # the HOST (bit-identical by the pinned host/device fp parity), so
-        # the whole spawn is one upload + one seed dispatch — the seed
-        # program mints every device buffer and the run loop's stats
-        # vector itself.
-        from ..ops.fingerprint import fp64_words
+        if self._resume_from is not None:
+            # A resume ADOPTS the snapshot's per-shard geometry (cap_s
+            # shapes the slot mask and the gid encoding, chunk the queue
+            # backstop); only model identity + MESH SIZE are key-checked
+            # — gids embed the owner shard, so a snapshot cannot move to
+            # a different mesh size.
+            snap = np.load(self._resume_from, allow_pickle=False)
+            want_key = self._snapshot_key()
+            got_key = str(snap["engine_key"])
+            if got_key != want_key:
+                raise ValueError(
+                    "snapshot does not match this sharded checker "
+                    f"configuration (snapshot {got_key}, expected "
+                    f"{want_key})"
+                )
+            self._cap_s = int(snap["cap_s"])
+            self._slot_bits = self._cap_s.bit_length() - 1
+            self._chunk = int(snap["chunk"])
+            cap_s = self._cap_s
+            f = self._chunk
+            from .wavefront import _device_owned
 
-        init = cm.init_packed()
-        n_init = init.shape[0]
-        fpw = cm.fp_words or cm.state_width
-        fps = [fp64_words(row[:fpw].tolist()) for row in init]
-        owner = np.array(
-            [
-                _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF) % n
-                for fp in fps
-            ],
-            np.uint32,
-        )
+            def up(x):
+                # Sharded upload, forced into DEVICE-OWNED buffers: the
+                # run program donates every argument, and donating a
+                # borrowed host-upload buffer corrupts the run (see
+                # wavefront._device_owned).
+                return _device_owned(jax.device_put(jnp.asarray(x), shard))
 
-        # Per-shard seed batches, padded to a common width; validity rides
-        # as one extra word column so the upload is a single array.
-        seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
-        packed_np = np.zeros((n, seed_w, cm.state_width + 1), np.uint32)
-        for d in range(n):
-            idx = np.flatnonzero(owner == d)
-            packed_np[d, : len(idx), : cm.state_width] = init[idx]
-            packed_np[d, : len(idx), cm.state_width] = 1
+            key_hi = up(snap["key_hi"])
+            key_lo = up(snap["key_lo"])
+            store = up(snap["store"])
+            parent = up(snap["parent"])
+            ebits = up(snap["ebits"])
+            queue = up(snap["queue"])
+            stats_np = np.asarray(snap["stats"]).astype(np.uint32)
+            stats = up(stats_np.reshape(-1))
+            snap_h = stats_np.astype(np.int64).reshape(n, k_stats)
+            with self._lock:
+                self._state_count = (
+                    int(snap_h[0, S_SC_HI]) << 32
+                ) | int(snap_h[0, S_SC_LO])
+                self._unique_count = int(snap_h[0, S_UNIQUE_G])
+                self._max_depth = int(snap_h[0, S_DEPTH])
+                for d in range(n):
+                    for p, prop in enumerate(props):
+                        g = int(snap_h[d, S_DISC + p])
+                        if g != NO_GID:
+                            self._discovery_gids.setdefault(prop.name, g)
+            if self._journal:
+                self._journal.append(
+                    "resume",
+                    path=self._resume_from,
+                    unique=self._unique_count,
+                    states=self._state_count,
+                    depth=self._max_depth,
+                )
+        else:
+            cap_s = self._cap_s
+            f = self._chunk
+            # Seed init states host-side: fingerprints and owners computed
+            # on the HOST (bit-identical by the pinned host/device fp
+            # parity), so the whole spawn is one upload + one seed
+            # dispatch — the seed program mints every device buffer and
+            # the run loop's stats vector itself.
+            from ..ops.fingerprint import fp64_words
 
-        seed = self._seed_program(int(seed_w))
-        key_hi, key_lo, store, parent, ebits, queue, stats = seed(
-            jax.device_put(jnp.asarray(packed_np), shard)
-        )
+            init = cm.init_packed()
+            n_init = init.shape[0]
+            fpw = cm.fp_words or cm.state_width
+            fps = [fp64_words(row[:fpw].tolist()) for row in init]
+            owner = np.array(
+                [
+                    _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF)
+                    % n
+                    for fp in fps
+                ],
+                np.uint32,
+            )
 
-        self._state_count = n_init
-        self._unique_count = len(set(fps))
+            # Per-shard seed batches, padded to a common width; validity
+            # rides as one extra word column so the upload is one array.
+            seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
+            packed_np = np.zeros((n, seed_w, cm.state_width + 1), np.uint32)
+            for d in range(n):
+                idx = np.flatnonzero(owner == d)
+                packed_np[d, : len(idx), : cm.state_width] = init[idx]
+                packed_np[d, : len(idx), cm.state_width] = 1
+
+            seed = self._seed_program(int(seed_w))
+            key_hi, key_lo, store, parent, ebits, queue, stats = seed(
+                jax.device_put(jnp.asarray(packed_np), shard)
+            )
+
+            self._state_count = n_init
+            self._unique_count = len(set(fps))
 
         waves_per_call = self._waves_per_call
 
         run = self._programs()
 
-        k_stats = S_DISC + len(props)
         waves_total = 0
+        waves_since_ckpt = 0
+        last_ckpt_time = _time.monotonic()
         while True:
+            t_call = _time.monotonic()
             (
                 key_hi,
                 key_lo,
@@ -793,9 +912,12 @@ class ShardedTpuChecker(Checker):
                 stats,
             )
             stats_h = np.asarray(stats).reshape(n, k_stats).astype(np.int64)
-            waves_total += waves_per_call - int(
+            call_sec = _time.monotonic() - t_call
+            waves_this_call = waves_per_call - int(
                 stats_h[0, S_WAVES_LEFT].astype(np.int32)
             )
+            waves_total += waves_this_call
+            waves_since_ckpt += waves_this_call
             remaining_h = int(
                 (stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START]).sum()
             )
@@ -813,6 +935,59 @@ class ShardedTpuChecker(Checker):
                         g = int(disc_h[d, p])
                         if g != NO_GID:
                             self._discovery_gids.setdefault(prop.name, g)
+            if self._journal:
+                self._journal.append(
+                    "wave",
+                    waves=waves_total,
+                    remaining=remaining_h,
+                    unique=self._unique_count,
+                    states=self._state_count,
+                    depth=depth_h,
+                    flags=flags_h,
+                    call_sec=round(call_sec, 4),
+                    # Binding constraint: the FULLEST shard's table load.
+                    occupancy=round(
+                        float(stats_h[:, S_UNIQUE_L].max()) / cap_s, 6
+                    ),
+                )
+            if (
+                self._checkpoint_path is not None
+                and flags_h == 0
+                and (
+                    (
+                        self._ckpt_every_waves is not None
+                        and waves_since_ckpt >= self._ckpt_every_waves
+                    )
+                    or (
+                        self._ckpt_every_sec is not None
+                        and _time.monotonic() - last_ckpt_time
+                        >= self._ckpt_every_sec
+                    )
+                )
+            ):
+                t_ck = _time.monotonic()
+                self._write_snapshot(
+                    self._checkpoint_path,
+                    {
+                        "key_hi": key_hi,
+                        "key_lo": key_lo,
+                        "store": store,
+                        "parent": parent,
+                        "ebits": ebits,
+                        "queue": queue,
+                        "stats": stats_h.astype(np.uint32),
+                    },
+                )
+                waves_since_ckpt = 0
+                last_ckpt_time = _time.monotonic()
+                if self._journal:
+                    self._journal.append(
+                        "checkpoint",
+                        path=self._checkpoint_path,
+                        unique=self._unique_count,
+                        depth=depth_h,
+                        write_sec=round(last_ckpt_time - t_ck, 4),
+                    )
             if flags_h & 16:
                 raise RuntimeError(
                     "init-state seeding overflowed the insert buffers; "
@@ -915,6 +1090,88 @@ class ShardedTpuChecker(Checker):
         # and most runs never reconstruct a path (same policy as the
         # single-chip engine).
         self._tables_dev = (parent, store)
+        # Full run state for save_snapshot (the single-chip engine's
+        # snapshot-ready policy): bounded sharded runs can persist and
+        # resume exactly like single-chip ones.
+        self._carry_dev = {
+            "key_hi": key_hi,
+            "key_lo": key_lo,
+            "store": store,
+            "parent": parent,
+            "ebits": ebits,
+            "queue": queue,
+            "stats": stats_h.astype(np.uint32),
+        }
+        if self._checkpoint_path is not None:
+            # Final checkpoint at stop, like the single-chip engine: the
+            # run directory always ends with a resumable snapshot.
+            self._write_snapshot(self._checkpoint_path, self._carry_dev)
+            if self._journal:
+                self._journal.append(
+                    "checkpoint",
+                    path=self._checkpoint_path,
+                    unique=self._unique_count,
+                    depth=self._max_depth,
+                    final=True,
+                )
+        if self._journal:
+            self._journal.append(
+                "engine_done",
+                unique=self._unique_count,
+                states=self._state_count,
+                depth=self._max_depth,
+            )
+
+    def _snapshot_key(self) -> str:
+        """Process-stable compatibility key for sharded snapshots — the
+        single-chip engine's recipe (model identity via the packed init
+        digest, never ``repr``) plus the MESH SIZE, which global ids
+        encode and so cannot change across a resume.  Per-shard capacity
+        and chunk geometry travel as npz data and are adopted."""
+        import hashlib
+
+        cm = self._compiled
+        init_digest = hashlib.sha256(
+            cm.init_packed().tobytes()
+        ).hexdigest()[:16]
+        return repr(
+            (
+                "sharded-v1",
+                type(cm).__qualname__,
+                cm.state_width,
+                cm.max_actions,
+                tuple(p.name for p in self._properties),
+                init_digest,
+                self._n,
+            )
+        )
+
+    def _write_snapshot(self, path: str, carry: dict) -> None:
+        """Atomic (write + rename) persistence of the full sharded run
+        state, in ``save_snapshot`` format."""
+        import os
+
+        arrays = {k: np.asarray(v) for k, v in carry.items()}
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as fh:
+            np.savez_compressed(
+                fh,
+                engine_key=self._snapshot_key(),
+                cap_s=self._cap_s,
+                chunk=self._chunk,
+                **arrays,
+            )
+        os.replace(tmp, path)
+
+    def save_snapshot(self, path: str) -> None:
+        """Persist the full sharded checker state so a bounded run can be
+        resumed with ``spawn_tpu_sharded(resume_from=path)`` on a mesh of
+        the SAME SIZE (global ids encode the owner shard).  Same npz
+        recipe as the single-chip engine's snapshots."""
+        self.join()
+        if self._carry_dev is None:
+            raise RuntimeError("no run state to snapshot")
+        self._write_snapshot(path, self._carry_dev)
 
     # --- Checker surface -----------------------------------------------------
 

@@ -1,0 +1,46 @@
+"""Run-supervisor subsystem: crash-resilient checking with durable progress.
+
+Long exhaustive checks on tunneled accelerators die in ways the engine
+cannot recover from inside one process: the TPU worker hard-crashes on
+long per-device calls, the tunnel drops, the driver kills the whole
+process at a wall deadline.  This package makes any such run survivable
+and observable, the way swarm verification (Holzmann et al.) and TLC's
+checkpoint/restore made week-long exhaustive runs practical — restartable
+workers plus durable progress state (see PAPERS.md):
+
+- :mod:`journal` — an append-only JSON-lines telemetry stream (per-wave
+  progress, checkpoint/crash/resume events) written as a run artifact and
+  doubling as the supervisor's liveness signal;
+- :mod:`supervisor` — runs a checker in an isolated child process,
+  checkpoints via the engines' ``save_snapshot`` every N waves / T
+  seconds, detects child death and hangs, and auto-resumes from the last
+  checkpoint with an adaptive geometry backoff (straight to
+  ``dedup_factor=1``, never stepwise);
+- :mod:`child` — the child-process entry (``python -m
+  stateright_tpu.runtime.child RUN_DIR``).
+
+The schema and policies are documented in docs/RUNTIME.md.
+"""
+
+from .journal import Journal, read_journal
+from .supervisor import (
+    CheckSpec,
+    RunSupervisor,
+    SupervisorConfig,
+    SupervisorError,
+    TRANSIENT_MARKERS,
+    relax_geometry,
+    run_isolated,
+)
+
+__all__ = [
+    "Journal",
+    "read_journal",
+    "CheckSpec",
+    "RunSupervisor",
+    "SupervisorConfig",
+    "SupervisorError",
+    "TRANSIENT_MARKERS",
+    "relax_geometry",
+    "run_isolated",
+]

@@ -1,0 +1,95 @@
+"""Append-only JSON-lines telemetry journal for long checking runs.
+
+One event per line, written with a single ``write()`` each so concurrent
+writers (the engine thread, the child process wrapper, and the supervisor
+parent all append to the same file through O_APPEND handles) interleave at
+line granularity.  The journal is both a run artifact — per-wave frontier
+size, unique states, dedup occupancy, device-call wall time — and the
+supervisor's liveness signal: a child whose journal stops moving past the
+per-call deadline is declared hung and restarted from the last checkpoint.
+
+Event schema (full field lists in docs/RUNTIME.md): every event carries
+``t`` (unix wall time, float seconds) and ``event`` (a string tag).
+Engine events: ``resume``, ``wave``, ``checkpoint``, ``grow``,
+``engine_done``.  Child events: ``run_start``, ``run_end``,
+``child_error``.  Supervisor events: ``supervisor_start``, ``crash``,
+``hang``, ``relax``, ``restart``, ``wall_timeout``, ``give_up``,
+``supervisor_done``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class Journal:
+    """Appends events to a JSONL file; safe to share a path across
+    processes (each instance holds its own append-mode handle)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = None
+
+    def append(self, event: str, **fields) -> dict:
+        record = {"t": time.time(), "event": event}
+        record.update(fields)
+        line = json.dumps(record, sort_keys=True, default=str) + "\n"
+        if self._fh is None:
+            # O_APPEND semantics: every writer's line lands at the true
+            # end of file even when the supervisor and child interleave.
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(line)
+        self._fh.flush()
+        return record
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def as_journal(journal) -> Optional[Journal]:
+    """Engine-kwarg coercion: accept a :class:`Journal`, a path, or None."""
+    if journal is None or isinstance(journal, Journal):
+        return journal
+    return Journal(str(journal))
+
+
+def read_journal(path: str) -> List[Dict]:
+    """Parse a journal file into a list of event dicts.  Tolerates a
+    torn trailing line (a writer killed mid-``write``)."""
+    events: List[Dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail from a killed writer
+    except FileNotFoundError:
+        pass
+    return events
+
+
+def last_event(path: str, event: Optional[str] = None) -> Optional[Dict]:
+    """The most recent event (optionally of one type); None if absent."""
+    matched = None
+    for rec in read_journal(path):
+        if event is None or rec.get("event") == event:
+            matched = rec
+    return matched

@@ -176,6 +176,60 @@ def test_check_tpu_subcommand():
     assert "unique=288" in r.stdout
 
 
+def test_runtime_flags_require_check_tpu():
+    r = run_cli("twophase", "check", "3", "--supervise")
+    assert r.returncode == 2
+    assert "check-tpu" in r.stderr
+    r = run_cli("twophase", "check-sym", "3", "--checkpoint-dir", "/tmp/x")
+    assert r.returncode == 2
+
+
+def test_supervise_requires_checkpoint_dir():
+    r = run_cli("twophase", "check-tpu", "3", "--supervise")
+    assert r.returncode == 2
+    assert "--checkpoint-dir" in r.stderr
+
+
+def test_resume_requires_checkpoint_dir():
+    # Silently starting fresh would discard the progress the flag was
+    # meant to continue.
+    r = run_cli("twophase", "check-tpu", "3", "--resume")
+    assert r.returncode == 2
+    assert "--checkpoint-dir" in r.stderr
+
+
+def test_checkpoint_dir_flag_value_missing_is_clean_error():
+    r = run_cli("twophase", "check-tpu", "3", "--checkpoint-dir")
+    assert r.returncode == 2
+    assert "requires a directory" in r.stderr
+
+
+@pytest.mark.slow
+def test_check_tpu_supervised_writes_journal_and_checkpoint(tmp_path):
+    """`check-tpu --supervise --checkpoint-dir` completes the check
+    through the run supervisor and leaves the run artifacts: a JSONL
+    journal with wave telemetry and an engine_done event, plus a
+    checkpoint snapshot."""
+    run_dir = str(tmp_path / "run")
+    r = run_cli(
+        "twophase", "check-tpu", "3", "--supervise",
+        "--checkpoint-dir", run_dir, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "unique=288" in r.stdout  # the child's report streams through
+    events = [
+        json.loads(ln)
+        for ln in open(os.path.join(run_dir, "journal.jsonl"))
+        if ln.strip()
+    ]
+    kinds = [e["event"] for e in events]
+    assert "supervisor_start" in kinds
+    assert "wave" in kinds
+    assert "engine_done" in kinds
+    assert "supervisor_done" in kinds
+    assert os.path.exists(os.path.join(run_dir, "checkpoint.npz"))
+
+
 def test_wire_codec_malformed_messages_raise_valueerror():
     """A hand-typed probe datagram with wrong fields must surface as
     ValueError (which the UDP runtime drops) — never a TypeError that
