@@ -28,6 +28,13 @@ from .model import (
     SelectRandom,
 )
 from .spawn import ActorRuntime, json_deserialize, json_serialize, spawn
+from .transport import (
+    Endpoint,
+    LoopbackTransport,
+    Transport,
+    TransportClosed,
+    UdpTransport,
+)
 
 __all__ = [
     "Id", "Actor", "Out", "SendCmd", "SetTimerCmd", "CancelTimerCmd",
@@ -36,4 +43,6 @@ __all__ = [
     "ActorModel", "ActorModelState", "Deliver", "Drop", "Timeout", "Crash",
     "Recover", "SelectRandom",
     "ActorRuntime", "spawn", "json_serialize", "json_deserialize",
+    "Transport", "Endpoint", "TransportClosed", "UdpTransport",
+    "LoopbackTransport",
 ]

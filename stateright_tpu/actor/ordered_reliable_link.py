@@ -6,12 +6,24 @@ Reference: src/actor/ordered_reliable_link.rs — based loosely on the
 pair ordering.  Sequencer state persists through ``Storage`` so actors can
 restart without re-delivering or re-numbering (the wrapper model-checks
 clean under a lossy duplicating network; see tests/test_actor_runtime.py).
+
+Real-network hardening beyond the reference: the retransmit timer backs
+off exponentially (``backoff_factor``, capped at ``max_resend_interval``)
+instead of hammering a partitioned peer at a fixed interval, and an
+optional ``max_resends`` cap bounds how long undeliverable messages are
+retried — on expiry the pending messages are dropped and the
+``on_give_up`` callback fires (the chaos runtime journals it).  All of
+this lives *outside* the model-checked state: the backoff only changes
+timer durations (irrelevant when checking, src/actor/model.rs:79-81) and
+the cap defaults to off, so the checked transition system is bit-identical
+to the reference semantics — pinned by
+``tests/test_actor_runtime.py::test_orl_backoff_config_does_not_change_model``.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 from .base import (
     Actor,
@@ -73,13 +85,56 @@ class ActorWrapper(Actor):
     """Wraps an actor to (1) maintain message order, (2) resend lost
     messages, (3) avoid redelivery.  Reference:27-222."""
 
-    def __init__(self, wrapped_actor: Actor, resend_interval=(1.0, 2.0)):
+    def __init__(
+        self,
+        wrapped_actor: Actor,
+        resend_interval=(1.0, 2.0),
+        backoff_factor: float = 1.0,
+        max_resend_interval: float = 30.0,
+        max_resends: Optional[int] = None,
+        on_give_up: Optional[Callable[[Id, Tuple], None]] = None,
+    ):
         self.wrapped_actor = wrapped_actor
         self.resend_interval = tuple(resend_interval)
+        self.backoff_factor = float(backoff_factor)
+        self.max_resend_interval = float(max_resend_interval)
+        # Runtime-only knobs.  ``max_resends`` must stay ``None`` for a
+        # wrapper that is model checked: the give-up decision reads the
+        # mutable attempt counter below, which is shared across explored
+        # branches (the counter is otherwise harmless during checking —
+        # it only scales timer durations, which the model ignores).
+        self.max_resends = max_resends
+        self.on_give_up = on_give_up
+        # Runtime-only counters: the backoff ladder position (reset when
+        # everything pending is acked) and per-sequence-number resend
+        # counts, so giving up on one undeliverable message never drops a
+        # freshly-sent deliverable one to a different destination.
+        self._resend_attempts = 0
+        self._attempts_by_seq: dict = {}
 
     @staticmethod
     def with_default_timeout(wrapped_actor: Actor) -> "ActorWrapper":
         return ActorWrapper(wrapped_actor)
+
+    def _next_resend_interval(self) -> Tuple[float, float]:
+        """Current (lo, hi) retransmit delay: base interval scaled by
+        ``backoff_factor ** attempts``, capped at ``max_resend_interval``.
+
+        The exponent is clamped: the attempt counter grows without bound
+        on a long-partitioned peer (and during model checking), and a
+        naked ``2.0 ** 1025`` raises OverflowError — which would kill the
+        actor thread mid-``on_timeout``.  Past the clamp every sane
+        factor has long saturated the cap anyway.
+        """
+        lo, hi = self.resend_interval
+        cap = self.max_resend_interval
+        try:
+            scale = self.backoff_factor ** min(self._resend_attempts, 64)
+        except OverflowError:
+            return (cap, cap)
+        if scale == float("inf"):
+            return (cap, cap)  # avoids 0.0 * inf = nan for a zero base
+        return (min(lo * scale, cap), min(hi * scale, cap))
 
     def name(self) -> str:
         return self.wrapped_actor.name()
@@ -132,6 +187,11 @@ class ActorWrapper(Actor):
             pending = tuple(
                 (seq, dm) for seq, dm in state.msgs_pending_ack if seq != msg.seq
             )
+            self._attempts_by_seq.pop(msg.seq, None)
+            if not pending:
+                # Progress: the peer is reachable again; restart the
+                # backoff ladder from the base interval.
+                self._resend_attempts = 0
             state = LinkState(
                 state.next_send_seq,
                 pending,
@@ -154,11 +214,57 @@ class ActorWrapper(Actor):
 
     def on_timeout(self, id: Id, state: LinkState, timer: Any, o: Out):
         if timer == NETWORK_TIMER:
-            # Re-arm and resend everything pending (reference:199-205).
-            o.set_timer(NETWORK_TIMER, self.resend_interval)
+            if not state.msgs_pending_ack:
+                self._resend_attempts = 0
+                o.set_timer(NETWORK_TIMER, self.resend_interval)
+                return None
+            if self.max_resends is None:
+                # Reference behavior: re-arm (with backoff) and resend
+                # everything pending, forever (reference:199-205).
+                self._resend_attempts += 1
+                o.set_timer(NETWORK_TIMER, self._next_resend_interval())
+                for seq, (dst, msg) in state.msgs_pending_ack:
+                    o.send(dst, Deliver(seq, msg))
+                return None
+            # Capped mode: each message carries its own resend budget —
+            # giving up on a message the network has refused max_resends
+            # times must not drop a freshly-sent one to a healthy peer.
+            # The give-up is surfaced through the callback so the drop is
+            # journal-visible, never silent.
+            self._resend_attempts += 1
+            kept, dropped = [], []
             for seq, (dst, msg) in state.msgs_pending_ack:
-                o.send(dst, Deliver(seq, msg))
-            return None
+                n = self._attempts_by_seq.get(seq, 0) + 1
+                if n > self.max_resends:
+                    self._attempts_by_seq.pop(seq, None)
+                    dropped.append((seq, (dst, msg)))
+                else:
+                    self._attempts_by_seq[seq] = n
+                    kept.append((seq, (dst, msg)))
+                    o.send(dst, Deliver(seq, msg))
+            if not kept:
+                self._resend_attempts = 0
+            o.set_timer(NETWORK_TIMER, self._next_resend_interval())
+            if not dropped:
+                return None
+            if self.on_give_up is not None:
+                self.on_give_up(id, tuple(dropped))
+            state = LinkState(
+                state.next_send_seq,
+                tuple(kept),
+                state.last_delivered_seqs,
+                state.wrapped_state,
+                state.wrapped_storage,
+            )
+            o.save(
+                LinkStorage(
+                    state.next_send_seq,
+                    state.msgs_pending_ack,
+                    state.last_delivered_seqs,
+                    state.wrapped_storage,
+                )
+            )
+            return state
         if isinstance(timer, UserTimer):
             wrapped_out = Out()
             next_wrapped = self.wrapped_actor.on_timeout(

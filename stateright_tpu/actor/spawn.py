@@ -1,13 +1,23 @@
 """The real-network actor runtime: run model-checked actors over UDP.
 
 Reference: src/actor/spawn.rs.  The *same* ``Actor`` implementations used
-for model checking execute on a real network: one thread per actor, a UDP
-socket bound to the actor's ``Id``-encoded address, persistent storage
-loaded from ``{addr}.storage`` before ``on_start`` (src/actor/spawn.rs:
-96-100), and an event loop that waits for the earliest pending interrupt
-(timer or scheduled random choice) or an incoming datagram, dispatching
-``on_msg`` / ``on_timeout`` / ``on_random`` and then applying the emitted
-commands (src/actor/spawn.rs:106-164,177-256).
+for model checking execute on a real network: one thread per actor, a
+transport endpoint bound to the actor's ``Id`` (UDP by default — the
+``Id``-encoded address of src/actor/spawn.rs:96-100), persistent storage
+loaded from ``{addr}.storage`` before ``on_start``, and an event loop that
+waits for the earliest pending interrupt (timer or scheduled random
+choice) or an incoming datagram, dispatching ``on_msg`` / ``on_timeout`` /
+``on_random`` and then applying the emitted commands
+(src/actor/spawn.rs:106-164,177-256).
+
+The wire is pluggable (``actor/transport.py``): pass ``transport=`` to run
+the same actors over the in-process loopback fabric, optionally wrapped in
+the fault-injecting chaos transport (``runtime/chaos.py``).
+
+Every event-loop deadline — timers, scheduled random choices, and the
+retransmit timers the ordered reliable link arms — is computed on
+``time.monotonic()``, never wall time, so NTP steps and clock jumps can
+neither fire a timer early nor starve it.
 
 Message and storage serializers are caller-supplied functions, as in the
 reference (whose examples use serde_json); ``json_serialize`` /
@@ -20,7 +30,6 @@ from __future__ import annotations
 import json
 import os
 import random as _random
-import socket
 import threading
 import time
 from typing import Any, Callable, List, Optional, Tuple
@@ -35,9 +44,27 @@ from .base import (
     SetTimerCmd,
 )
 from .ids import Id
+from .transport import (
+    MAX_DATAGRAM,
+    Endpoint,
+    Transport,
+    TransportClosed,
+    UdpTransport,
+)
+
+__all__ = [
+    "ActorRuntime",
+    "spawn",
+    "json_serialize",
+    "json_deserialize",
+    "MAX_DATAGRAM",
+]
 
 _PRACTICALLY_NEVER = 1e18  # src/actor/spawn.rs practically_never()
-MAX_DATAGRAM = 65_535
+
+# The longest one recv blocks before re-checking the stop flag: bounds
+# teardown latency for a thread parked waiting for a datagram.
+_STOP_POLL_SEC = 1.0
 
 
 def json_serialize(msg: Any) -> bytes:
@@ -58,20 +85,44 @@ class ActorRuntime:
 
     def __init__(self):
         self._threads: List[threading.Thread] = []
-        self._sockets: List[socket.socket] = []
+        self._endpoints: List[Endpoint] = []
+        self._transport: Optional[Transport] = None
         self._stop = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
         self.errors: List[BaseException] = []
 
-    def stop(self) -> None:
-        """Stop all actor threads (closing their sockets)."""
-        self._stop.set()
-        for s in self._sockets:
-            try:
-                s.close()
-            except OSError:
-                pass
-        for t in self._threads:
-            t.join(timeout=5)
+    def stop(self, timeout: float = 10.0, raise_errors: bool = True) -> None:
+        """Stop all actor threads (closing their endpoints); idempotent.
+
+        Teardown is bounded: each closed endpoint wakes its thread's
+        ``recv`` immediately, and recv waits are capped at
+        ``_STOP_POLL_SEC`` regardless, so ``timeout`` is a hard ceiling on
+        the join — a chaos test can never hang CI on a thread parked in
+        ``recvfrom``.  Actor-thread exceptions collected in
+        ``self.errors`` are re-raised here (first one) unless
+        ``raise_errors=False``.
+        """
+        with self._stop_lock:
+            first = not self._stopped
+            self._stopped = True
+        if first:
+            self._stop.set()
+            for ep in self._endpoints:
+                try:
+                    ep.close()
+                except Exception:
+                    pass
+            if self._transport is not None:
+                try:
+                    self._transport.close()
+                except Exception:
+                    pass
+            deadline = time.monotonic() + timeout
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if raise_errors and self.errors:
+            raise self.errors[0]
 
     def join(self) -> None:
         """Block until the runtime stops (the reference blocks forever,
@@ -89,21 +140,44 @@ def spawn(
     storage_deserialize: Callable[[bytes], Any],
     actors: List[Tuple[Id, Actor]],
     storage_dir: str = ".",
+    transport: Optional[Transport] = None,
 ) -> ActorRuntime:
-    """Run ``actors`` on real UDP sockets; returns a runtime handle.
+    """Run ``actors`` on a datagram transport; returns a runtime handle.
+
+    ``transport`` defaults to real UDP sockets (``UdpTransport``).
+    Endpoints are bound up front, in the caller's thread, so an
+    already-taken address raises here instead of landing in
+    ``runtime.errors`` asynchronously.
 
     Reference: ``spawn``, src/actor/spawn.rs:70-168 (which blocks; call
     ``.join()`` on the returned handle for that behavior).
     """
     runtime = ActorRuntime()
-    for id, actor in actors:
-        id = Id(id)
+    runtime._transport = transport = (
+        transport if transport is not None else UdpTransport()
+    )
+    bound: List[Tuple[Id, Actor, Endpoint]] = []
+    try:
+        for id, actor in actors:
+            id = Id(id)
+            endpoint = transport.bind(id)
+            runtime._endpoints.append(endpoint)
+            bound.append((id, actor, endpoint))
+    except BaseException:
+        for ep in runtime._endpoints:
+            try:
+                ep.close()
+            except Exception:
+                pass
+        raise
+    for id, actor, endpoint in bound:
         t = threading.Thread(
             target=_actor_main,
             args=(
                 runtime,
                 id,
                 actor,
+                endpoint,
                 msg_serialize,
                 msg_deserialize,
                 storage_serialize,
@@ -123,6 +197,7 @@ def _actor_main(
     runtime: ActorRuntime,
     id: Id,
     actor: Actor,
+    endpoint: Endpoint,
     msg_serialize,
     msg_deserialize,
     storage_serialize,
@@ -130,12 +205,6 @@ def _actor_main(
     storage_dir: str,
 ) -> None:
     try:
-        ip, port = id.to_socket_addr()
-        addr = (f"{ip[0]}.{ip[1]}.{ip[2]}.{ip[3]}", port)
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        sock.bind(addr)
-        runtime._sockets.append(sock)
-
         storage_path = os.path.join(storage_dir, f"{_addr_str(id)}.storage")
         storage: Optional[Any] = None
         try:
@@ -144,21 +213,17 @@ def _actor_main(
         except (OSError, ValueError):
             storage = None
 
-        # interrupt key -> (kind, payload, fire_at)
+        # interrupt key -> fire_at (monotonic seconds)
         next_interrupts: dict = {}
 
         def on_command(cmd) -> None:
             # Reference: on_command, src/actor/spawn.rs:177-256.
             if isinstance(cmd, SendCmd):
-                dst_ip, dst_port = Id(cmd.dst).to_socket_addr()
-                dst = (
-                    f"{dst_ip[0]}.{dst_ip[1]}.{dst_ip[2]}.{dst_ip[3]}",
-                    dst_port,
-                )
                 try:
-                    sock.sendto(msg_serialize(cmd.msg), dst)
-                except (OSError, ValueError, TypeError):
-                    pass  # unable to send/serialize: ignore, like the reference
+                    data = msg_serialize(cmd.msg)
+                except (ValueError, TypeError):
+                    return  # unserializable: ignore, like the reference
+                endpoint.send(Id(cmd.dst), data)
             elif isinstance(cmd, SetTimerCmd):
                 lo, hi = cmd.duration
                 duration = _random.uniform(lo, hi) if lo < hi else lo
@@ -195,21 +260,17 @@ def _actor_main(
                 min_key, min_at = None, _PRACTICALLY_NEVER
             max_wait = min_at - time.monotonic()
             if max_wait > 0:
-                sock.settimeout(min(max_wait, 1.0))
                 try:
-                    data, src_addr = sock.recvfrom(MAX_DATAGRAM)
-                except socket.timeout:
-                    continue
-                except OSError:
-                    return  # socket closed: runtime stopping
+                    received = endpoint.recv(min(max_wait, _STOP_POLL_SEC))
+                except TransportClosed:
+                    return  # endpoint closed: runtime stopping
+                if received is None:
+                    continue  # timeout: re-check interrupts and stop flag
+                data, src = received
                 try:
                     msg = msg_deserialize(data)
                 except (ValueError, KeyError):
                     continue  # unparseable: ignore, like the reference
-                src = Id.from_socket_addr(
-                    tuple(int(b) for b in src_addr[0].split(".")),
-                    src_addr[1],
-                )
                 next_state = actor.on_msg(id, state, src, msg, out)
             else:
                 del next_interrupts[min_key]

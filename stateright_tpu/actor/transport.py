@@ -1,0 +1,175 @@
+"""Pluggable datagram transports for the real-network actor runtime.
+
+The reference's ``spawn`` (src/actor/spawn.rs) hard-wires UDP sockets into
+the event loop.  Here the socket code is behind a three-method ``Transport``
+interface so the *same* runtime can run over:
+
+- :class:`UdpTransport` — the production wire (one UDP socket per actor,
+  addresses encoded in the actor ``Id``, src/actor/spawn.rs:96-105);
+- :class:`LoopbackTransport` — an in-process queue fabric for hermetic
+  tests: actor ``Id``s are plain indices, no ports are bound, and a chaos
+  wrapper (``runtime/chaos.py``) can inject seeded drop / duplicate /
+  reorder / delay / partition faults deterministically.
+
+Transports deal in raw datagrams (``bytes``) addressed by ``Id`` — message
+codecs stay in the runtime, exactly where the reference keeps serde.
+Datagram semantics are fire-and-forget: ``send`` to an unreachable or
+unbound destination silently drops, like UDP.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Optional, Tuple
+
+from .ids import Id
+
+MAX_DATAGRAM = 65_535
+
+
+class TransportClosed(Exception):
+    """Raised by ``Endpoint.recv`` once the endpoint is closed — the
+    runtime's signal that the actor thread should exit."""
+
+
+class Endpoint:
+    """One actor's attachment to a transport (the analog of its socket)."""
+
+    def send(self, dst: Id, data: bytes) -> None:
+        """Fire-and-forget datagram send; never raises on delivery failure."""
+        raise NotImplementedError
+
+    def recv(self, timeout: float) -> Optional[Tuple[bytes, Id]]:
+        """Wait up to ``timeout`` seconds for one datagram.
+
+        Returns ``(data, src)`` or ``None`` on timeout; raises
+        :class:`TransportClosed` once the endpoint is closed.
+        """
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class Transport:
+    """A datagram fabric actors bind endpoints onto."""
+
+    def bind(self, id: Id) -> Endpoint:
+        """Create the endpoint for actor ``id``; raises if the address is
+        taken (mirroring a UDP bind failure)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release any fabric-level resources (endpoints close themselves)."""
+
+
+# --- UDP ---------------------------------------------------------------------
+
+
+class UdpEndpoint(Endpoint):
+    def __init__(self, id: Id):
+        ip, port = Id(id).to_socket_addr()
+        addr = (f"{ip[0]}.{ip[1]}.{ip[2]}.{ip[3]}", port)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(addr)
+
+    def send(self, dst: Id, data: bytes) -> None:
+        ip, port = Id(dst).to_socket_addr()
+        try:
+            self._sock.sendto(
+                data, (f"{ip[0]}.{ip[1]}.{ip[2]}.{ip[3]}", port)
+            )
+        except OSError:
+            pass  # unable to send: ignore, like the reference
+
+    def recv(self, timeout: float) -> Optional[Tuple[bytes, Id]]:
+        self._sock.settimeout(timeout)
+        try:
+            data, src_addr = self._sock.recvfrom(MAX_DATAGRAM)
+        except socket.timeout:
+            return None
+        except OSError:
+            raise TransportClosed() from None
+        src = Id.from_socket_addr(
+            tuple(int(b) for b in src_addr[0].split(".")), src_addr[1]
+        )
+        return data, src
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class UdpTransport(Transport):
+    """The production transport: actor ``Id``s are encoded socket addresses
+    (``ip << 16 | port``), one bound UDP socket per actor."""
+
+    def bind(self, id: Id) -> UdpEndpoint:
+        return UdpEndpoint(id)
+
+
+# --- in-process loopback -----------------------------------------------------
+
+_CLOSE = object()  # queue sentinel waking a parked recv on close
+
+
+class LoopbackEndpoint(Endpoint):
+    def __init__(self, transport: "LoopbackTransport", id: Id):
+        self._transport = transport
+        self.id = Id(id)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+
+    def send(self, dst: Id, data: bytes) -> None:
+        if self._closed or len(data) > MAX_DATAGRAM:
+            return  # oversized datagrams drop, like UDP sendto failing
+        self._transport._deliver(self.id, Id(dst), data)
+
+    def recv(self, timeout: float) -> Optional[Tuple[bytes, Id]]:
+        if self._closed:
+            raise TransportClosed()
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is _CLOSE:
+            raise TransportClosed()
+        return item
+
+    def close(self) -> None:
+        self._closed = True
+        self._transport._unbind(self.id)
+        self._queue.put(_CLOSE)
+
+
+class LoopbackTransport(Transport):
+    """Hermetic in-process fabric: per-actor queues keyed by ``Id``.  Any
+    hashable ``Id`` works (plain model indices included), so the actors a
+    model checked can run unmodified without binding ports."""
+
+    def __init__(self):
+        self._endpoints = {}
+        self._lock = threading.Lock()
+
+    def bind(self, id: Id) -> LoopbackEndpoint:
+        id = Id(id)
+        with self._lock:
+            if id in self._endpoints:
+                raise OSError(f"loopback address already bound: {id!r}")
+            ep = LoopbackEndpoint(self, id)
+            self._endpoints[id] = ep
+            return ep
+
+    def _unbind(self, id: Id) -> None:
+        with self._lock:
+            self._endpoints.pop(Id(id), None)
+
+    def _deliver(self, src: Id, dst: Id, data: bytes) -> None:
+        with self._lock:
+            ep = self._endpoints.get(dst)
+        if ep is not None and not ep._closed:
+            ep._queue.put((data, src))

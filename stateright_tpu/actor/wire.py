@@ -74,6 +74,8 @@ def _dec(v: Any) -> Any:
             except TypeError as e:  # unhashable element
                 raise ValueError(f"malformed __set payload: {v!r}") from e
         if "__t" in v:
+            if not isinstance(v["__t"], str):
+                raise ValueError(f"malformed __t tag: {v!r}")
             cls = _REGISTRY.get(v["__t"])
             if cls is None:
                 raise ValueError(f"unknown wire type {v['__t']!r}")
@@ -95,4 +97,11 @@ def wire_serialize(msg: Any) -> bytes:
 
 
 def wire_deserialize(data: bytes) -> Any:
-    return _dec(json.loads(data.decode()))
+    # The full failure surface must be ValueError (the runtime's
+    # malformed-datagram contract): UnicodeDecodeError and JSONDecodeError
+    # already subclass it; absurdly nested payloads would otherwise
+    # surface as RecursionError and kill the replica thread.
+    try:
+        return _dec(json.loads(data.decode()))
+    except RecursionError as e:
+        raise ValueError("wire message nests too deeply") from e

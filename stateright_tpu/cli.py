@@ -42,7 +42,10 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--supervise] [--checkpoint-dir DIR] [--resume]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     if spec.spawn is not None:
-        lines.append("  spawn")
+        lines.append(
+            "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
+            " [--journal PATH] [--duration SEC]"
+        )
     if spec.default_network:
         lines.append(f"NETWORK: one of {' | '.join(Network.names())}")
     return "\n".join(lines)
@@ -122,6 +125,88 @@ def _extract_runtime_flags(args):
             out.append(a)
         i += 1
     return out, supervise, ckpt_dir, resume
+
+
+def _parse_chaos_flags(args):
+    """Parse the ``spawn`` subcommand's chaos flags.  Returns
+    ``(leftover_args, ChaosOptions | None)``; raises ``ValueError`` on a
+    malformed flag or chaos spec.  ``--chaos @FILE`` reads the spec JSON
+    from a file."""
+    from .runtime.chaos import ChaosSpec
+
+    spec_json = None
+    seed = 0
+    audit = False
+    journal = None
+    duration = 10.0
+    seen_any = False
+    out = []
+    i = 0
+
+    def value_of(flag):
+        nonlocal i
+        i += 1
+        if i >= len(args):
+            raise ValueError(f"{flag} requires a value")
+        return args[i]
+
+    while i < len(args):
+        a = args[i]
+        if a == "--chaos":
+            spec_json, seen_any = value_of(a), True
+        elif a == "--seed":
+            v = value_of(a)
+            try:
+                seed = int(v)
+            except ValueError:
+                raise ValueError("--seed requires an integer") from None
+            seen_any = True
+        elif a == "--audit":
+            audit, seen_any = True, True
+        elif a == "--journal":
+            journal, seen_any = value_of(a), True
+        elif a == "--duration":
+            v = value_of(a)
+            try:
+                duration = float(v)
+            except ValueError:
+                raise ValueError("--duration requires seconds") from None
+            if duration <= 0:
+                raise ValueError("--duration must be positive")
+            seen_any = True
+        else:
+            out.append(a)
+        i += 1
+    if not seen_any:
+        return out, None
+    if spec_json is None:
+        spec_json = "{}"  # --audit/--seed alone: fault-free chaos harness
+    if spec_json.startswith("@"):
+        try:
+            with open(spec_json[1:], "r", encoding="utf-8") as f:
+                spec_json = f.read()
+        except OSError as e:
+            raise ValueError(f"--chaos {spec_json}: {e}") from None
+    chaos = ChaosOptions(
+        spec=ChaosSpec.from_json(spec_json),
+        seed=seed,
+        audit=audit,
+        journal=journal,
+        duration=duration,
+    )
+    return out, chaos
+
+
+class ChaosOptions:
+    """Parsed ``spawn --chaos`` flags, handed to a chaos-capable spawn
+    target (one whose callable accepts a ``chaos`` keyword)."""
+
+    def __init__(self, spec, seed, audit, journal, duration):
+        self.spec = spec
+        self.seed = seed
+        self.audit = audit
+        self.journal = journal
+        self.duration = duration
 
 
 def _parse_network(args, spec):
@@ -359,8 +444,26 @@ def example_main(spec: CliSpec, argv=None) -> int:
         if spec.spawn is None:
             print(f"{spec.name} has no spawn target", file=sys.stderr)
             return 2
-        spec.spawn()
-        return 0
+        try:
+            args, chaos = _parse_chaos_flags(args)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+        _reject_leftovers(args, spec)
+        if chaos is None:
+            rc = spec.spawn()
+            return int(rc) if rc else 0
+        import inspect
+
+        if "chaos" not in inspect.signature(spec.spawn).parameters:
+            print(
+                f"{spec.name}'s spawn target is not chaos-capable "
+                "(it takes no `chaos` keyword)",
+                file=sys.stderr,
+            )
+            return 2
+        rc = spec.spawn(chaos=chaos)
+        return int(rc) if rc else 0
 
     print(_usage(spec.name, spec))
     return 2
@@ -369,13 +472,19 @@ def example_main(spec: CliSpec, argv=None) -> int:
 # --- shared spawn helper for register-harness systems ------------------------
 
 
-def spawn_register_system(make_actors, count: int, name: str) -> None:
+def spawn_register_system(
+    make_actors, count: int, name: str, make_transport=None
+) -> None:
     """Run register-protocol servers over real localhost UDP, mirroring the
     reference examples' ``spawn`` subcommands (examples/paxos.rs:488-512):
     servers at 127.0.0.1:3000+i, JSON-over-datagram message encoding, until
     interrupted.  ``make_actors(ids)`` builds the server actors given their
     real socket-addr ``Id``s (peers must reference these, not model
-    indices)."""
+    indices).  ``make_transport(ids)`` overrides the wire — e.g. a
+    ``runtime.chaos.FaultyTransport`` wrapping UDP (with the chaos spec's
+    model indices remapped onto the real ids), which is how
+    ``spawn --chaos`` (without ``--audit``) injects faults into a system
+    being poked externally with ``nc -u``."""
     from .actor.ids import Id
     from .actor.spawn import spawn
     from .actor.wire import wire_deserialize, wire_serialize
@@ -383,6 +492,7 @@ def spawn_register_system(make_actors, count: int, name: str) -> None:
     ids = [
         Id.from_socket_addr((127, 0, 0, 1), 3000 + i) for i in range(count)
     ]
+    transport = make_transport(ids) if make_transport is not None else None
     server_actors = make_actors(ids)
     print(f"A set of {name} servers is now running on:")
     for i in ids:
@@ -396,8 +506,9 @@ def spawn_register_system(make_actors, count: int, name: str) -> None:
         wire_serialize,
         wire_deserialize,
         list(zip(ids, server_actors)),
+        transport=transport,
     )
     try:
         runtime.join()
     except KeyboardInterrupt:
-        runtime.stop()
+        runtime.stop(raise_errors=False)

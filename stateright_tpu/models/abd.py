@@ -78,8 +78,21 @@ class AbdState:
 
 
 class AbdActor(Actor):
-    def __init__(self, peers):
+    """The ABD quorum register replica.
+
+    ``fault`` injects a deliberate protocol bug for chaos-audit testing
+    (never used when model checking): ``"skip_ack"`` makes the replica
+    acknowledge client operations immediately from local state, skipping
+    both quorum phases — the classic linearizability violation (a read on
+    another replica misses a completed write) that the live auditor must
+    catch (tests/test_actor_chaos.py).
+    """
+
+    def __init__(self, peers, fault=None):
         self.peers = list(peers)
+        if fault not in (None, "skip_ack"):
+            raise ValueError(f"unknown AbdActor fault: {fault!r}")
+        self.fault = fault
 
     def name(self) -> str:
         return "ABD Server"
@@ -88,6 +101,17 @@ class AbdActor(Actor):
         return AbdState(seq=(0, id), val=NULL_VALUE, phase=None)
 
     def on_msg(self, id, state: AbdState, src, msg, o: Out):
+        if self.fault == "skip_ack" and isinstance(msg, (Put, Get)):
+            # Broken replica: answer from local state without consulting a
+            # quorum (no Query/Record round, no acks awaited).
+            if isinstance(msg, Put):
+                o.send(src, PutOk(msg.request_id))
+                return AbdState(
+                    seq=(state.seq[0] + 1, id), val=msg.value, phase=state.phase
+                )
+            o.send(src, GetOk(msg.request_id, state.val))
+            return None
+
         if isinstance(msg, (Put, Get)) and state.phase is None:
             write = msg.value if isinstance(msg, Put) else None
             o.broadcast(self.peers, Internal(Query(msg.request_id)))
@@ -232,11 +256,36 @@ class AbdModelCfg:
         return model
 
 
+def run_chaos_audit(chaos, fault=None, client_count=2, put_count=2) -> dict:
+    """A hermetic ABD cluster under chaos with live linearizability
+    auditing (the `spawn --chaos ... --audit` flow; see docs/ACTORS.md).
+    ``fault`` forwards to :class:`AbdActor` — ``"skip_ack"`` builds the
+    deliberately-broken replica the audit must reject."""
+    from ..actor.register import RegisterServer
+    from ..runtime.chaos import run_chaos_register_system
+    from ..semantics import LinearizabilityTester, Register
+
+    return run_chaos_register_system(
+        lambda peers: RegisterServer(AbdActor(peers, fault=fault)),
+        server_count=3,
+        client_count=client_count,
+        put_count=put_count,
+        spec=chaos.spec,
+        seed=chaos.seed,
+        tester_factory=lambda: LinearizabilityTester(Register(NULL_VALUE)),
+        wire_types=(Internal, Query, AckQuery, Record, AckRecord),
+        journal=chaos.journal,
+        deadline_sec=chaos.duration,
+    )
+
+
 def main(argv=None) -> int:
     """CLI mirroring examples/linearizable-register.rs."""
     from ..cli import CliSpec, example_main, spawn_register_system
 
-    def spawn_servers():
+    def spawn_servers(chaos=None):
+        import json as _json
+
         from ..actor.register import (
             Get, GetOk, Internal, Put, PutOk, RegisterServer,
         )
@@ -246,6 +295,39 @@ def main(argv=None) -> int:
             Put, Get, PutOk, GetOk, Internal,
             Query, AckQuery, Record, AckRecord,
         )
+        if chaos is not None and chaos.audit:
+            result = run_chaos_audit(chaos)
+            print(_json.dumps(result, sort_keys=True, default=str))
+            # Exit 0 only for a meaningful pass: a linearizable history
+            # with no crashed actor threads and at least one completed
+            # operation (a cluster that did nothing, or died early with a
+            # trivially-consistent prefix, must not go green).
+            ok = (
+                result["consistent"]
+                and not result["errors"]
+                and result["returned"] >= 1
+            )
+            return 0 if ok else 1
+        make_transport = None
+        if chaos is not None:
+            from ..actor.transport import UdpTransport
+            from ..runtime.chaos import FaultyTransport
+
+            def make_transport(ids):
+                # Spec links/partitions are written with model indices;
+                # remap them onto the real socket-addr ids.
+                spec = chaos.spec.remap_ids(
+                    {i: int(a) for i, a in enumerate(ids)}
+                )
+                return FaultyTransport(
+                    UdpTransport(), spec, seed=chaos.seed,
+                    journal=chaos.journal,
+                )
+
+            print(
+                f"Chaos transport active: seed={chaos.seed} "
+                f"spec={_json.dumps(chaos.spec.to_dict(), sort_keys=True)}"
+            )
         spawn_register_system(
             lambda ids: [
                 RegisterServer(AbdActor([p for p in ids if p != me]))
@@ -253,6 +335,7 @@ def main(argv=None) -> int:
             ],
             3,
             "ABD replicas",
+            make_transport=make_transport,
         )
 
     return example_main(

@@ -17,11 +17,22 @@ workers plus durable progress state (see PAPERS.md):
   checkpoint with an adaptive geometry backoff (straight to
   ``dedup_factor=1``, never stepwise);
 - :mod:`child` — the child-process entry (``python -m
-  stateright_tpu.runtime.child RUN_DIR``).
+  stateright_tpu.runtime.child RUN_DIR``);
+- :mod:`chaos` — deterministic fault injection for the *actor* runtime
+  (seeded drop/duplicate/reorder/delay/partition schedules over any
+  transport) plus live linearizability auditing of the faulted run with
+  the model checker's own consistency testers (docs/ACTORS.md).
 
 The schema and policies are documented in docs/RUNTIME.md.
 """
 
+from .chaos import (
+    ChaosSpec,
+    FaultyTransport,
+    LiveAuditor,
+    RecordingTransport,
+    run_chaos_register_system,
+)
 from .journal import Journal, read_journal
 from .supervisor import (
     CheckSpec,
@@ -34,6 +45,11 @@ from .supervisor import (
 )
 
 __all__ = [
+    "ChaosSpec",
+    "FaultyTransport",
+    "LiveAuditor",
+    "RecordingTransport",
+    "run_chaos_register_system",
     "Journal",
     "read_journal",
     "CheckSpec",

@@ -1,0 +1,746 @@
+"""Deterministic fault injection and live correctness auditing for the
+actor runtime.
+
+The model checker's ``Network`` semantics (``actor/network.py``) enumerate
+drop, duplication, and reordering — but the production runtime only ever
+saw a well-behaved loopback.  This module closes that gap in the spirit of
+swarm verification (Holzmann et al., PAPERS.md: faults are expected,
+progress must be durable): inject the faults the model enumerates into the
+*real* runtime, journal every injection, and audit the live history with
+the same ``ConsistencyTester``s the checker uses.
+
+Three layers, all stackable over any ``Transport``:
+
+- :class:`FaultyTransport` — wraps a transport with seeded, per-link
+  drop / duplicate / reorder / delay probabilities plus timed
+  partition/heal windows (:class:`ChaosSpec`).  Drop/duplicate/reorder/
+  delay decisions for the n-th datagram on a directed link are a pure
+  function of ``(seed, src, dst, n)`` — independent of thread scheduling
+  and wall time — so a fixed seed gives a bit-reproducible fault
+  schedule.  (Partition drops are the one exception: their windows are
+  measured in elapsed wall time, so they are journaled like everything
+  else but excluded from the reproducibility guarantee.)  Every injected
+  fault is appended to a ``runtime/journal.py`` JSONL journal.
+- :class:`RecordingTransport` — taps the transport boundary, decoding
+  datagrams and handing ``Envelope``s to callbacks on send and receive.
+- :class:`LiveAuditor` — adapts recorded register-protocol traffic
+  (``Put``/``Get`` invocations, ``PutOk``/``GetOk`` returns, with
+  ordered-reliable-link wrappers unwrapped and retransmits deduplicated)
+  into a live ``LinearizabilityTester`` / ``SequentialConsistencyTester``
+  history, checked against the same ``SequentialSpec`` the model uses.
+
+:func:`run_chaos_register_system` composes them: a hermetic loopback
+cluster of ORL-wrapped register actors under chaos, audited live — the
+``spawn --chaos ... --audit`` CLI flow and the CI chaos smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+import random as _random_mod
+
+from ..actor.ids import Id
+from ..actor.transport import Endpoint, Transport
+from .journal import Journal, as_journal
+
+_MASK64 = (1 << 64) - 1
+
+
+# --- chaos specification -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LinkFaults:
+    """Per-directed-link fault probabilities (each decided per datagram)."""
+
+    drop: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    delay: Tuple[float, float] = (0.0, 0.0)  # uniform seconds (lo, hi)
+
+
+@dataclass(frozen=True)
+class Partition:
+    """A timed partition window: links crossing group boundaries drop all
+    datagrams while ``at <= elapsed < heal`` (``heal=None``: forever)."""
+
+    at: float
+    heal: Optional[float]
+    groups: Tuple[FrozenSet[int], ...]
+
+    def cuts(self, src: int, dst: int, elapsed: float) -> bool:
+        if elapsed < self.at or (self.heal is not None and elapsed >= self.heal):
+            return False
+        src_g = dst_g = None
+        for i, g in enumerate(self.groups):
+            if src in g:
+                src_g = i
+            if dst in g:
+                dst_g = i
+        return src_g is not None and dst_g is not None and src_g != dst_g
+
+
+_FAULT_KEYS = ("drop", "duplicate", "reorder", "delay")
+
+
+def _parse_faults(d: dict, where: str) -> LinkFaults:
+    unknown = set(d) - set(_FAULT_KEYS)
+    if unknown:
+        raise ValueError(f"unknown chaos fault key(s) in {where}: {sorted(unknown)}")
+    rates = {}
+    for k in ("drop", "duplicate", "reorder"):
+        v = d.get(k, 0.0)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or not (0.0 <= v <= 1.0):
+            raise ValueError(f"chaos {where}.{k} must be a probability in [0, 1]: {v!r}")
+        rates[k] = float(v)
+    delay = d.get("delay", (0.0, 0.0))
+    if isinstance(delay, (int, float)) and not isinstance(delay, bool):
+        delay = (float(delay), float(delay))
+    try:
+        lo, hi = (float(delay[0]), float(delay[1]))
+    except (TypeError, ValueError, IndexError):
+        raise ValueError(
+            f"chaos {where}.delay must be seconds or [lo, hi]: {delay!r}"
+        ) from None
+    if lo < 0 or hi < lo:
+        raise ValueError(f"chaos {where}.delay must satisfy 0 <= lo <= hi: {delay!r}")
+    return LinkFaults(delay=(lo, hi), **rates)
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """Parsed chaos spec: default link faults, per-link overrides, and
+    partition windows.  JSON schema (docs/ACTORS.md):
+
+    ``{"drop": 0.1, "duplicate": 0.05, "reorder": 0.1, "delay": [0, 0.02],
+    "links": {"0->1": {"drop": 0.5}},
+    "partitions": [{"at": 0.5, "heal": 1.5, "groups": [[0, 1], [2]]}]}``
+
+    Fault keys may be given at top level (the default for every link) or
+    under ``"default"``; ``"links"`` keys are ``"SRC->DST"`` actor ids.
+    """
+
+    default: LinkFaults = field(default_factory=LinkFaults)
+    links: Tuple[Tuple[Tuple[int, int], LinkFaults], ...] = ()
+    partitions: Tuple[Partition, ...] = ()
+
+    @staticmethod
+    def from_json(obj) -> "ChaosSpec":
+        if isinstance(obj, (str, bytes)):
+            obj = json.loads(obj)  # JSONDecodeError is a ValueError
+        if obj is None:
+            return ChaosSpec()
+        if not isinstance(obj, dict):
+            raise ValueError(f"chaos spec must be a JSON object: {obj!r}")
+        known = set(_FAULT_KEYS) | {"default", "links", "partitions"}
+        unknown = set(obj) - known
+        if unknown:
+            raise ValueError(f"unknown chaos spec key(s): {sorted(unknown)}")
+        top = {k: obj[k] for k in _FAULT_KEYS if k in obj}
+        if top and "default" in obj:
+            raise ValueError(
+                "chaos spec: give fault rates at top level OR under "
+                '"default", not both'
+            )
+        default = _parse_faults(top or obj.get("default", {}) or {}, "default")
+        links = []
+        for key, d in (obj.get("links") or {}).items():
+            try:
+                src_s, dst_s = str(key).split("->")
+                link = (int(src_s), int(dst_s))
+            except ValueError:
+                raise ValueError(
+                    f'chaos links key must look like "SRC->DST": {key!r}'
+                ) from None
+            links.append((link, _parse_faults(d or {}, f"links[{key}]")))
+        partitions = []
+        for i, p in enumerate(obj.get("partitions") or ()):
+            if not isinstance(p, dict):
+                raise ValueError(f"chaos partitions[{i}] must be an object: {p!r}")
+            try:
+                at = float(p["at"])
+                heal = None if p.get("heal") is None else float(p["heal"])
+                groups = tuple(
+                    frozenset(int(x) for x in g) for g in p["groups"]
+                )
+            except (KeyError, TypeError, ValueError):
+                raise ValueError(
+                    f"chaos partitions[{i}] needs at/groups "
+                    f"(+ optional heal): {p!r}"
+                ) from None
+            if heal is not None and heal < at:
+                raise ValueError(f"chaos partitions[{i}]: heal < at: {p!r}")
+            partitions.append(Partition(at, heal, groups))
+        return ChaosSpec(
+            default=default,
+            links=tuple(sorted(links)),
+            partitions=tuple(partitions),
+        )
+
+    def remap_ids(self, mapping: Dict[int, int]) -> "ChaosSpec":
+        """Rewrite link and partition-group ids through ``mapping`` —
+        specs are written with model indices (0, 1, 2, …), but over UDP
+        the actors' real ids are socket-addr encodings, which would
+        silently never match (ids absent from the mapping pass through
+        unchanged)."""
+
+        def m(x: int) -> int:
+            return mapping.get(x, x)
+
+        return ChaosSpec(
+            default=self.default,
+            links=tuple(
+                sorted(((m(s), m(d)), f) for (s, d), f in self.links)
+            ),
+            partitions=tuple(
+                Partition(
+                    p.at, p.heal, tuple(frozenset(m(x) for x in g) for g in p.groups)
+                )
+                for p in self.partitions
+            ),
+        )
+
+    def faults_for(self, src: Id, dst: Id) -> LinkFaults:
+        link = (int(src), int(dst))
+        for k, f in self.links:
+            if k == link:
+                return f
+        return self.default
+
+    def to_dict(self) -> dict:
+        def faults(f: LinkFaults) -> dict:
+            return {
+                "drop": f.drop, "duplicate": f.duplicate,
+                "reorder": f.reorder, "delay": list(f.delay),
+            }
+
+        return {
+            "default": faults(self.default),
+            "links": {f"{s}->{d}": faults(f) for (s, d), f in self.links},
+            "partitions": [
+                {
+                    "at": p.at,
+                    "heal": p.heal,
+                    "groups": [sorted(g) for g in p.groups],
+                }
+                for p in self.partitions
+            ],
+        }
+
+
+# --- the fault-injecting transport -------------------------------------------
+
+
+def _link_rng_seed(seed: int, src: Id, dst: Id) -> int:
+    """A stable 64-bit per-link seed: fault schedules depend only on
+    (seed, src, dst, per-link datagram index), never on hash
+    randomization, thread interleaving, or wall time."""
+    h = (int(seed) & _MASK64) * 0x9E3779B97F4A7C15
+    h = (h + (int(src) + 1) * 0xC2B2AE3D27D4EB4F) & _MASK64
+    h = (h + (int(dst) + 1) * 0x165667B19E3779F9) & _MASK64
+    return h
+
+
+class _LinkState:
+    __slots__ = ("rng", "n", "held")
+
+    def __init__(self, seed: int, src: Id, dst: Id):
+        self.rng = _random_mod.Random(_link_rng_seed(seed, src, dst))
+        self.n = 0  # datagrams sent on this link so far
+        self.held: List[bytes] = []  # reorder buffer
+
+
+class FaultyEndpoint(Endpoint):
+    def __init__(self, transport: "FaultyTransport", inner: Endpoint, id: Id):
+        self._transport = transport
+        self._inner = inner
+        self.id = Id(id)
+
+    def send(self, dst: Id, data: bytes) -> None:
+        self._transport._send(self._inner, self.id, Id(dst), data)
+
+    def recv(self, timeout: float):
+        return self._inner.recv(timeout)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class FaultyTransport(Transport):
+    """Wraps ``inner`` with the seeded fault schedule of ``spec``.
+
+    Fault decision order per datagram (all four random draws happen for
+    every datagram, so the schedule for datagram ``n`` on a link never
+    shifts with timing): partition check → drop → reorder-hold →
+    duplicate → delay.  A held (reordered) datagram is released right
+    after the next delivered datagram on the same link — i.e. the two
+    swap places; held datagrams are discarded if the transport closes
+    first (indistinguishable from a drop, which the ORL retransmit
+    absorbs).  Every injected fault appends a ``chaos_*`` event to the
+    journal and bumps ``fault_counts``.
+    """
+
+    def __init__(
+        self,
+        inner: Transport,
+        spec: ChaosSpec,
+        seed: int = 0,
+        journal=None,
+    ):
+        self.inner = inner
+        self.spec = spec if isinstance(spec, ChaosSpec) else ChaosSpec.from_json(spec)
+        self.seed = int(seed)
+        self.journal: Optional[Journal] = as_journal(journal)
+        self.fault_counts: Dict[str, int] = {}
+        self._links: Dict[Tuple[int, int], _LinkState] = {}
+        self._lock = threading.Lock()
+        self._timers: set = set()
+        self._closed = False
+        self._start = time.monotonic()
+        if self.journal is not None:
+            self.journal.append(
+                "chaos_start", seed=self.seed, spec=self.spec.to_dict()
+            )
+
+    def bind(self, id: Id) -> FaultyEndpoint:
+        return FaultyEndpoint(self, self.inner.bind(id), id)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            timers, self._timers = list(self._timers), set()
+        for t in timers:
+            t.cancel()
+        self.inner.close()
+
+    # -- internals ------------------------------------------------------------
+
+    def _send(self, inner: Endpoint, src: Id, dst: Id, data: bytes) -> None:
+        link = (int(src), int(dst))
+        # Fault events are decided (and counted) under the lock but
+        # journaled after releasing it: the critical section must not
+        # include disk I/O, or every actor thread's send serializes
+        # behind a file flush.  Journal.append has its own lock.
+        events: List[dict] = []
+
+        def event(kind: str, **fields) -> None:
+            self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+            events.append({"event": kind, **fields})
+
+        batch = None
+        delay = 0.0
+        with self._lock:
+            if self._closed:
+                return
+            ls = self._links.get(link)
+            if ls is None:
+                ls = self._links[link] = _LinkState(self.seed, src, dst)
+            n = ls.n
+            ls.n += 1
+            rng = ls.rng
+            # Always draw all four, in a fixed order: the schedule for
+            # datagram n is a pure function of (seed, link, n).
+            r_drop = rng.random()
+            r_reorder = rng.random()
+            r_dup = rng.random()
+            r_delay = rng.random()
+            faults = self.spec.faults_for(src, dst)
+            elapsed = time.monotonic() - self._start
+            if any(
+                p.cuts(link[0], link[1], elapsed) for p in self.spec.partitions
+            ):
+                event("chaos_partition", src=link[0], dst=link[1], n=n)
+            elif r_drop < faults.drop:
+                event("chaos_drop", src=link[0], dst=link[1], n=n)
+            elif r_reorder < faults.reorder:
+                ls.held.append(data)
+                event("chaos_reorder", src=link[0], dst=link[1], n=n)
+            else:
+                batch = [data]
+                if r_dup < faults.duplicate:
+                    batch.append(data)
+                    event("chaos_duplicate", src=link[0], dst=link[1], n=n)
+                batch.extend(ls.held)
+                ls.held = []
+                lo, hi = faults.delay
+                delay = lo + r_delay * (hi - lo) if hi > 0 else 0.0
+                if delay > 0:
+                    event(
+                        "chaos_delay", src=link[0], dst=link[1], n=n,
+                        sec=round(delay, 6),
+                    )
+        if self.journal is not None:
+            for e in events:
+                self.journal.append(**e)
+        if batch is None:
+            return
+
+        def deliver() -> None:
+            for d in batch:
+                inner.send(dst, d)
+
+        if delay > 0:
+            timer = threading.Timer(delay, self._fire)
+            timer.args = (timer, deliver)  # so _fire can retire it
+            timer.daemon = True
+            with self._lock:
+                if self._closed:
+                    return
+                self._timers.add(timer)
+            timer.start()
+        else:
+            deliver()
+
+    def _fire(self, timer, deliver: Callable[[], None]) -> None:
+        with self._lock:
+            self._timers.discard(timer)
+            if self._closed:
+                return
+        deliver()
+
+    def datagram_count(self) -> int:
+        """Total datagrams offered to the fabric (pre-fault) — the chaos
+        harness's quiescence signal."""
+        with self._lock:
+            return sum(ls.n for ls in self._links.values())
+
+
+# --- transport-boundary history recording ------------------------------------
+
+
+@dataclass(frozen=True)
+class WireEnvelope:
+    """A decoded datagram observed at the transport boundary."""
+
+    src: Id
+    dst: Id
+    msg: Any
+
+
+class RecordingEndpoint(Endpoint):
+    def __init__(self, transport: "RecordingTransport", inner: Endpoint, id: Id):
+        self._transport = transport
+        self._inner = inner
+        self.id = Id(id)
+
+    def send(self, dst: Id, data: bytes) -> None:
+        self._transport._record_out(self.id, Id(dst), data)
+        self._inner.send(dst, data)
+
+    def recv(self, timeout: float):
+        received = self._inner.recv(timeout)
+        if received is not None:
+            data, src = received
+            self._transport._record_in(Id(src), self.id, data)
+        return received
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class RecordingTransport(Transport):
+    """Decodes every datagram crossing the transport boundary and hands
+    ``WireEnvelope``s to ``on_out`` (at send, pre-fault-injection) and
+    ``on_in`` (at receive, post-fault-injection).  Undecodable datagrams
+    are skipped — the runtime drops those anyway."""
+
+    def __init__(
+        self,
+        inner: Transport,
+        deserialize: Callable[[bytes], Any],
+        on_out: Optional[Callable[[WireEnvelope], None]] = None,
+        on_in: Optional[Callable[[WireEnvelope], None]] = None,
+    ):
+        self.inner = inner
+        self._deserialize = deserialize
+        self._on_out = on_out
+        self._on_in = on_in
+
+    def bind(self, id: Id) -> RecordingEndpoint:
+        return RecordingEndpoint(self, self.inner.bind(id), id)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def _record(self, hook, src: Id, dst: Id, data: bytes) -> None:
+        if hook is None:
+            return
+        try:
+            msg = self._deserialize(data)
+        except (ValueError, KeyError):
+            return
+        hook(WireEnvelope(src, dst, msg))
+
+    def _record_out(self, src: Id, dst: Id, data: bytes) -> None:
+        self._record(self._on_out, src, dst, data)
+
+    def _record_in(self, src: Id, dst: Id, data: bytes) -> None:
+        self._record(self._on_in, src, dst, data)
+
+
+# --- live consistency auditing -----------------------------------------------
+
+
+class LiveAuditor:
+    """Feeds register-harness traffic observed at the transport boundary
+    into a ``ConsistencyTester`` — the *same* tester + ``SequentialSpec``
+    the model checker evaluates in its ``always`` properties, now judging
+    a live run.
+
+    Client→server ``Put``/``Get`` datagrams record invocations; server→
+    client ``PutOk``/``GetOk`` datagrams record returns.  Ordered-
+    reliable-link ``Deliver`` wrappers are unwrapped, and retransmits /
+    chaos duplicates are deduplicated by ``(client, request_id)`` so the
+    history sees each operation exactly once.  Tester-level history
+    violations (double invocation, orphan return) are collected rather
+    than raised — a violating history is simply reported inconsistent.
+    """
+
+    def __init__(self, tester, client_ids):
+        from ..actor import register as _register
+
+        self._reg = _register
+        self.tester = tester
+        self.client_ids = frozenset(Id(c) for c in client_ids)
+        self.violations: List[str] = []
+        self._invoked: set = set()
+        self._returned: set = set()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _unwrap(msg: Any) -> Any:
+        from ..actor.ordered_reliable_link import Deliver
+
+        return msg.msg if isinstance(msg, Deliver) else msg
+
+    def on_out(self, env: WireEnvelope) -> None:
+        from ..semantics.register import READ, WriteOp
+
+        if env.src not in self.client_ids:
+            return
+        msg = self._unwrap(env.msg)
+        if isinstance(msg, self._reg.Put):
+            op = WriteOp(msg.value)
+        elif isinstance(msg, self._reg.Get):
+            op = READ
+        else:
+            return
+        key = (int(env.src), msg.request_id)
+        with self._lock:
+            if key in self._invoked:
+                return  # retransmit of an already-recorded invocation
+            self._invoked.add(key)
+            try:
+                self.tester.on_invoke(env.src, op)
+            except ValueError as e:
+                self.violations.append(f"invoke {key}: {e}")
+
+    def on_in(self, env: WireEnvelope) -> None:
+        from ..semantics.register import WRITE_OK, ReadOk
+
+        if env.dst not in self.client_ids:
+            return
+        msg = self._unwrap(env.msg)
+        if isinstance(msg, self._reg.PutOk):
+            ret = WRITE_OK
+        elif isinstance(msg, self._reg.GetOk):
+            ret = ReadOk(msg.value)
+        else:
+            return
+        key = (int(env.dst), msg.request_id)
+        with self._lock:
+            if key in self._returned:
+                return  # duplicate delivery of an already-recorded return
+            if key not in self._invoked:
+                self.violations.append(f"return without invocation: {key}")
+                return
+            self._returned.add(key)
+            try:
+                self.tester.on_return(env.dst, ret)
+            except ValueError as e:
+                self.violations.append(f"return {key}: {e}")
+
+    @property
+    def invoked_count(self) -> int:
+        with self._lock:
+            return len(self._invoked)
+
+    @property
+    def returned_count(self) -> int:
+        with self._lock:
+            return len(self._returned)
+
+    def result(self) -> dict:
+        """Final verdict (runs the tester's interleaving search)."""
+        with self._lock:
+            violations = list(self.violations)
+            invoked, returned = len(self._invoked), len(self._returned)
+            pending = self.tester.pending_count()
+            serialized = (
+                None if violations else self.tester.serialized_history()
+            )
+        return {
+            "consistent": not violations and serialized is not None,
+            "invoked": invoked,
+            "returned": returned,
+            "in_flight": pending,
+            "violations": violations,
+        }
+
+
+# --- the composed chaos run --------------------------------------------------
+
+
+def run_chaos_register_system(
+    make_server_actor: Callable[[List[Id]], Any],
+    *,
+    server_count: int = 3,
+    client_count: int = 2,
+    put_count: int = 2,
+    spec: Optional[ChaosSpec] = None,
+    seed: int = 0,
+    tester_factory: Optional[Callable[[], Any]] = None,
+    wire_types: Tuple = (),
+    journal=None,
+    deadline_sec: float = 20.0,
+    resend_interval: Tuple[float, float] = (0.05, 0.1),
+    backoff_factor: float = 2.0,
+    max_resend_interval: float = 1.0,
+    max_resends: Optional[int] = 40,
+    storage_dir: Optional[str] = None,
+    transport_factory: Optional[Callable[[], Transport]] = None,
+    quiesce_sec: float = 2.0,
+) -> dict:
+    """Run a register-protocol cluster hermetically under chaos and audit it.
+
+    ``make_server_actor(peers)`` builds one server actor (e.g. a
+    ``RegisterServer(AbdActor(peers))``) given its peer ids; servers get
+    ids ``0..server_count-1`` and scripted ``RegisterClient``s ride at
+    ``server_count..`` — plain model indices, since the loopback fabric
+    needs no socket addresses.  Every actor is wrapped in the hardened
+    ordered reliable link (exponential backoff, journal-visible give-up),
+    the transport stack is ``Recording(Faulty(Loopback))``, and the run
+    ends when every client op has returned, when ``deadline_sec`` passes,
+    or — after the last partition window has closed — when the fabric has
+    been quiescent (no datagram offered anywhere) for ``quiesce_sec``:
+    per the reference ORL semantics a message no-op'd by a busy replica
+    is acked but never redelivered, so a stalled client is a legal stable
+    outcome (its op stays in flight, which the testers treat as optional)
+    rather than something worth spinning on until the deadline.
+
+    Returns the audit verdict dict plus ``faults`` (injected-fault
+    counts), ``completed``, ``elapsed_sec``, and ``errors``.
+    """
+    import shutil
+
+    from ..actor.ids import Id as _Id
+    from ..actor.ordered_reliable_link import ActorWrapper, Ack, Deliver, LinkStorage
+    from ..actor.register import Get, GetOk, Put, PutOk, RegisterClient
+    from ..actor.spawn import spawn
+    from ..actor.transport import LoopbackTransport
+    from ..actor.wire import register_wire_types, wire_deserialize, wire_serialize
+    from ..semantics import LinearizabilityTester, Register
+
+    journal = as_journal(journal)
+    spec = spec if spec is not None else ChaosSpec()
+    register_wire_types(
+        Deliver, Ack, LinkStorage, Put, Get, PutOk, GetOk, *wire_types
+    )
+    server_ids = [_Id(i) for i in range(server_count)]
+    client_ids = [_Id(server_count + i) for i in range(client_count)]
+
+    if tester_factory is None:
+        tester_factory = lambda: LinearizabilityTester(Register(None))  # noqa: E731
+    auditor = LiveAuditor(tester_factory(), client_ids)
+
+    def give_up(actor_id, dropped):
+        if journal is not None:
+            journal.append(
+                "orl_give_up",
+                actor=int(actor_id),
+                dropped=len(dropped),
+                seqs=[seq for seq, _dm in dropped],
+            )
+
+    def wrap(actor):
+        return ActorWrapper(
+            actor,
+            resend_interval=resend_interval,
+            backoff_factor=backoff_factor,
+            max_resend_interval=max_resend_interval,
+            max_resends=max_resends,
+            on_give_up=give_up,
+        )
+
+    actors = [
+        (sid, wrap(make_server_actor([p for p in server_ids if p != sid])))
+        for sid in server_ids
+    ] + [
+        (cid, wrap(RegisterClient(put_count=put_count, server_count=server_count)))
+        for cid in client_ids
+    ]
+
+    inner = transport_factory() if transport_factory is not None else LoopbackTransport()
+    faulty = FaultyTransport(inner, spec, seed=seed, journal=journal)
+    transport: Transport = RecordingTransport(
+        faulty, wire_deserialize, on_out=auditor.on_out, on_in=auditor.on_in
+    )
+
+    tmp_storage = None
+    if storage_dir is None:
+        tmp_storage = tempfile.mkdtemp(prefix="stateright-chaos-")
+        storage_dir = tmp_storage
+
+    expected = client_count * (put_count + 1)
+    started = time.monotonic()
+    runtime = spawn(
+        wire_serialize,
+        wire_deserialize,
+        wire_serialize,
+        wire_deserialize,
+        actors,
+        storage_dir=storage_dir,
+        transport=transport,
+    )
+    try:
+        deadline = started + deadline_sec
+        # Quiescence detection only arms once every healing partition has
+        # healed; permanent (heal=None) partitions don't delay it — after
+        # the ORL gives up on a permanently cut link, silence is final.
+        last_heal = max(
+            (p.heal for p in spec.partitions if p.heal is not None),
+            default=0.0,
+        )
+        quiesce_from = started + last_heal
+        last_count, last_change = -1, time.monotonic()
+        while auditor.returned_count < expected and time.monotonic() < deadline:
+            count = faulty.datagram_count()
+            now = time.monotonic()
+            if count != last_count:
+                last_count, last_change = count, now
+            elif now >= quiesce_from and now - last_change >= quiesce_sec:
+                break  # stalled-stable: nothing has moved for quiesce_sec
+            time.sleep(0.01)
+    finally:
+        runtime.stop(raise_errors=False)
+        if tmp_storage is not None:
+            shutil.rmtree(tmp_storage, ignore_errors=True)
+
+    result = auditor.result()
+    result.update(
+        completed=result["returned"] >= expected,
+        expected=expected,
+        elapsed_sec=round(time.monotonic() - started, 3),
+        faults=dict(sorted(faulty.fault_counts.items())),
+        seed=seed,
+        errors=[repr(e) for e in runtime.errors],
+    )
+    if journal is not None:
+        journal.append("audit", **result)
+    return result

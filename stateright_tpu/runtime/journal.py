@@ -14,20 +14,26 @@ Engine events: ``resume``, ``wave``, ``checkpoint``, ``grow``,
 ``engine_done``.  Child events: ``run_start``, ``run_end``,
 ``child_error``.  Supervisor events: ``supervisor_start``, ``crash``,
 ``hang``, ``relax``, ``restart``, ``wall_timeout``, ``give_up``,
-``supervisor_done``.
+``supervisor_done``.  Chaos-runtime events (``runtime/chaos.py``, see
+docs/ACTORS.md): ``chaos_start``, ``chaos_drop``, ``chaos_duplicate``,
+``chaos_reorder``, ``chaos_delay``, ``chaos_partition``, ``orl_give_up``,
+``audit``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
 
 class Journal:
     """Appends events to a JSONL file; safe to share a path across
-    processes (each instance holds its own append-mode handle)."""
+    processes (each instance holds its own append-mode handle) and to
+    share one instance across threads (the chaos transport's actor and
+    delay-timer threads all append through a single journal)."""
 
     def __init__(self, path: str):
         self.path = str(path)
@@ -35,17 +41,20 @@ class Journal:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._fh = None
+        self._lock = threading.Lock()
 
     def append(self, event: str, **fields) -> dict:
         record = {"t": time.time(), "event": event}
         record.update(fields)
         line = json.dumps(record, sort_keys=True, default=str) + "\n"
-        if self._fh is None:
-            # O_APPEND semantics: every writer's line lands at the true
-            # end of file even when the supervisor and child interleave.
-            self._fh = open(self.path, "a", encoding="utf-8")
-        self._fh.write(line)
-        self._fh.flush()
+        with self._lock:
+            if self._fh is None:
+                # O_APPEND semantics: every writer's line lands at the
+                # true end of file even when the supervisor and child
+                # interleave.
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(line)
+            self._fh.flush()
         return record
 
     def close(self) -> None:

@@ -65,6 +65,17 @@ class _TesterBase(ConsistencyTester):
             len(h) for h in self.history_by_thread.values()
         )
 
+    def completed_count(self) -> int:
+        """Operations with both invocation and return recorded — the live
+        auditor's progress signal (runtime/chaos.py)."""
+        return sum(len(h) for h in self.history_by_thread.values())
+
+    def pending_count(self) -> int:
+        """Invocations still in flight (no return recorded yet).  A
+        serialization may schedule these or leave them out, so a live run
+        stopped mid-operation still audits cleanly."""
+        return len(self.in_flight_by_thread)
+
     def _key(self):
         return (
             type(self).__name__,

@@ -5,7 +5,6 @@ src/actor/ordered_reliable_link.rs:279-385 (the ORL's own model-checked
 verification), src/actor/write_once_register.rs.
 """
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Optional
@@ -64,14 +63,14 @@ class OrlReceiver(Actor):
         return state + ((src, msg),)
 
 
-def _orl_model():
+def _orl_model(**wrapper_kwargs):
     def received(state):
         return state.actor_states[1].wrapped_state
 
     return (
         ActorModel(cfg=None, init_history=None)
-        .actor(ActorWrapper.with_default_timeout(OrlSender(Id(1))))
-        .actor(ActorWrapper.with_default_timeout(OrlReceiver()))
+        .actor(ActorWrapper(OrlSender(Id(1)), **wrapper_kwargs))
+        .actor(ActorWrapper(OrlReceiver(), **wrapper_kwargs))
         .init_network_(Network.new_unordered_duplicating())
         .lossy_network_(True)
         .property(
@@ -118,6 +117,132 @@ def test_orl_messages_are_eventually_delivered(orl_checker):
             DeliverAction(src=Id(0), dst=Id(1), msg=Deliver(2, 43)),
         ],
     )
+
+
+def test_orl_backoff_config_does_not_change_model(orl_checker):
+    """The runtime retransmission hardening (exponential backoff, capped
+    interval) must be invisible to the checker: backoff only scales timer
+    *durations*, which the model ignores (src/actor/model.rs:79-81).
+    Same properties, same state space, bit-identical transitions — over
+    the same lossy unordered_duplicating network as the reference's own
+    ORL verification."""
+    checker = (
+        _orl_model(
+            resend_interval=(0.05, 0.1),
+            backoff_factor=2.0,
+            max_resend_interval=8.0,
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_no_discovery("no redelivery")
+    checker.assert_no_discovery("ordered")
+    checker.assert_discovery(
+        "delivered",
+        [
+            DeliverAction(src=Id(0), dst=Id(1), msg=Deliver(1, 42)),
+            DeliverAction(src=Id(0), dst=Id(1), msg=Deliver(2, 43)),
+        ],
+    )
+    assert checker.unique_state_count() == orl_checker.unique_state_count()
+
+
+# --- ORL runtime hardening: backoff + give-up (unit level) -------------------
+
+
+def test_orl_resend_interval_backs_off_exponentially_with_cap():
+    w = ActorWrapper(
+        OrlReceiver(),
+        resend_interval=(0.1, 0.2),
+        backoff_factor=2.0,
+        max_resend_interval=1.0,
+    )
+    assert w._next_resend_interval() == (0.1, 0.2)
+    w._resend_attempts = 2
+    assert w._next_resend_interval() == (0.4, 0.8)
+    w._resend_attempts = 3
+    assert w._next_resend_interval() == (0.8, 1.0)  # hi capped
+    w._resend_attempts = 50
+    assert w._next_resend_interval() == (1.0, 1.0)  # both capped
+    # A long-partitioned peer (or a deep model check) can push the
+    # attempt counter arbitrarily high: the exponent must saturate, not
+    # raise OverflowError inside on_timeout and kill the actor thread.
+    w._resend_attempts = 100_000
+    assert w._next_resend_interval() == (1.0, 1.0)
+
+
+def test_orl_gives_up_after_max_resends_and_reports_dropped():
+    from stateright_tpu.actor.base import SaveCmd, SendCmd, SetTimerCmd
+    from stateright_tpu.actor.ordered_reliable_link import NETWORK_TIMER
+
+    given_up = []
+    w = ActorWrapper(
+        OrlReceiver(),
+        resend_interval=(0.01, 0.02),
+        max_resends=2,
+        on_give_up=lambda id, dropped: given_up.append((id, dropped)),
+    )
+    state = LinkState(
+        next_send_seq=3,
+        msgs_pending_ack=((1, (Id(1), 42)), (2, (Id(1), 43))),
+        last_delivered_seqs=(),
+        wrapped_state=(),
+        wrapped_storage=None,
+    )
+    # Two resend rounds are allowed...
+    for expected_attempts in (1, 2):
+        out = Out()
+        assert w.on_timeout(Id(0), state, NETWORK_TIMER, out) is None
+        assert w._resend_attempts == expected_attempts
+        sends = [c for c in out if isinstance(c, SendCmd)]
+        assert [c.msg for c in sends] == [Deliver(1, 42), Deliver(2, 43)]
+    # ...the third gives up: pending cleared, persisted, callback fired.
+    out = Out()
+    next_state = w.on_timeout(Id(0), state, NETWORK_TIMER, out)
+    assert next_state.msgs_pending_ack == ()
+    assert not any(isinstance(c, SendCmd) for c in out)
+    assert any(isinstance(c, SetTimerCmd) for c in out)  # timer re-armed
+    assert any(isinstance(c, SaveCmd) for c in out)  # give-up is durable
+    assert given_up == [(Id(0), ((1, (Id(1), 42)), (2, (Id(1), 43))))]
+    assert w._resend_attempts == 0  # ladder reset for future sends
+
+
+def test_orl_give_up_is_per_message_not_per_wrapper():
+    """Exhausting one message's resend budget (e.g. to a partitioned
+    peer) must not drop a freshly-sent message to a healthy peer."""
+    from stateright_tpu.actor.base import SendCmd
+    from stateright_tpu.actor.ordered_reliable_link import NETWORK_TIMER
+
+    given_up = []
+    w = ActorWrapper(
+        OrlReceiver(),
+        resend_interval=(0.01, 0.02),
+        max_resends=1,
+        on_give_up=lambda id, dropped: given_up.append(dropped),
+    )
+    stuck_only = LinkState(
+        next_send_seq=2,
+        msgs_pending_ack=((1, (Id(1), "stuck")),),
+        last_delivered_seqs=(),
+        wrapped_state=(),
+        wrapped_storage=None,
+    )
+    out = Out()
+    assert w.on_timeout(Id(0), stuck_only, NETWORK_TIMER, out) is None  # 1st resend
+    both = LinkState(
+        next_send_seq=3,
+        msgs_pending_ack=((1, (Id(1), "stuck")), (2, (Id(2), "fresh"))),
+        last_delivered_seqs=(),
+        wrapped_state=(),
+        wrapped_storage=None,
+    )
+    out = Out()
+    next_state = w.on_timeout(Id(0), both, NETWORK_TIMER, out)
+    assert next_state.msgs_pending_ack == ((2, (Id(2), "fresh")),)
+    sends = [c for c in out if isinstance(c, SendCmd)]
+    assert [c.msg for c in sends] == [Deliver(2, "fresh")]
+    assert given_up == [((1, (Id(1), "stuck")),)]
 
 
 # --- write-once register harness ---------------------------------------------
@@ -312,3 +437,162 @@ def test_udp_runtime_timers_fire(tmp_path):
         time.sleep(0.02)
     runtime.stop()
     assert results == ["ticked"]
+
+
+# --- transport pluggability: the same actors over in-process loopback --------
+
+
+def test_loopback_runtime_delivers_and_persists(tmp_path):
+    """The UDP round-trip/persistence scenario, hermetic: plain model
+    indices as Ids, no ports bound — the chaos harness's substrate."""
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    server_id, client_id = Id(1), Id(2)
+    results = []
+    runtime = spawn(
+        json_serialize,
+        json_deserialize,
+        json_serialize,
+        json_deserialize,
+        [
+            (server_id, CountingServer()),
+            (client_id, CollectingClient(server_id, results)),
+        ],
+        storage_dir=str(tmp_path),
+        transport=LoopbackTransport(),
+    )
+    deadline = time.time() + 10
+    while len(results) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    runtime.stop()
+    assert results[:3] == [1, 2, 3]
+    results2 = []
+    runtime2 = spawn(
+        json_serialize,
+        json_deserialize,
+        json_serialize,
+        json_deserialize,
+        [
+            (server_id, CountingServer()),
+            (client_id, CollectingClient(server_id, results2)),
+        ],
+        storage_dir=str(tmp_path),
+        transport=LoopbackTransport(),
+    )
+    deadline = time.time() + 10
+    while len(results2) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    runtime2.stop()
+    assert results2 and results2[0] > max(results)
+
+
+def test_duplicate_loopback_bind_raises_in_caller(tmp_path):
+    """Endpoints bind in spawn()'s caller thread: an address collision
+    surfaces synchronously, not asynchronously via runtime.errors."""
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    transport = LoopbackTransport()
+    runtime = spawn(
+        json_serialize, json_deserialize, json_serialize, json_deserialize,
+        [(Id(1), Collector([]))],
+        storage_dir=str(tmp_path),
+        transport=transport,
+    )
+    try:
+        with pytest.raises(OSError):
+            spawn(
+                json_serialize, json_deserialize, json_serialize,
+                json_deserialize,
+                [(Id(1), Collector([]))],
+                storage_dir=str(tmp_path),
+                transport=transport,
+            )
+    finally:
+        runtime.stop()
+
+
+# --- runtime teardown hardening ----------------------------------------------
+
+
+def test_stop_is_idempotent_and_bounded(tmp_path):
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    runtime = spawn(
+        json_serialize, json_deserialize, json_serialize, json_deserialize,
+        [(Id(1), Collector([])), (Id(2), Collector([]))],
+        storage_dir=str(tmp_path),
+        transport=LoopbackTransport(),
+    )
+    t0 = time.monotonic()
+    runtime.stop()
+    runtime.stop()  # second call is a no-op, not an error
+    assert time.monotonic() - t0 < 5.0, "teardown must be bounded"
+    assert not any(t.is_alive() for t in runtime._threads)
+    runtime.stop()  # still fine after threads are gone
+
+
+class _FailingActor(Actor):
+    def on_start(self, id, storage, o: Out):
+        raise RuntimeError("boom at startup")
+
+
+def test_stop_surfaces_actor_errors(tmp_path):
+    """stop() re-raises collected actor-thread errors (previously only
+    join() did), and can be told not to for best-effort teardown."""
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    runtime = spawn(
+        json_serialize, json_deserialize, json_serialize, json_deserialize,
+        [(Id(1), _FailingActor())],
+        storage_dir=str(tmp_path),
+        transport=LoopbackTransport(),
+    )
+    deadline = time.time() + 5
+    while not runtime.errors and time.time() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="boom at startup"):
+        runtime.stop()
+    runtime.stop(raise_errors=False)  # idempotent, quiet teardown
+
+
+def test_event_loop_never_reads_wall_clock(tmp_path, monkeypatch):
+    """Pin the monotonic-deadline contract: the event loop computing
+    timer/retransmit deadlines must never call time.time() — a wall-clock
+    jump (NTP step) could otherwise fire timers early or starve them.
+    The shim raises on any wall-clock read from the spawn module; timers
+    must still fire."""
+    import sys
+
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    # (the actor package re-exports the spawn *function* under the same
+    # name, so `import stateright_tpu.actor.spawn` resolves to that —
+    # fetch the module itself)
+    spawn_mod = sys.modules["stateright_tpu.actor.spawn"]
+
+    real_time = time
+
+    class _NoWallClock:
+        @staticmethod
+        def monotonic():
+            return real_time.monotonic()
+
+        @staticmethod
+        def time():
+            raise AssertionError(
+                "the actor event loop read the wall clock"
+            )
+
+    monkeypatch.setattr(spawn_mod, "time", _NoWallClock)
+    results = []
+    runtime = spawn(
+        json_serialize, json_deserialize, json_serialize, json_deserialize,
+        [(Id(1), TimerActor(Id(2))), (Id(2), Collector(results))],
+        storage_dir=str(tmp_path),
+        transport=LoopbackTransport(),
+    )
+    deadline = real_time.time() + 10
+    while not results and real_time.time() < deadline:
+        real_time.sleep(0.02)
+    runtime.stop()
+    assert results == ["ticked"], f"errors={runtime.errors!r}"

@@ -251,3 +251,50 @@ def test_explore_invalid_port_is_clean_error():
     r = run_cli("paxos", "explore", "2", "localhost:abc")
     assert r.returncode == 2
     assert "invalid ADDRESS port" in r.stderr
+
+
+# --- spawn --chaos: the fault-injecting runtime surface ----------------------
+
+
+def test_spawn_chaos_rejects_malformed_spec():
+    r = run_cli("abd", "spawn", "--chaos", '{"drop": 1.5}')
+    assert r.returncode == 2
+    assert "probability" in r.stderr
+
+
+def test_spawn_chaos_rejects_bad_flag_values():
+    r = run_cli("abd", "spawn", "--chaos", "{}", "--seed", "x")
+    assert r.returncode == 2
+    assert "--seed requires an integer" in r.stderr
+    r = run_cli("abd", "spawn", "--seed")
+    assert r.returncode == 2
+    assert "requires a value" in r.stderr
+
+
+def test_spawn_chaos_on_non_capable_model_is_clean_error():
+    r = run_cli("paxos", "spawn", "--chaos", "{}")
+    assert r.returncode == 2
+    assert "not chaos-capable" in r.stderr
+
+
+def test_spawn_chaos_audit_end_to_end(tmp_path):
+    """The headline chaos flow: a seeded, hermetic ABD cluster under
+    drop+duplicate+reorder, audited for linearizability, journaling every
+    injected fault — exit code reports the verdict."""
+    journal = str(tmp_path / "journal.jsonl")
+    r = run_cli(
+        "abd", "spawn",
+        "--chaos", '{"drop": 0.1, "duplicate": 0.1, "reorder": 0.15}',
+        "--seed", "7", "--audit", "--journal", journal,
+        "--duration", "30",
+        timeout=180,
+    )
+    assert r.returncode == 0, r.stderr
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["consistent"] is True
+    assert verdict["returned"] >= 1
+    events = [json.loads(ln) for ln in open(journal) if ln.strip()]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "chaos_start"
+    assert "audit" in kinds
+    assert any(k.startswith("chaos_") for k in kinds[1:])

@@ -1,0 +1,160 @@
+"""Fuzzing the wire codec's malformed-datagram contract.
+
+``wire.py:58`` documents that every decode failure must surface as
+``ValueError`` — the runtime's receive loop treats that as "malformed
+datagram, drop it"; any other exception type would kill the replica
+thread on a hand-typed probe message.  Here that contract is asserted
+both at the codec level (seeded random garbage, truncations, bit flips,
+hand-typed hostile payloads) and against a live runtime (every garbage
+datagram is dropped and the replica keeps answering).
+"""
+
+import random
+from dataclasses import dataclass
+
+from stateright_tpu.actor.base import Actor, Out
+from stateright_tpu.actor.ids import Id
+from stateright_tpu.actor.spawn import spawn
+from stateright_tpu.actor.transport import LoopbackTransport
+from stateright_tpu.actor.wire import (
+    register_wire_types,
+    wire_deserialize,
+    wire_serialize,
+)
+
+
+@dataclass(frozen=True)
+class FuzzPing:
+    request_id: int
+    payload: str
+
+
+@dataclass(frozen=True)
+class FuzzPong:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class FuzzBag:
+    items: tuple
+    tags: frozenset
+
+
+register_wire_types(FuzzPing, FuzzPong, FuzzBag)
+
+
+def _hand_typed_corpus():
+    """Hostile payloads a human (or a confused client) might type at a
+    replica with ``nc -u``."""
+    return [
+        b"",
+        b"not json",
+        b"\xff\xfe\x00garbage",  # not UTF-8
+        b"5",
+        b"null",
+        b'"just a string"',
+        b"[1, 2, 3]",
+        b"{}",
+        b'{"__t": "NoSuchType"}',
+        b'{"__t": "FuzzPing"}',  # missing fields
+        b'{"__t": "FuzzPing", "request_id": 1}',  # still missing payload
+        b'{"__t": "FuzzPing", "request_id": 1, "payload": "x", "extra": 2}',
+        b'{"__t": []}',  # unhashable tag: must not TypeError
+        b'{"__t": {"a": 1}}',
+        b'{"__t": null}',
+        b'{"__id": "zero"}',
+        b'{"__id": true}',
+        b'{"__id": 1.5}',
+        b'{"__tup": 5}',
+        b'{"__set": 5}',
+        b'{"__set": [[1]]}',  # unhashable element
+        b"[" * 5000,  # nests past the recursion limit
+        b'{"a":' * 5000,
+        b"[" * 5000 + b"1" + b"]" * 5000,
+    ]
+
+
+def _seeded_corpus():
+    rng = random.Random(0xC0FFEE)
+    corpus = []
+    for _ in range(300):
+        corpus.append(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64))))
+    valid = [
+        wire_serialize(FuzzPing(7, "hello")),
+        wire_serialize(FuzzBag(items=(FuzzPong(1), (1, 2)), tags=frozenset([3]))),
+        wire_serialize(FuzzPing(2, "x" * 100)),
+    ]
+    for v in valid:
+        for _ in range(60):
+            cut = rng.randrange(len(v))
+            corpus.append(v[:cut])  # truncation
+            flipped = bytearray(v)
+            flipped[rng.randrange(len(v))] ^= 1 << rng.randrange(8)
+            corpus.append(bytes(flipped))  # bit flip
+    return corpus
+
+
+def test_wire_deserialize_failures_are_always_valueerror():
+    """Decode either succeeds or raises ValueError — never TypeError /
+    KeyError / RecursionError / UnicodeDecodeError-as-something-else."""
+    decoded = failed = 0
+    for datagram in _hand_typed_corpus() + _seeded_corpus():
+        try:
+            wire_deserialize(datagram)
+            decoded += 1
+        except ValueError:
+            failed += 1
+        # any other exception type propagates and fails the test
+    assert failed > 0, "the corpus should contain undecodable datagrams"
+
+
+class _EchoActor(Actor):
+    """Replies FuzzPong to every well-formed FuzzPing."""
+
+    def on_start(self, id, storage, o: Out):
+        return ()
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        if isinstance(msg, FuzzPing):
+            o.send(src, FuzzPong(msg.request_id))
+        return None
+
+
+def test_live_replica_survives_garbage_datagrams():
+    """Blast the full garbage corpus at a running replica over the
+    loopback transport: every datagram must be dropped without killing
+    the replica thread, which must still answer a valid probe."""
+    transport = LoopbackTransport()
+    replica = Id(1)
+    runtime = spawn(
+        wire_serialize,
+        wire_deserialize,
+        wire_serialize,
+        wire_deserialize,
+        [(replica, _EchoActor())],
+        storage_dir="/tmp",
+        transport=transport,
+    )
+    probe = transport.bind(Id(99))
+    try:
+        corpus = _hand_typed_corpus() + _seeded_corpus()
+        for i, datagram in enumerate(corpus):
+            probe.send(replica, datagram)
+            if i % 100 == 0:  # interleave probes with the garbage
+                probe.send(replica, wire_serialize(FuzzPing(i, "probe")))
+        probe.send(replica, wire_serialize(FuzzPing(-1, "final")))
+        replies = []
+        while True:
+            r = probe.recv(2.0)
+            if r is None:
+                break
+            replies.append(wire_deserialize(r[0]))
+            if replies[-1] == FuzzPong(-1):
+                break
+        assert FuzzPong(-1) in replies, (
+            f"replica stopped answering after garbage; errors={runtime.errors!r}"
+        )
+        assert runtime.errors == []
+    finally:
+        probe.close()
+        runtime.stop()
