@@ -22,8 +22,15 @@ it; every earlier line is a valid fallback record from an earlier phase):
            exists.  If the two-phase expansion path fails, the run falls
            back to the single-phase step kernel (and says so in the
            record) rather than dying.
-  phase 2+ optional phases (reference suite, ttfv, sharded smoke) add
-           keys and re-emit; they can never zero earlier lines.
+  phase 2+ optional phases (symmetry on/off cut, ttfv, sharded smoke,
+           reference suite) add keys and re-emit; they can never zero
+           earlier lines.  The reference suite re-emits after EVERY
+           workload child, so a deadline kill mid-suite keeps the
+           completed workloads in the artifact.  Discovered tuned_kwargs
+           persist in a knob cache (.bench_knobs/, runtime/knob_cache.py)
+           keyed by (workload, device, engine) — later rounds and suite
+           children skip the re-discovery; golden gates drop stale
+           entries.
 
 Record shape: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
 ...} where value is unique-states/sec of the TPU wavefront checker (warm —
@@ -65,6 +72,30 @@ from stateright_tpu.runtime.supervisor import (  # noqa: E402
     TRANSIENT_MARKERS as _TRANSIENT_MARKERS,
     run_isolated,
 )
+from stateright_tpu.runtime.knob_cache import (  # noqa: E402
+    drop_knobs,
+    load_knobs,
+    store_knobs,
+)
+
+# Discovered tuned_kwargs persist here (the bench's checkpoint dir), keyed
+# by (workload, device, engine); suite children and later rounds reload
+# them instead of re-paying the ~21-min 2pc-check-10 discovery every round
+# (VERDICT r5 weak #2).  Golden gates keep staleness safe: a cache entry
+# whose measured run misses the golden is dropped and rediscovered.
+KNOB_CACHE_DIR = os.environ.get(
+    "BENCH_KNOB_CACHE_DIR", str(_REPO / ".bench_knobs")
+)
+
+
+def _knob_key(label: str) -> str:
+    """Cache key: workload label + device identity + engine/protocol
+    version (geometry defaults change what discovery finds)."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform)
+    return f"{label}|{d.platform}|{kind}|tpu-wavefront-v1"
 
 # GLOBAL TIME BUDGET: the round-5 suite was killed by the driver's own
 # timeout mid-workload (BENCH_r05.json rc=124), zeroing nothing — the
@@ -199,28 +230,44 @@ REFERENCE_SUITE = [
 def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
     """THE measurement protocol, shared by the headline and every suite
     workload so the two cannot drift: a timed default-knob discovery run
-    (auto-tune does all sizing), a (unique, depth) golden gate, then up
-    to MEASURED_REPEATS measured runs at ``tuned_kwargs()`` — each
-    re-gated — with big workloads (>120s) measured once.  Returns
-    ``(discovery_sec, tuned, samples)``; raises on any golden mismatch
-    or device error (a wrong answer must never post a rate)."""
+    (auto-tune does all sizing) — SKIPPED when the knob cache already
+    holds this workload's tuned sizes — a (unique, depth) golden gate,
+    then up to MEASURED_REPEATS measured runs at ``tuned_kwargs()`` —
+    each re-gated — with big workloads (>120s) measured once.  Returns
+    ``(discovery_sec, tuned, samples, knobs_cached)``; raises on any
+    golden mismatch or device error (a wrong answer must never post a
+    rate).  A cached entry that fails its first golden gate is dropped
+    and the workload falls back to one full discovery."""
     import gc
 
-    log(f"{label}: discovery run (default knobs, auto-tune sizing)...")
-    t0 = time.time()
-    ck = run_device(lambda: mk().checker().spawn_tpu())
-    discovery = time.time() - t0
-    tuned = ck.tuned_kwargs()
-    unique, depth = ck.unique_state_count(), ck.max_depth()
-    del ck
-    gc.collect()
-    if (unique, depth) != (want_unique, want_depth):
-        raise AssertionError(
-            f"{label}: discovery golden mismatch: unique={unique} "
-            f"depth={depth} != {want_unique}/{want_depth}"
+    key = _knob_key(label)
+    tuned = load_knobs(KNOB_CACHE_DIR, key)
+    knobs_cached = tuned is not None
+    discovery = 0.0
+    if knobs_cached:
+        log(f"{label}: tuned knobs from cache ({KNOB_CACHE_DIR}): {tuned}")
+    else:
+        log(f"{label}: discovery run (default knobs, auto-tune sizing)...")
+        t0 = time.time()
+        ck = run_device(lambda: mk().checker().spawn_tpu())
+        discovery = time.time() - t0
+        tuned = ck.tuned_kwargs()
+        unique, depth = ck.unique_state_count(), ck.max_depth()
+        del ck
+        gc.collect()
+        if (unique, depth) != (want_unique, want_depth):
+            raise AssertionError(
+                f"{label}: discovery golden mismatch: unique={unique} "
+                f"depth={depth} != {want_unique}/{want_depth}"
+            )
+        store_knobs(
+            KNOB_CACHE_DIR, key, tuned,
+            unique=want_unique, depth=want_depth,
+            discovery_sec=round(discovery, 1),
         )
-    log(f"{label}: discovery {discovery:.1f}s (incl. compile); "
-        f"measured runs {tuned}...")
+        log(f"{label}: discovery {discovery:.1f}s (incl. compile); "
+            f"knobs cached under {KNOB_CACHE_DIR}")
+    log(f"{label}: measured runs {tuned}...")
     samples = []
     for rep in range(MEASURED_REPEATS):
         ck, dt = run_device_timed(
@@ -230,6 +277,16 @@ def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
         del ck
         gc.collect()
         if (unique, depth) != (want_unique, want_depth):
+            if knobs_cached and not samples:
+                # Stale cache entry (e.g. the engine's geometry defaults
+                # moved under it): drop it and rediscover once — the
+                # recursive call misses the cache, so a second mismatch
+                # raises like any other golden failure.
+                log(f"{label}: cached knobs failed the golden gate "
+                    f"(unique={unique} depth={depth}); dropping cache "
+                    "entry and rediscovering")
+                drop_knobs(KNOB_CACHE_DIR, key)
+                return discover_and_measure(label, mk, want_unique, want_depth)
             raise AssertionError(
                 f"{label}: measured golden mismatch: unique={unique} "
                 f"depth={depth} != {want_unique}/{want_depth}"
@@ -245,7 +302,7 @@ def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
         # best-of-N drops the cold one.
         if dt > 120.0 and rep >= 1:
             break
-    return discovery, tuned, samples
+    return discovery, tuned, samples, knobs_cached
 
 
 def _measure_suite_workload(spec, entry: dict) -> None:
@@ -254,7 +311,7 @@ def _measure_suite_workload(spec, entry: dict) -> None:
     wrong workload never hides the others)."""
     name, mk, want_unique, want_depth = spec
     try:
-        discovery, tuned, samples = discover_and_measure(
+        discovery, tuned, samples, knobs_cached = discover_and_measure(
             f"suite: {name}", mk, want_unique, want_depth
         )
     except AssertionError as exc:
@@ -262,6 +319,7 @@ def _measure_suite_workload(spec, entry: dict) -> None:
         log(entry["error"])
         return
     best = min(samples)
+    entry["knobs_cached"] = knobs_cached
     entry["discovery_sec"] = round(discovery, 2)
     entry["unique_states"] = want_unique
     entry["depth"] = want_depth
@@ -326,6 +384,13 @@ def phase_reference_suite(record: dict) -> None:
     deadline is capped by the remaining global budget so the suite can
     never run the bench into the driver's kill window.
 
+    Partial results are durable: the record is re-emitted after EVERY
+    child (not just after the whole phase), so a deadline kill mid-suite
+    still leaves driver-captured numbers for the workloads that finished
+    — the round-5 artifact lost all five to an rc=124 during the first
+    child precisely because emission waited for the phase (VERDICT r5
+    weak #1).
+
     Concurrent clients verified on this tunnel (2026-07-31): a second
     process ran a device computation while another held the chip
     mid-run, so children initializing the runtime under a live parent
@@ -341,6 +406,7 @@ def phase_reference_suite(record: dict) -> None:
                 f"({remaining:.0f}s remaining of {BENCH_TIME_BUDGET:.0f}s)"
             )}
             log(f"suite: {name}: {suite[name]['error']}")
+            emit(record)
             continue
         # 2pc check 10 from default knobs: ~21 min discovery (measured
         # 2026-07-31) + two comparable measured runs (cold + warm) —
@@ -382,6 +448,7 @@ def phase_reference_suite(record: dict) -> None:
                     f"tail: {res.stderr[-500:]}"
                 )}
             log(f"suite: {name}: {suite[name]['error']}")
+            emit(record)
             continue
         lines = _suite_json_lines(res.stdout)
         if res.returncode != 0 or not lines:
@@ -389,6 +456,7 @@ def phase_reference_suite(record: dict) -> None:
                 f"child died rc={res.returncode} without a result; "
                 f"stderr tail: {res.stderr[-500:]}"
             )}
+            emit(record)
             continue
         try:
             suite[name] = json.loads(lines[-1])["suite_entry"]
@@ -396,6 +464,9 @@ def phase_reference_suite(record: dict) -> None:
             suite[name] = {"error": traceback.format_exc(limit=3)}
             log(f"suite: {name}: child handling failed:\n"
                 f"{suite[name]['error']}")
+        # Per-workload durability: the last JSON line always carries
+        # every workload finished so far.
+        emit(record)
 
 
 def emit(record: dict) -> None:
@@ -436,6 +507,84 @@ def phase_ttfv(record: dict, threads: int, tuned: dict) -> None:
     log(f"ttfv: tpu={ttfv_tpu:.2f}s host={ttfv_host:.2f}s")
     record["ttfv_tpu_sec"] = round(ttfv_tpu, 2)
     record["ttfv_host_sec"] = round(ttfv_host, 2)
+
+
+SYM_RM = 5
+SYM_UNIQUE_FULL = 8_832   # reference examples/2pc.rs:158-159
+# Full-record canon orbit count, pinned by tests/test_tpu_symmetry.py (the
+# reference's DFS-with-symmetry reports 665 with its traversal-dependent
+# tie-broken representative; the device canon is the exact orbit
+# invariant — docs/SYMMETRY.md).
+SYM_UNIQUE_CANON = 314
+SYM_HOST_DFS = 665        # reference examples/2pc.rs:163-168, for context
+
+
+def phase_symmetry(record: dict) -> None:
+    """Device symmetry reduction (optional phase): with/without-symmetry
+    unique-state counts and uniq/s on `2pc check 5` — the reference's own
+    symmetry golden workload — both runs golden-gated, plus a
+    budget-gated scale datapoint (`2pc check 10` with symmetry, whose
+    non-sym count is the 61.5M suite golden)."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    def mk():
+        return TwoPhaseSys(rm_count=SYM_RM)
+
+    entry: dict = {"workload": f"2pc_check_{SYM_RM}"}
+
+    def measure(spawn, want):
+        run_device(spawn)  # warm the program
+        ck, dt = run_device_timed(spawn)
+        u = ck.unique_state_count()
+        assert u == want, (
+            f"symmetry phase golden mismatch: unique={u} != {want}"
+        )
+        return u, dt
+
+    u0, dt0 = measure(lambda: mk().checker().spawn_tpu(), SYM_UNIQUE_FULL)
+    u1, dt1 = measure(
+        lambda: mk().checker().symmetry().spawn_tpu(), SYM_UNIQUE_CANON
+    )
+    entry.update({
+        "unique_no_sym": u0,
+        "sec_no_sym": round(dt0, 3),
+        "uniq_per_sec_no_sym": round(u0 / dt0, 1),
+        "unique_sym": u1,
+        "sec_sym": round(dt1, 3),
+        "uniq_per_sec_sym": round(u1 / dt1, 1),
+        "state_space_cut": round(u0 / u1, 2),
+        "host_dfs_sym_unique": SYM_HOST_DFS,
+    })
+    record["symmetry"] = entry
+    log(f"symmetry: 2pc({SYM_RM}) {u0} -> {u1} unique "
+        f"({u0 / u1:.1f}x cut), sym {dt1:.2f}s")
+    # Durability before the open-ended big run (same policy as the
+    # per-child emits in phase_reference_suite): the rm=5 numbers are
+    # measured and golden-gated — a driver kill during the 2pc(10) leg
+    # must not lose them.
+    emit(record)
+    if budget_remaining() < 900.0:
+        return
+    # Scale datapoint: the biggest reference bench workload, reduced.
+    # The sym count is self-measured (no golden exists yet); the non-sym
+    # side is the suite's pinned 61,515,776, so the CUT is still
+    # golden-anchored on one side.
+    ck, dt = run_device_timed(
+        lambda: TwoPhaseSys(rm_count=10).checker().symmetry().spawn_tpu()
+    )
+    u = ck.unique_state_count()
+    entry["big"] = {
+        "workload": "2pc_check_10_sym",
+        "unique_sym": u,
+        "unique_no_sym": 61_515_776,
+        "state_space_cut": round(61_515_776 / max(u, 1), 1),
+        "sec_sym_incl_autotune": round(dt, 2),
+        "uniq_per_sec_sym": round(u / dt, 1),
+        "note": "sym count self-measured; non-sym count is the suite "
+                "golden (2pc_check_10)",
+    }
+    log(f"symmetry: 2pc(10) sym {u} unique in {dt:.1f}s "
+        f"({61_515_776 / max(u, 1):.0f}x cut)")
 
 
 def phase_sharded_smoke(record: dict) -> None:
@@ -577,7 +726,7 @@ def phase_headline(record: dict, threads: int) -> dict:
     two_phase = hasattr(PaxosCompiled, "step_valid")
     single_phase_reason = record.get("single_phase_reason")
     try:
-        discovery, tuned, samples = discover_and_measure(
+        discovery, tuned, samples, knobs_cached = discover_and_measure(
             "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
         )
     except Exception as exc:
@@ -593,7 +742,7 @@ def phase_headline(record: dict, threads: int) -> dict:
         single_phase_reason = f"{type(exc).__name__}: {exc}"[:300]
         log("headline: device run failed; retrying single-phase:")
         log(traceback.format_exc(limit=5))
-        discovery, tuned, samples = discover_and_measure(
+        discovery, tuned, samples, knobs_cached = discover_and_measure(
             "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
         )
     best = min(samples)
@@ -628,11 +777,22 @@ def phase_headline(record: dict, threads: int) -> dict:
             "this package's thread-pool BFS (pure Python, GIL-bound)"
         ),
         "denominator_threads": threads,
+        # Honest framing (VERDICT r5 weak #5): the ratio is a
+        # same-machine, same-language comparison.  The reference's
+        # native Rust checker would be a far stronger denominator on a
+        # many-core box; vs_baseline is NOT a cross-implementation claim.
+        "denominator_caveat": (
+            "pure-Python GIL-bound BFS on this box; the reference's "
+            "native Rust checker would be orders faster — vs_baseline "
+            "is a same-machine/same-language ratio, not a "
+            "cross-implementation claim"
+        ),
         "tpu_unique_states": GOLDEN_UNIQUE,
         "tpu_wallclock_sec": round(best, 2),
         "samples_sec": [round(s, 2) for s in samples],
         "tpu_warmup_sec": round(discovery, 1),
         "tuned_kwargs": {k: int(v) for k, v in tuned.items()},
+        "tuned_kwargs_cached": knobs_cached,
         "two_phase": two_phase,
     })
     if single_phase_reason:
@@ -670,6 +830,7 @@ def main() -> None:
     # worker, and although each now runs in its own subprocess, keeping
     # the parent's device use front-loaded is free insurance.
     for phase_name, phase in (
+        ("symmetry", phase_symmetry),
         ("ttfv", lambda r: phase_ttfv(r, threads, tuned)),
         ("sharded_smoke", phase_sharded_smoke),
         ("reference_suite", phase_reference_suite),

@@ -35,7 +35,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
     lines.append(f"  check [{n_meta}]{net}")
     lines.append(f"  check-dfs [{n_meta}]{net}")
     if spec.symmetry:
-        lines.append(f"  check-sym [{n_meta}]{net}")
+        tpu_flag = " [--tpu]" if spec.tpu else ""
+        lines.append(f"  check-sym [{n_meta}]{net}{tpu_flag}")
     lines.append(f"  check-simulation [{n_meta}] [SEED]{net}")
     if spec.tpu:
         lines.append(f"  check-tpu [{n_meta}]{net}"
@@ -348,6 +349,12 @@ def example_main(spec: CliSpec, argv=None) -> int:
     threads = os.cpu_count() or 1
 
     if sub in ("check", "check-bfs", "check-dfs", "check-sym", "check-tpu"):
+        # check-sym --tpu: run the symmetry-reduced check on the TPU
+        # wavefront engine (dedup on the compiled model's canonical-row
+        # fingerprint, parallel/canon.py) instead of the host DFS.
+        tpu_sym = sub == "check-sym" and "--tpu" in args
+        if tpu_sym:
+            args = [a for a in args if a != "--tpu"]
         n = _parse_n(args, spec.default_n)
         try:
             network = _parse_network(args, spec)
@@ -377,7 +384,14 @@ def example_main(spec: CliSpec, argv=None) -> int:
             if not spec.symmetry:
                 print(f"{spec.name} has no symmetry reduction", file=sys.stderr)
                 return 2
-            checker = builder.symmetry().spawn_dfs()
+            if tpu_sym:
+                if not spec.tpu:
+                    print(f"{spec.name} has no compiled TPU form",
+                          file=sys.stderr)
+                    return 2
+                checker = builder.symmetry().spawn_tpu(**dict(spec.tpu_kwargs))
+            else:
+                checker = builder.symmetry().spawn_dfs()
         elif sub == "check-tpu":
             if not spec.tpu:
                 print(f"{spec.name} has no compiled TPU form", file=sys.stderr)

@@ -32,7 +32,16 @@ class CheckerBuilder:
 
     def symmetry(self) -> "CheckerBuilder":
         """Enable symmetry reduction via the state's ``representative()``
-        method.  Reference: src/checker.rs:222-227."""
+        method.  Reference: src/checker.rs:222-227.
+
+        Engine support mirrors the reference plus the device path:
+        ``spawn_dfs`` dedups on the representative's fingerprint host-side
+        (src/checker/dfs.rs:309-334); ``spawn_bfs`` ignores the option
+        (reference parity, SURVEY §2.1); ``spawn_tpu`` /
+        ``spawn_tpu_sharded`` honor it when the compiled model declares a
+        device canonicalization (``canon_spec()``/``canon_rows``,
+        parallel/canon.py) and raise loudly otherwise — never a silent
+        fall-through to unreduced exploration (docs/SYMMETRY.md)."""
         return self.symmetry_fn(lambda s: s.representative())
 
     def symmetry_fn(self, representative) -> "CheckerBuilder":
@@ -100,7 +109,10 @@ class CheckerBuilder:
         """Spawn the TPU wavefront checker: successor expansion, frontier
         dedup, and property evaluation run on-device as a vmapped wavefront
         BFS (the replacement for the reference's thread-pool hot loop,
-        src/checker/bfs.rs:177-335)."""
+        src/checker/bfs.rs:177-335).  With ``symmetry()``, dedup keys on
+        the canonical row's fingerprint via the compiled model's canon
+        spec (parallel/canon.py) while logging original rows; models
+        without a canon spec fail the spawn loudly."""
         self._require("stateright_tpu.parallel.wavefront", "TPU wavefront checker")
         from ..parallel.wavefront import TpuChecker
 

@@ -11,6 +11,11 @@ values (e.g. per-actor states); ``rewrite(i)`` maps an old index to its new
 index, and ``reindex`` permutes an indexed collection while recursively
 rewriting the elements (src/checker/rewrite_plan.rs:81-123).
 
+This module is the HOST side (used by spawn_dfs); the device analog —
+sort-of-record-blocks canonicalization kernels over packed state rows,
+used by spawn_tpu / spawn_tpu_sharded — lives in ``parallel/canon.py``
+(docs/SYMMETRY.md).
+
 Where the reference dispatches on the ``Rewrite<Id>`` trait to renumber
 ``Id`` values nested inside state, Python has no type-directed dispatch, so
 ``rewrite_value`` recurses structurally and rewrites values of the marker

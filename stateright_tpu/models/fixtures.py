@@ -3,7 +3,11 @@
 Reference: src/test_util.rs — binary_clock, dgraph, linear_equation_solver,
 and panicker, reproduced with the same state spaces so the reference's
 golden counts (e.g. 65,536 states for full LinearEquation enumeration) pin
-this implementation too.
+this implementation too.  ``TrapCounter`` (+ its compiled form) is this
+package's own fixture for the device engines: the smallest model
+exercising the full eventually-property pipeline, and — via its identity
+canonicalization — the symmetry plumbing on a model with no symmetric
+structure.
 """
 
 from __future__ import annotations
@@ -12,7 +16,10 @@ import enum
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+import numpy as np
+
 from ..core.model import Model, Property
+from ..parallel.compiled import CompiledModel
 
 
 class BinaryClock(Model):
@@ -132,6 +139,97 @@ class Panicker(Model):
 
     def properties(self):
         return [Property.always("true", lambda _m, _s: True)]
+
+
+class TrapCounter(Model):
+    """0 →inc→ 1 → … → limit, with a dead-end trap edge at ``trap_at``.
+
+    Exercises the full eventually pipeline: "reaches one" is satisfied
+    along every path (bit cleared mid-path, never reported); "reaches
+    limit" has a genuine counterexample ending in the trap terminal state.
+    States are plain ints with no symmetric structure, so the compiled
+    form's canonicalization is the identity — the fixture for pinning
+    that symmetry-on changes nothing when there is nothing to reduce
+    (``checker().symmetry_fn(lambda s: s)`` on the host side).
+    """
+
+    def __init__(self, limit=5, trap_at=2):
+        self.limit = limit
+        self.trap_at = trap_at
+        self.trap_state = limit + 1
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        if state < self.limit:
+            actions.append("inc")
+        if state == self.trap_at:
+            actions.append("trap")
+
+    def next_state(self, state, action):
+        return state + 1 if action == "inc" else self.trap_state
+
+    def properties(self):
+        return [
+            Property.eventually("reaches one", lambda _m, s: s >= 1),
+            Property.eventually(
+                "reaches limit", lambda _m, s: s == self.limit
+            ),
+            Property.sometimes(
+                "trapped", lambda _m, s: s == self.trap_state
+            ),
+        ]
+
+    def compiled(self):
+        return TrapCounterCompiled(self)
+
+
+class TrapCounterCompiled(CompiledModel):
+    state_width = 1
+    max_actions = 2
+
+    def __init__(self, model):
+        self.model = model
+
+    def encode(self, state):
+        return np.array([state], np.uint32)
+
+    def decode(self, words):
+        return int(words[0])
+
+    def step(self, state):
+        import jax.numpy as jnp
+
+        n = state[0]
+        limit = jnp.uint32(self.model.limit)
+        inc = jnp.stack([n + jnp.uint32(1)])
+        trap = jnp.stack([jnp.uint32(self.model.trap_state)])
+        nexts = jnp.stack([inc, trap])
+        valid = jnp.stack(
+            [n < limit, n == jnp.uint32(self.model.trap_at)]
+        )
+        return nexts, valid
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        n = state[0]
+        return jnp.stack(
+            [
+                n >= jnp.uint32(1),
+                n == jnp.uint32(self.model.limit),
+                n == jnp.uint32(self.model.trap_state),
+            ]
+        )
+
+    def canon_spec(self):
+        """No symmetric records: the canonical form is the row itself —
+        an empty spec, so symmetry-enabled runs must match plain runs
+        bit-for-bit (pinned in tests/test_tpu_symmetry.py)."""
+        from ..parallel.canon import CanonSpec
+
+        return CanonSpec(n=0)
 
 
 class FnModel(Model):

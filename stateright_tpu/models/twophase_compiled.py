@@ -148,6 +148,28 @@ class TwoPhaseCompiled(CompiledModel):
         )  # [A, W]
         return nexts.astype(_U32), jnp.stack(valids)
 
+    def canon_spec(self):
+        """RM records are fully described by three bit fields — state
+        (word0, 2 bits at 2i), tm_prepared (word1, bit i), and the
+        Prepared(i) message presence (word1, bit n+i) — so sorting whole
+        records canonicalizes exactly the orbit (the reference's
+        representative sorts by rm_state alone and tie-breaks by index,
+        examples/2pc.rs:203-223, which is traversal-order-dependent; see
+        parallel/canon.py's module docstring).  The TM state and the
+        Commit/Abort message bits are permutation-invariant and stay
+        untouched."""
+        from ..parallel.canon import CanonSpec, field
+
+        n = self.n
+        return CanonSpec(
+            n=n,
+            fields=(
+                field(word=0, shift=0, width=2),   # rm_state
+                field(word=1, shift=0, width=1),   # tm_prepared
+                field(word=1, shift=n, width=1),   # Prepared(i) in msgs
+            ),
+        )
+
     def property_conds(self, state):
         n = self.n
         w0 = state[0]

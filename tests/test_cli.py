@@ -40,6 +40,17 @@ def test_check_sym_subcommand_twophase():
     assert "unique=665" in r.stdout  # examples/2pc.rs:163-168
 
 
+def test_check_sym_tpu_subcommand_twophase():
+    """check-sym --tpu: the symmetry-reduced check on the device
+    wavefront engine — dedup on the canonical-row fingerprint.  The
+    full-record canon is the exact orbit invariant: 80 classes at rm=3
+    (tests/test_tpu_symmetry.py pins the recipe; docs/SYMMETRY.md
+    explains why this differs from the host DFS's tie-broken 107)."""
+    r = run_cli("twophase", "check-sym", "3", "--tpu", timeout=600)
+    assert r.returncode == 0, r.stderr
+    assert "unique=80" in r.stdout
+
+
 def test_network_positional():
     r = run_cli("single_copy_register", "check", "2", "ordered")
     assert r.returncode == 0, r.stderr

@@ -378,3 +378,40 @@ def test_supervisor_deterministic_child_error_is_fatal(tmp_path):
 
 def _raising_factory():
     raise RuntimeError("deliberately broken model factory")
+
+
+# --- knob cache (runtime/knob_cache.py) --------------------------------------
+
+
+def test_knob_cache_roundtrip(tmp_path):
+    from stateright_tpu.runtime.knob_cache import (
+        drop_knobs, load_knobs, store_knobs,
+    )
+
+    d = str(tmp_path / "knobs")
+    assert load_knobs(d, "k") is None
+    store_knobs(d, "k", {"capacity": 1 << 20, "dedup_factor": 8},
+                unique=314, discovery_sec=1.5)
+    assert load_knobs(d, "k") == {"capacity": 1 << 20, "dedup_factor": 8}
+    # Second key merges; first survives.
+    store_knobs(d, "k2", {"max_frontier": 2048})
+    assert load_knobs(d, "k") is not None
+    assert load_knobs(d, "k2") == {"max_frontier": 2048}
+    drop_knobs(d, "k")
+    assert load_knobs(d, "k") is None
+    assert load_knobs(d, "k2") is not None
+    # Stored metadata is on disk for humans but not returned.
+    data = json.load(open(os.path.join(d, "knobs.json")))
+    assert data["k2"]["knobs"]["max_frontier"] == 2048
+
+
+def test_knob_cache_degrades_on_torn_file(tmp_path):
+    from stateright_tpu.runtime.knob_cache import load_knobs, store_knobs
+
+    d = str(tmp_path / "knobs")
+    store_knobs(d, "k", {"capacity": 4})
+    with open(os.path.join(d, "knobs.json"), "w") as fh:
+        fh.write('{"k": {"knobs": {"capa')  # torn write
+    assert load_knobs(d, "k") is None  # degrade to rediscovery, no crash
+    store_knobs(d, "k", {"capacity": 8})  # and the file heals on store
+    assert load_knobs(d, "k") == {"capacity": 8}

@@ -1,0 +1,360 @@
+"""Device symmetry reduction: canonicalization kernels + engine wiring.
+
+Pins, per docs/SYMMETRY.md:
+
+- the canon kernel is IDEMPOTENT (``canon(canon(r)) == canon(r)``) and
+  SOUND (``canon(r)`` stays in ``r``'s orbit) over the full reachable set;
+- its equivalence classes are EXACTLY the brute-force orbit partition
+  (full-record sort keys make the canonical form an orbit invariant);
+- ``spawn_tpu`` + ``symmetry()`` dedups on the canonical fingerprint while
+  logging originals: unique counts equal the distinct-canon count over the
+  full space (80 / 166 / 314 at rm=3/4/5), the discovery set equals host
+  DFS-sym's, and the host DFS golden 665 at rm=5 (reference recipe,
+  examples/2pc.rs:163-168) stays untouched;
+- ``spawn_tpu_sharded`` on an 8-device virtual mesh reproduces the same
+  counts (canonical fps route owners, so the reduction is mesh-invariant);
+- models with no canon capability fail the spawn loudly; the identity
+  canon (trap-counter fixture) changes nothing.
+"""
+
+from collections import deque
+from itertools import permutations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.models.fixtures import TrapCounter  # noqa: E402
+from stateright_tpu.models.twophase import (  # noqa: E402
+    TwoPhaseState,
+    TwoPhaseSys,
+)
+from stateright_tpu.parallel.canon import (  # noqa: E402
+    CanonSpec,
+    canon_batch_host,
+    canonicalize,
+    field,
+    make_canon,
+    validate_spec,
+)
+
+CANON_GOLDEN = {3: 80, 4: 166, 5: 314}  # distinct orbits, pinned below
+HOST_DFS_GOLDEN = {3: 107, 5: 665}  # tie-broken representative (DFS order)
+
+
+def _reachable(model):
+    seen, order, q = set(), [], deque(model.init_states())
+    while q:
+        s = q.popleft()
+        if s in seen:
+            continue
+        seen.add(s)
+        order.append(s)
+        q.extend(ns for ns in model.next_states(s) if ns not in seen)
+    return order
+
+
+def _permute(s: TwoPhaseState, perm) -> TwoPhaseState:
+    """Apply a genuine RM-index permutation (perm[new] = old)."""
+    inv = [0] * len(perm)
+    for new_i, old_i in enumerate(perm):
+        inv[old_i] = new_i
+    return TwoPhaseState(
+        rm_state=tuple(s.rm_state[o] for o in perm),
+        tm_state=s.tm_state,
+        tm_prepared=tuple(s.tm_prepared[o] for o in perm),
+        msgs=frozenset(
+            ("prepared", inv[m[1]]) if m[0] == "prepared" else m
+            for m in s.msgs
+        ),
+    )
+
+
+def _orbit_key(s: TwoPhaseState, perms):
+    def k(t):
+        n = len(t.rm_state)
+        prep = tuple(("prepared", i) in t.msgs for i in range(n))
+        rest = tuple(sorted(m for m in t.msgs if m[0] != "prepared"))
+        return (t.rm_state, t.tm_state, t.tm_prepared, prep, rest)
+
+    return min(k(_permute(s, p)) for p in perms)
+
+
+# --- the kernel --------------------------------------------------------------
+
+
+def _canon_np(model):
+    """(states, original rows, canonical rows) for the full reachable set."""
+    cm = model.compiled()
+    states = _reachable(model)
+    rows = np.stack([cm.encode(s) for s in states]).astype(np.uint32)
+    return cm, states, rows, canon_batch_host(cm, rows)
+
+
+@pytest.mark.parametrize("rm", [3, 4])
+def test_canon_idempotent_sound_and_orbit_exact(rm):
+    """Over the FULL reachable set: canon is idempotent, lands inside the
+    input's orbit, and partitions states exactly like brute-force orbit
+    enumeration — i.e. the full-record sort is a perfect canonicalization
+    (see docs/SYMMETRY.md for why perfection is what makes wavefront
+    counts traversal-invariant)."""
+    model = TwoPhaseSys(rm_count=rm)
+    cm, states, rows, canon = _canon_np(model)
+    # Idempotence: canon(canon(r)) == canon(r).
+    assert np.array_equal(canon_batch_host(cm, canon), canon)
+    perms = list(permutations(range(rm)))
+    canon_of, orbit_of = {}, {}
+    for s, crow in zip(states, canon.tolist()):
+        cs = cm.decode(crow)
+        # Soundness: the canonical state is a member of s's orbit.
+        assert _orbit_key(cs, perms) == _orbit_key(s, perms)
+        canon_of[s] = tuple(crow)
+        orbit_of[s] = _orbit_key(s, perms)
+    # Partition equality: same canon <=> same orbit, state by state.
+    for a in states:
+        for b in states:
+            if orbit_of[a] == orbit_of[b]:
+                assert canon_of[a] == canon_of[b], (a, b)
+    assert len(set(canon_of.values())) == len(set(orbit_of.values()))
+    assert len(set(canon_of.values())) == CANON_GOLDEN[rm]
+
+
+def test_canon_identity_on_empty_spec():
+    cm = TrapCounter().compiled()
+    rows = np.arange(8, dtype=np.uint32).reshape(8, 1)
+    assert np.array_equal(canon_batch_host(cm, rows), rows)
+
+
+def test_canon_id_field_remap():
+    """A per-record Id field and a global Id field both follow the
+    permutation (the device RewritePlan.rewrite), and sentinel values
+    >= n pass through unchanged."""
+    # Row layout: word0 = three 8-bit record keys; word1 bits 0-1 a
+    # per-record 2-bit Id field (stride 2); word2 a global 4-bit Id.
+    spec = CanonSpec(
+        n=3,
+        fields=(
+            field(word=0, shift=0, width=8),
+            field(word=1, shift=0, width=2, is_id=True),
+        ),
+        id_fields=(field(word=2, shift=0, width=4),),
+    )
+    validate_spec(spec, 3)
+
+    def canon(row):
+        return canonicalize(spec, row)
+
+    # Keys (30, 10, 20) sort to (10, 20, 30): order = [1, 2, 0], so the
+    # old->new mapping is 0->2, 1->0, 2->1.
+    keys = 30 | (10 << 8) | (20 << 16)
+    ids = 0 | (2 << 2) | (1 << 4)  # per-record ids: r0=0, r1=2, r2=1
+    row = jnp.asarray(np.array([keys, ids, 2], np.uint32))
+    out = np.asarray(jax.jit(canon)(row))
+    assert out[0] == 10 | (20 << 8) | (30 << 16)
+    # Records permuted to (r1, r2, r0) = ids (2, 1, 0), then each id
+    # value remapped old->new: 2->1, 1->0, 0->2.
+    assert out[1] == 1 | (0 << 2) | (2 << 4)
+    assert out[2] == 1  # global id 2 -> new index 1
+    # Sentinel: a global id >= n is not an index; it must pass through.
+    row2 = jnp.asarray(np.array([keys, ids, 9], np.uint32))
+    assert np.asarray(jax.jit(canon)(row2))[2] == 9
+
+
+def test_canon_validate_rejects_malformed():
+    with pytest.raises(ValueError, match="outside"):
+        validate_spec(
+            CanonSpec(n=2, fields=(field(word=5, shift=0, width=2),)), 2
+        )
+    with pytest.raises(ValueError, match="exceed"):
+        validate_spec(
+            CanonSpec(n=12, fields=(field(word=0, shift=16, width=2),)), 2
+        )
+    with pytest.raises(ValueError, match="too narrow"):
+        validate_spec(
+            CanonSpec(n=5, id_fields=(field(word=0, shift=0, width=2),)), 2
+        )
+    # Sort-key fields outside the fp_words identity prefix would make
+    # the permutation depend on non-identity data (silent count
+    # inflation); id fields there are harmless (they never shape the
+    # sort).
+    beyond = CanonSpec(
+        n=2, fields=(field(word=1, shift=0, width=4, word_stride=0),)
+    )
+    with pytest.raises(ValueError, match="identity prefix"):
+        validate_spec(beyond, 2, fp_words=1)
+    validate_spec(beyond, 2)  # full-identity models: fine
+    validate_spec(
+        CanonSpec(
+            n=2,
+            fields=(field(word=0, shift=0, width=4),),
+            id_fields=(field(word=1, shift=0, width=4),),
+        ),
+        2,
+        fp_words=1,
+    )
+
+
+# --- single-chip engine ------------------------------------------------------
+
+
+def _spawn_sym(model, **kw):
+    kw.setdefault("device", jax.devices("cpu")[0])
+    kw.setdefault("capacity", 1 << 14)
+    kw.setdefault("max_frontier", 1 << 9)
+    return model.checker().symmetry().spawn_tpu(**kw).join()
+
+
+def test_tpu_sym_2pc3_golden():
+    """Device-sym unique count == distinct canon rows over the full
+    space (the traversal-invariant orbit count), discovery set == host
+    DFS-sym's; host DFS keeps its own tie-broken count."""
+    model = TwoPhaseSys(rm_count=3)
+    tpu = _spawn_sym(model)
+    cm, _states, _rows, canon = _canon_np(model)
+    want = len({tuple(r) for r in canon.tolist()})
+    assert want == CANON_GOLDEN[3]
+    assert tpu.unique_state_count() == want
+    host = model.checker().symmetry().spawn_dfs().join()
+    assert host.unique_state_count() == HOST_DFS_GOLDEN[3]
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    # Paths re-execute the host model over ORIGINAL (logged) rows, so
+    # building them validates the store-original semantics.
+    for name, path in tpu.discoveries().items():
+        assert len(path) >= 1
+
+
+@pytest.mark.slow
+def test_tpu_sym_2pc5_goldens():
+    """rm=5, the reference's symmetry showcase: host DFS-sym reproduces
+    the reference golden 665 (examples/2pc.rs:163-168); the device
+    wavefront reports 314 — the exact orbit count, a strictly stronger
+    cut than the DFS-traversal-dependent 665 (8,832 full) — identically
+    on any chunk geometry, with the same discovery set.  Canon
+    idempotence rides along over the full 8,832-row set."""
+    model = TwoPhaseSys(rm_count=5)
+    host = model.checker().symmetry().spawn_dfs().join()
+    assert host.unique_state_count() == HOST_DFS_GOLDEN[5]
+
+    tpu = _spawn_sym(model, capacity=1 << 15, max_frontier=1 << 11)
+    assert tpu.unique_state_count() == CANON_GOLDEN[5]
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    # Chunk geometry must not change the count (traversal invariance).
+    narrow = _spawn_sym(model, capacity=1 << 15, max_frontier=1 << 6)
+    assert narrow.unique_state_count() == CANON_GOLDEN[5]
+
+    cm, _states, rows, canon = _canon_np(model)
+    assert len({tuple(r) for r in canon.tolist()}) == CANON_GOLDEN[5]
+    assert np.array_equal(canon_batch_host(cm, canon), canon)
+
+
+def test_tpu_sym_trap_counter_identity():
+    """The identity canon (empty spec): symmetry-on must match plain
+    runs exactly — counts AND discovery set — on the fixture with no
+    symmetric structure."""
+    model = TrapCounter()
+    dev = jax.devices("cpu")[0]
+    sym = (
+        model.checker().symmetry_fn(lambda s: s)
+        .spawn_tpu(capacity=1 << 10, max_frontier=1 << 4, device=dev)
+        .join()
+    )
+    plain = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 10, max_frontier=1 << 4, device=dev)
+        .join()
+    )
+    host = model.checker().symmetry_fn(lambda s: s).spawn_dfs().join()
+    assert sym.unique_state_count() == plain.unique_state_count()
+    assert sym.state_count() == plain.state_count()
+    assert sorted(sym.discoveries()) == sorted(plain.discoveries())
+    assert sorted(sym.discoveries()) == sorted(host.discoveries())
+    assert sym.discoveries()["reaches limit"].last_state() == model.trap_state
+
+
+def test_tpu_sym_requires_canon_capability():
+    """No canon spec -> loud spawn error, never a silent full-space run
+    reported as reduced."""
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(
+        client_count=2,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+    with pytest.raises(ValueError, match="canon"):
+        model.checker().symmetry_fn(lambda s: s).spawn_tpu()
+    with pytest.raises(ValueError, match="canon"):
+        model.checker().symmetry_fn(lambda s: s).spawn_tpu_sharded()
+
+
+def test_sym_snapshot_not_resumable_as_plain(tmp_path):
+    """A symmetry run's table holds canonical fingerprints; resuming it
+    without symmetry() must be rejected by the snapshot key."""
+    model = TwoPhaseSys(rm_count=3)
+    ck = _spawn_sym(model)
+    snap = str(tmp_path / "sym.npz")
+    ck.save_snapshot(snap)
+    with pytest.raises(ValueError, match="snapshot does not match"):
+        model.checker().spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 9, resume_from=snap
+        ).join()
+    resumed = model.checker().symmetry().spawn_tpu(
+        capacity=1 << 14, max_frontier=1 << 9, resume_from=snap
+    ).join()
+    assert resumed.unique_state_count() == CANON_GOLDEN[3]
+
+
+# --- sharded engine ----------------------------------------------------------
+
+
+def test_sharded_sym_2pc3_matches_single_chip():
+    """8-device virtual mesh: canonical fps route owners, so the mesh
+    reproduces the single-chip count exactly."""
+    model = TwoPhaseSys(rm_count=3)
+    sh = (
+        model.checker().symmetry()
+        .spawn_tpu_sharded(capacity=1 << 16, chunk_size=1 << 7)
+        .join()
+    )
+    assert sh.unique_state_count() == CANON_GOLDEN[3]
+    host = model.checker().symmetry().spawn_dfs().join()
+    assert sorted(sh.discoveries()) == sorted(host.discoveries())
+
+
+@pytest.mark.slow
+def test_sharded_sym_2pc5_matches_single_chip():
+    model = TwoPhaseSys(rm_count=5)
+    sh = (
+        model.checker().symmetry()
+        .spawn_tpu_sharded(capacity=1 << 16, chunk_size=1 << 7)
+        .join()
+    )
+    assert sh.unique_state_count() == CANON_GOLDEN[5]
+
+
+# --- canon capability resolution ---------------------------------------------
+
+
+def test_make_canon_resolution():
+    assert make_canon(TwoPhaseSys(rm_count=3).compiled()) is not None
+    assert make_canon(TrapCounter().compiled()) is not None
+
+    from stateright_tpu.parallel.compiled import CompiledModel
+
+    class NoCanon(CompiledModel):
+        state_width = 1
+        max_actions = 1
+
+    assert make_canon(NoCanon()) is None
+
+    class CustomCanon(CompiledModel):
+        state_width = 1
+        max_actions = 1
+
+        def canon_rows(self, state):
+            return state
+
+    assert make_canon(CustomCanon()) is not None

@@ -11,10 +11,8 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-from stateright_tpu import Model, Property  # noqa: E402
 from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
 from stateright_tpu.ops.device_fp import device_fp64  # noqa: E402
-from stateright_tpu.parallel.compiled import CompiledModel  # noqa: E402
 from stateright_tpu.ops.fingerprint import fp64_words  # noqa: E402
 from stateright_tpu.parallel.hashset import (  # noqa: E402
     insert_batch,
@@ -183,80 +181,13 @@ def test_target_max_depth_with_chunked_levels():
 
 # --- eventually-property machinery on device ---------------------------------
 
-
-class TrapCounter(Model):
-    """0 →inc→ 1 → … → limit, with a dead-end trap edge at ``trap_at``.
-
-    Exercises the full eventually pipeline: "reaches one" is satisfied along
-    every path (bit cleared mid-path, never reported); "reaches limit" has a
-    genuine counterexample ending in the trap terminal state.
-    """
-
-    def __init__(self, limit=5, trap_at=2):
-        self.limit = limit
-        self.trap_at = trap_at
-        self.trap_state = limit + 1
-
-    def init_states(self):
-        return [0]
-
-    def actions(self, state, actions):
-        if state < self.limit:
-            actions.append("inc")
-        if state == self.trap_at:
-            actions.append("trap")
-
-    def next_state(self, state, action):
-        return state + 1 if action == "inc" else self.trap_state
-
-    def properties(self):
-        return [
-            Property.eventually("reaches one", lambda _m, s: s >= 1),
-            Property.eventually(
-                "reaches limit", lambda _m, s: s == self.limit
-            ),
-            Property.sometimes(
-                "trapped", lambda _m, s: s == self.trap_state
-            ),
-        ]
-
-    def compiled(self):
-        return TrapCounterCompiled(self)
-
-
-class TrapCounterCompiled(CompiledModel):
-    state_width = 1
-    max_actions = 2
-
-    def __init__(self, model):
-        self.model = model
-
-    def encode(self, state):
-        return np.array([state], np.uint32)
-
-    def decode(self, words):
-        return int(words[0])
-
-    def step(self, state):
-        n = state[0]
-        limit = jnp.uint32(self.model.limit)
-        inc = jnp.stack([n + jnp.uint32(1)])
-        trap = jnp.stack([jnp.uint32(self.model.trap_state)])
-        nexts = jnp.stack([inc, trap])
-        valid = jnp.stack(
-            [n < limit, n == jnp.uint32(self.model.trap_at)]
-        )
-        return nexts, valid
-
-    def property_conds(self, state):
-        n = state[0]
-        return jnp.stack(
-            [
-                n >= jnp.uint32(1),
-                n == jnp.uint32(self.model.limit),
-                n == jnp.uint32(self.model.trap_state),
-            ]
-        )
+# The fixture moved to the package (models/fixtures.py) so the symmetry
+# tests and PARITY's compiled-model inventory can reference it; re-exported
+# here for the sibling test modules that import it from this one.
+from stateright_tpu.models.fixtures import (  # noqa: E402
+    TrapCounter,
+    TrapCounterCompiled,  # noqa: F401  (re-export)
+)
 
 
 def test_eventually_parity_with_host():
