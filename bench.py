@@ -22,9 +22,14 @@ it; every earlier line is a valid fallback record from an earlier phase):
            exists.  If the two-phase expansion path fails, the run falls
            back to the single-phase step kernel (and says so in the
            record) rather than dying.
-  phase 2+ optional phases (symmetry on/off cut, ttfv, sharded smoke,
-           reference suite) add keys and re-emit; they can never zero
-           earlier lines.  The reference suite re-emits after EVERY
+  phase 2+ optional phases (native C++ denominator bound, roofline
+           trace, symmetry on/off cut, ttfv, sharded smoke + measured
+           exchange occupancy, reference suite) add keys and re-emit;
+           they can never zero earlier lines.  The observability keys —
+           `wave_breakdown`, `hbm_util_frac`, `bottleneck_phase`,
+           `exchange_occupancy`, `denominator_native` (VERDICT r5 weak
+           #6/#9, docs/OBSERVABILITY.md) — come from phase_trace,
+           phase_sharded_smoke, and phase_denominator_native.  The reference suite re-emits after EVERY
            workload child, so a deadline kill mid-suite keeps the
            completed workloads in the artifact.  Discovered tuned_kwargs
            persist in a knob cache (.bench_knobs/, runtime/knob_cache.py)
@@ -63,6 +68,13 @@ import traceback
 _REPO = pathlib.Path(__file__).resolve().parent
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_REPO / ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# Virtual CPU shards for the measured-exchange phase (must be set before
+# any jax import; appended so a driver-supplied XLA_FLAGS survives).
+_XLA_FLAGS = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _XLA_FLAGS:
+    os.environ["XLA_FLAGS"] = (
+        _XLA_FLAGS + " --xla_force_host_platform_device_count=8"
+    ).strip()
 sys.path.insert(0, str(_REPO))
 
 # One resilience implementation: the transient-failure classification and
@@ -637,6 +649,167 @@ def phase_sharded_smoke(record: dict) -> None:
         ),
     }
 
+    # MEASURED exchange metrics on a real multi-shard mesh: the 1-device
+    # smoke elides the exchange entirely, so the occupancy evidence comes
+    # from the 8-shard virtual CPU mesh (the same mesh the weak-scaling
+    # table in docs/SHARDED_SCALING.md is generated on) — per-shard
+    # candidate counters measured by the engine, golden-gated.
+    cpu_devs = jax.devices("cpu")
+    if len(cpu_devs) >= 8:
+        mesh8 = jax.sharding.Mesh(np.array(cpu_devs[:8]), ("shards",))
+        c8 = run_device(
+            lambda: paxos_model(2).checker().spawn_tpu_sharded(
+                mesh=mesh8, capacity=1 << 16, chunk_size=1 << 9
+            )
+        )
+        assert c8.unique_state_count() == 16_668, (
+            f"virtual-8 paxos2 unique={c8.unique_state_count()} != 16668"
+        )
+        acc8 = c8.accounting()
+        record["exchange_occupancy"] = round(acc8["exchange_occupancy"], 6)
+        record["sharded_virtual8"] = {
+            "waves": acc8["waves"],
+            "exchange_occupancy": round(acc8["exchange_occupancy"], 6),
+            "exchange_payload_bytes_total": acc8[
+                "exchange_payload_bytes_total"
+            ],
+            "all_to_all_bytes_total": acc8["all_to_all_bytes_total"],
+            "unique_skew_max_over_mean": round(
+                acc8["unique_skew_max_over_mean"], 4
+            ),
+        }
+        log(
+            f"sharded virtual-8: paxos2 occupancy="
+            f"{acc8['exchange_occupancy']:.4f} payload="
+            f"{acc8['exchange_payload_bytes_total']} B useful of "
+            f"{acc8['all_to_all_bytes_total']} B transmitted"
+        )
+    else:
+        # Elided exchange moves zero bytes; the identity occupancy ×
+        # transmitted = useful still holds at 0.0.
+        record["exchange_occupancy"] = round(acc["exchange_occupancy"], 6)
+
+
+def phase_trace(record: dict, tuned: dict) -> None:
+    """Roofline trace of the headline workload (VERDICT r5 weak #6: BENCH
+    reported states/sec and nothing else): run `paxos check 3` with
+    trace=True at the headline's tuned sizes, golden-gate it, and emit
+    `wave_breakdown` (per-phase seconds; the phases partition the traced
+    wall time, so they sum to the measured wave time by construction),
+    `hbm_util_frac` (modeled bytes / measured time / device peak,
+    obs/roofline.py), and the named `bottleneck_phase`.  The traced rate
+    is NOT the headline — per-wave dispatch+sync overhead is the
+    documented trace cost (`trace_overhead_vs_fused` quantifies it)."""
+    def spawn(**extra):
+        b = paxos_model(3).checker()
+        for k, v in extra.items():
+            getattr(b, k)(v)
+        return b.spawn_tpu(trace=True, **tuned)
+
+    # Budget-gated like every other open-ended phase: the traced run is
+    # deliberately un-fused (per-wave sync — on a tunneled device each
+    # is ~100-170 ms) and must never eat the suite phases' budget.  The
+    # builder timeout is a hard stop; a timed-out partial run fails the
+    # golden gate below and the phase is skipped, headline intact.
+    if budget_remaining() < 600.0:
+        record["trace_skipped"] = (
+            "global time budget too low for a traced headline run "
+            f"({budget_remaining():.0f}s remaining)"
+        )
+        log(f"trace: {record['trace_skipped']}")
+        return
+    # Bounded warm-up: a few waves compile every phase program without
+    # paying a full traced run twice; the measured run below is warm.
+    run_device(lambda: spawn(target_state_count=50_000))
+    t_cap = max(120.0, budget_remaining() - 300.0)
+    ck, dt = run_device_timed(lambda: spawn(timeout=t_cap))
+    unique, depth = ck.unique_state_count(), ck.max_depth()
+    if (unique, depth) != (GOLDEN_UNIQUE, GOLDEN_DEPTH):
+        raise AssertionError(
+            f"trace phase golden mismatch: unique={unique} depth={depth}"
+            f" != {GOLDEN_UNIQUE}/{GOLDEN_DEPTH}"
+        )
+    s = ck.trace_summary()
+    record["wave_breakdown"] = s["wave_breakdown"]
+    record["wave_breakdown_frac"] = s["wave_breakdown_frac"]
+    record["hbm_util_frac"] = s["hbm_util_frac"]
+    record["hbm_peak_bytes_per_sec"] = s["hbm_peak_bytes_per_sec"]
+    record["hbm_peak_estimated"] = s["hbm_peak_estimated"]
+    record["trace_workload"] = "paxos_check_3"
+    record["trace_sec"] = round(dt, 2)
+    if record.get("tpu_wallclock_sec"):
+        record["trace_overhead_vs_fused"] = round(
+            dt / record["tpu_wallclock_sec"], 2
+        )
+    # The bottleneck names a DEVICE phase: the host-side readback is the
+    # trace instrumentation's own documented cost, not an engine phase,
+    # and on a tunneled device it can dominate the per-wave wall time.
+    from stateright_tpu.obs.trace import HOST_PHASES
+
+    device_phases = {
+        k: v for k, v in s["wave_breakdown"].items() if k not in HOST_PHASES
+    }
+    record["bottleneck_phase"] = max(device_phases, key=device_phases.get)
+    log(
+        f"trace: paxos3 breakdown {s['wave_breakdown_frac']} "
+        f"hbm_util={s['hbm_util_frac']} "
+        f"bottleneck={record['bottleneck_phase']}"
+    )
+
+
+def phase_denominator_native(record: dict) -> None:
+    """Honest-denominator bound (VERDICT r5 weak #9): the single-threaded
+    C++ hot-loop BFS in native/stateright_core.cpp on direct 2pc —
+    successor generation + fingerprint + dedup only, NO property
+    evaluation — so the number is an UPPER bound on a native
+    single-thread checker's inner loop.  README's vs_baseline framing
+    cites this phase.  Gated on the reference golden (2pc(5) = 8,832)
+    before any rate is posted; the measured workload is the suite's
+    biggest pinned golden when the budget allows."""
+    from stateright_tpu.ops.native import available, twophase_bfs_native
+
+    if not available():
+        record["denominator_native"] = {
+            "error": "no C++ toolchain for the native core"
+        }
+        return
+    gate = twophase_bfs_native(5)
+    assert gate["unique_states"] == 8_832, (
+        f"native 2pc(5) unique={gate['unique_states']} != 8832"
+    )
+    if budget_remaining() > 900.0:
+        n, want = 10, 61_515_776  # the suite's 2pc_check_10 pin
+    else:
+        n, want = 8, None  # self-measured scale point, no golden exists
+    t0 = time.time()
+    r = twophase_bfs_native(n)
+    dt = time.time() - t0
+    if want is not None and r["unique_states"] != want:
+        raise AssertionError(
+            f"native 2pc({n}) unique={r['unique_states']} != {want}"
+        )
+    record["denominator_native"] = {
+        "workload": f"2pc_check_{n}",
+        "impl": (
+            "single-thread C++ hot-loop BFS (successor gen + fingerprint "
+            "+ dedup; no property evaluation, no paths)"
+        ),
+        "unique_states": r["unique_states"],
+        "golden_gated": want is not None,
+        "sec": round(dt, 2),
+        "unique_states_per_sec": round(r["unique_states"] / dt, 1),
+        "note": (
+            "upper bound on a native single-thread checker's inner "
+            "loop; multiply by core count for an optimistic parallel "
+            "bound (the reference's Rust checker also evaluates "
+            "properties and tracks paths, which this loop omits)"
+        ),
+    }
+    log(
+        f"denominator_native: 2pc({n}) {r['unique_states']} unique in "
+        f"{dt:.2f}s = {r['unique_states'] / dt:.0f} uniq/s (C++ 1 thread)"
+    )
+
 
 def _force_single_phase() -> bool:
     """Disable the two-phase expansion path (engine falls back to the
@@ -830,6 +1003,10 @@ def main() -> None:
     # worker, and although each now runs in its own subprocess, keeping
     # the parent's device use front-loaded is free insurance.
     for phase_name, phase in (
+        # denominator_native is host-only C++ (no device risk) and cheap
+        # at its gate size; trace reuses the headline's tuned sizes.
+        ("denominator_native", phase_denominator_native),
+        ("trace", lambda r: phase_trace(r, tuned)),
         ("symmetry", phase_symmetry),
         ("ttfv", lambda r: phase_ttfv(r, threads, tuned)),
         ("sharded_smoke", phase_sharded_smoke),

@@ -227,4 +227,158 @@ int32_t sr_fpset_contains(void* set_ptr, uint64_t fp) {
   return sr_fpset_get_parent(set_ptr, fp, &unused);
 }
 
+// --- direct 2pc hot-loop BFS (the honest native denominator) ----------------
+//
+// The bench's vs_baseline ratio divides by this package's pure-Python BFS;
+// this function is the native bound that framing cites (bench.py's
+// `denominator_native` phase): a single-threaded C++ BFS of the direct
+// two-phase-commit model — successor generation, 64-bit fingerprinting
+// (the mixer above, bit-identical to the framework's), and dedup into an
+// open-addressing visited set.  No property evaluation, no path
+// reconstruction, no parent tracking: an UPPER bound on what a native
+// single-thread checker's inner loop achieves, by construction.
+//
+// The packed encoding is models/twophase_compiled.py's, word for word:
+//   w0: RM states, 2 bits each at bit 2*i (WORKING=0 / PREPARED=1 /
+//       COMMITTED=2 / ABORTED=3); TM state (INIT=0/COMMITTED=1/ABORTED=2)
+//       at bit 24.
+//   w1: tm_prepared bitmap at [0, n); Prepared(i) message at bit n+i;
+//       Commit at 2n; Abort at 2n+1.
+// so the golden counts (288 at 3 RMs, 8,832 at 5, 61,515,776 at 10 —
+// examples/2pc.rs + the suite pins) gate correctness end to end.
+
+namespace {
+
+// Minimal single-thread open-addressing fp set: the leanest possible
+// dedup hot loop (the concurrent FpSet above pays stripe locks and
+// atomics this single-thread bound should not).
+struct LocalFpSet {
+  std::vector<uint64_t> keys;  // 0 = empty (fps are nonzero)
+  uint64_t mask;
+  uint64_t count = 0;
+
+  explicit LocalFpSet(uint64_t cap_pow2)
+      : keys(cap_pow2, 0), mask(cap_pow2 - 1) {}
+
+  void grow() {
+    std::vector<uint64_t> old;
+    old.swap(keys);
+    keys.assign((mask + 1) * 2, 0);
+    mask = mask * 2 + 1;
+    for (uint64_t key : old) {
+      if (key == 0) continue;
+      uint64_t idx = home_of(key, mask);
+      while (keys[idx] != 0) idx = (idx + 1) & mask;
+      keys[idx] = key;
+    }
+  }
+
+  // True iff newly inserted.
+  bool insert(uint64_t fp) {
+    if (count * 2 >= mask + 1) grow();
+    uint64_t idx = home_of(fp, mask);
+    for (;;) {
+      uint64_t cur = keys[idx];
+      if (cur == 0) {
+        keys[idx] = fp;
+        ++count;
+        return true;
+      }
+      if (cur == fp) return false;
+      idx = (idx + 1) & mask;
+    }
+  }
+};
+
+inline uint64_t tp_fp(uint64_t state) {
+  uint32_t words[2] = {static_cast<uint32_t>(state),
+                       static_cast<uint32_t>(state >> 32)};
+  return sr_fp64_words(words, 2);
+}
+
+}  // namespace
+
+// Exhaustive single-threaded BFS of direct 2pc with n_rms RMs (<= 12, the
+// packed layout's bound).  Writes unique/generated/depth counts; returns
+// 0 on completion, -1 on bad arguments or when unique states exceed
+// max_unique (0 = unlimited) — a caller-supplied memory guard, not an
+// error of the model.
+int32_t sr_twophase_bfs(uint32_t n_rms, uint64_t max_unique,
+                        uint64_t* unique_out, uint64_t* generated_out,
+                        uint64_t* depth_out) {
+  if (n_rms == 0 || n_rms > 12) return -1;
+  const uint32_t n = n_rms;
+  const uint32_t tm_shift = 24;
+  const uint32_t prepared_mask = (1u << n) - 1;
+  const uint64_t commit_bit = 1ull << (32 + 2 * n);
+  const uint64_t abort_bit = 1ull << (32 + 2 * n + 1);
+
+  LocalFpSet seen(1 << 16);
+  std::vector<uint64_t> frontier, next;
+  // depth counts states on the deepest path (init level = 1), the
+  // framework's max_depth convention (suite pin: 2pc(10) -> 32).
+  uint64_t generated = 0, depth = 1;
+
+  const uint64_t init = 0;  // all RMs WORKING, TM INIT, no msgs
+  seen.insert(tp_fp(init));
+  frontier.push_back(init);
+  ++generated;  // init states count, like the framework's state_count
+
+  auto emit = [&](uint64_t s) {
+    ++generated;
+    if (seen.insert(tp_fp(s))) next.push_back(s);
+  };
+
+  while (!frontier.empty()) {
+    if (max_unique != 0 && seen.count > max_unique) return -1;
+    next.clear();
+    for (uint64_t s : frontier) {
+      const uint32_t w0 = static_cast<uint32_t>(s);
+      const uint32_t w1 = static_cast<uint32_t>(s >> 32);
+      const bool tm_init = ((w0 >> tm_shift) & 3u) == 0;
+      const bool all_prepared = (w1 & prepared_mask) == prepared_mask;
+      const bool commit_msg = (s & commit_bit) != 0;
+      const bool abort_msg = (s & abort_bit) != 0;
+      const uint64_t tm_cleared = s & ~(3ull << tm_shift);
+
+      if (tm_init && all_prepared) {  // TmCommit
+        emit((tm_cleared | (1ull << tm_shift)) | commit_bit);
+      }
+      if (tm_init) {  // TmAbort
+        emit((tm_cleared | (2ull << tm_shift)) | abort_bit);
+      }
+      for (uint32_t rm = 0; rm < n; ++rm) {
+        const uint32_t rm_bits = (w0 >> (2 * rm)) & 3u;
+        const bool working = rm_bits == 0;
+        const bool prep_msg = (w1 >> (n + rm)) & 1u;
+        const uint64_t rm_cleared = s & ~(3ull << (2 * rm));
+        if (tm_init && prep_msg) {  // TmRcvPrepared(rm)
+          emit(s | (1ull << (32 + rm)));
+        }
+        if (working) {  // RmPrepare(rm)
+          emit((rm_cleared | (1ull << (2 * rm))) |
+               (1ull << (32 + n + rm)));
+        }
+        if (working) {  // RmChooseToAbort(rm)
+          emit(rm_cleared | (3ull << (2 * rm)));
+        }
+        if (commit_msg) {  // RmRcvCommitMsg(rm)
+          emit(rm_cleared | (2ull << (2 * rm)));
+        }
+        if (abort_msg) {  // RmRcvAbortMsg(rm)
+          emit(rm_cleared | (3ull << (2 * rm)));
+        }
+      }
+    }
+    if (next.empty()) break;
+    ++depth;
+    frontier.swap(next);
+  }
+
+  if (unique_out) *unique_out = seen.count;
+  if (generated_out) *generated_out = generated;
+  if (depth_out) *depth_out = depth;
+  return 0;
+}
+
 }  // extern "C"

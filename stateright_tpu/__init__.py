@@ -24,9 +24,12 @@ from .core.report import (
     Reporter,
     WriteReporter,
 )
+from .obs import MetricsRegistry, WaveTracer
 from .ops.fingerprint import fingerprint
 
 __all__ = [
+    "MetricsRegistry",
+    "WaveTracer",
     "Model",
     "Property",
     "Expectation",

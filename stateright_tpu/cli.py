@@ -40,7 +40,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
     lines.append(f"  check-simulation [{n_meta}] [SEED]{net}")
     if spec.tpu:
         lines.append(f"  check-tpu [{n_meta}]{net}"
-                     " [--supervise] [--checkpoint-dir DIR] [--resume]")
+                     " [--supervise] [--checkpoint-dir DIR] [--resume]"
+                     " [--trace]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     if spec.spawn is not None:
         lines.append(
@@ -95,10 +96,11 @@ def _parse_n(args, default):
 def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
-    ``(positional_args, supervise, checkpoint_dir, resume)`` or raises
-    ``ValueError`` on a malformed flag."""
+    ``(positional_args, supervise, checkpoint_dir, resume, trace)`` or
+    raises ``ValueError`` on a malformed flag."""
     supervise = False
     resume = False
+    trace = False
     ckpt_dir = None
     out = []
     i = 0
@@ -108,6 +110,8 @@ def _extract_runtime_flags(args):
             supervise = True
         elif a == "--resume":
             resume = True
+        elif a == "--trace":
+            trace = True
         elif a == "--checkpoint-dir":
             i += 1
             if i >= len(args):
@@ -125,7 +129,7 @@ def _extract_runtime_flags(args):
         else:
             out.append(a)
         i += 1
-    return out, supervise, ckpt_dir, resume
+    return out, supervise, ckpt_dir, resume, trace
 
 
 def _parse_chaos_flags(args):
@@ -327,7 +331,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
         return 0
     sub = args.pop(0)
     try:
-        args, supervise, ckpt_dir, resume = _extract_runtime_flags(args)
+        args, supervise, ckpt_dir, resume, trace = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
         return 2
@@ -335,6 +339,24 @@ def example_main(spec: CliSpec, argv=None) -> int:
         print(
             "--supervise/--checkpoint-dir/--resume require the check-tpu "
             "subcommand (the host engines have no snapshot support)",
+            file=sys.stderr,
+        )
+        return 2
+    if trace and sub != "check-tpu":
+        print(
+            "--trace requires the check-tpu subcommand (phase-timed "
+            "tracing instruments the device wave loop; "
+            "docs/OBSERVABILITY.md)",
+            file=sys.stderr,
+        )
+        return 2
+    if trace and (supervise or resume):
+        # Traced runs are diagnostic and do not support resume; a
+        # supervised child auto-resumes on restart, so the combination
+        # is refused loudly instead of dying mid-restart.
+        print(
+            "--trace cannot be combined with --supervise/--resume "
+            "(traced runs do not resume; run the trace unsupervised)",
             file=sys.stderr,
         )
         return 2
@@ -399,10 +421,22 @@ def example_main(spec: CliSpec, argv=None) -> int:
             tpu_kwargs = dict(spec.tpu_kwargs)
             if ckpt_dir is not None:
                 tpu_kwargs.update(_checkpointed_tpu_kwargs(ckpt_dir, resume))
+            if trace:
+                # Phase-timed wave tracing (docs/OBSERVABILITY.md); with
+                # --checkpoint-dir the enriched wave records land in the
+                # run dir's journal.jsonl — the wave-trace artifact.
+                tpu_kwargs["trace"] = True
             checker = builder.spawn_tpu(**tpu_kwargs)
         else:
             checker = builder.spawn_bfs()
         checker.join_and_report(WriteReporter(sys.stdout))
+        if sub == "check-tpu" and trace:
+            # One parseable line with the roofline reduction, so shell
+            # pipelines (and the CI trace smoke) can gate on it without
+            # reading the journal.
+            import json as _json
+
+            print("trace: " + _json.dumps(checker.trace_summary()))
         return 0
 
     if sub == "check-simulation":

@@ -112,7 +112,14 @@ class CheckerBuilder:
         src/checker/bfs.rs:177-335).  With ``symmetry()``, dedup keys on
         the canonical row's fingerprint via the compiled model's canon
         spec (parallel/canon.py) while logging original rows; models
-        without a canon spec fail the spawn loudly."""
+        without a canon spec fail the spawn loudly.
+
+        ``trace=True`` runs the wave loop in phase-timed segments with
+        roofline byte accounting (obs/, docs/OBSERVABILITY.md).  Coarse
+        wave-granularity visitors are supported via the traced readback
+        path: a ``visitor()`` forces tracing on and receives every
+        unique state once, at expansion, as a single-state path — BFS
+        level order across waves, fingerprint-sorted within a level."""
         self._require("stateright_tpu.parallel.wavefront", "TPU wavefront checker")
         from ..parallel.wavefront import TpuChecker
 
@@ -195,6 +202,21 @@ class Checker:
 
     def run_to_completion(self) -> None:
         pass  # only meaningful for on-demand checking
+
+    def metrics(self) -> dict:
+        """Live observability snapshot — counts every engine has; the
+        device engines extend it with their registry (wave cadence,
+        table occupancy, device-call time) and, under ``trace=True``,
+        the roofline trace summary.  Served by the Explorer's
+        ``GET /.metrics`` (docs/OBSERVABILITY.md names the fields);
+        never blocks on a still-running checker."""
+        return {
+            "engine": type(self).__name__,
+            "done": self.is_done(),
+            "state_count": self.state_count(),
+            "unique_state_count": self.unique_state_count(),
+            "max_depth": self.max_depth(),
+        }
 
     # --- shared functionality -----------------------------------------------
 

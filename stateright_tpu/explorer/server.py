@@ -8,6 +8,11 @@ checker, and serves:
 - ``GET /.status`` — ``StatusView`` JSON: done, model type name, counts,
   properties with encoded discovery paths, a recently-visited path
   (src/checker/explorer.rs:171-190);
+- ``GET /.metrics`` — the checker's live ``metrics()`` snapshot (this
+  package's addition; the reference has no metrics surface): counts for
+  every engine, plus wave cadence / table occupancy / device-call time
+  for the TPU engines and the roofline trace summary under ``trace=True``
+  (docs/OBSERVABILITY.md);
 - ``GET /.states/{fp1}/{fp2}/...`` — the successor ``StateView`` list for
   the state reached by re-executing the fingerprint path (404 on a bad
   path), each visit nudging the background checker via
@@ -187,7 +192,10 @@ def serve(builder, address, block: bool = True, engine: str = "on_demand",
     if engine == "on_demand":
         checker = builder.visitor(snapshot).spawn_on_demand(**engine_kwargs)
     elif engine == "tpu":
-        # The wavefront rejects visitors; the recent-path pane stays empty.
+        # Deliberately NO snapshot visitor: a visitor forces the traced
+        # per-wave loop (docs/OBSERVABILITY.md), which would slow the
+        # exhaustive background run the UI is watching.  The recent-path
+        # pane stays empty; live counts come from /.status and /.metrics.
         checker = builder.spawn_tpu(**engine_kwargs)
     else:
         raise ValueError(f"unknown explorer engine {engine!r}")
@@ -222,6 +230,16 @@ def serve(builder, address, block: bool = True, engine: str = "on_demand",
                 try:
                     self._send_json(_status_view(checker, snapshot))
                 except Exception as e:  # surface, don't reset the connection
+                    self._send(500, str(e).encode(), "text/plain")
+            elif url == "/.metrics":
+                # The live observability surface beside /.status: the
+                # checker's metrics() snapshot (counts for every engine;
+                # the device engines add wave cadence, table occupancy,
+                # device-call totals, and — traced — the roofline
+                # summary).  Names: docs/OBSERVABILITY.md.
+                try:
+                    self._send_json(checker.metrics())
+                except Exception as e:
                     self._send(500, str(e).encode(), "text/plain")
             elif url.startswith("/.states"):
                 try:

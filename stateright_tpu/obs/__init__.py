@@ -1,0 +1,40 @@
+"""Observability for the TPU engines: metrics, trace spans, roofline.
+
+Three small pieces, composed by the wavefront engines
+(parallel/wavefront.py, parallel/sharded.py) and surfaced through the
+runtime journal, the Explorer's ``GET /.metrics`` endpoint, the CLI's
+``check-tpu --trace``, and ``bench.py``:
+
+- :mod:`.metrics` — a thread-safe name->value registry every checker
+  carries; counters and gauges the host loop updates from the scalars it
+  already reads back (no extra device syncs with ``trace=False``).
+- :mod:`.trace` — per-wave phase-timed trace spans: with ``trace=True``
+  the engines run the wave loop in separately-dispatched phase programs
+  (step kernel / canon+fingerprint / dedup-sort+probe / exchange /
+  append / host readback) and record seconds + modeled bytes per phase.
+- :mod:`.roofline` — the per-device-peak table and the bytes-touched
+  model that reduce a wave's phase records into ``hbm_util_frac``
+  (fraction of the device's peak HBM bandwidth the wave achieved).
+
+Schema and methodology: docs/OBSERVABILITY.md.
+"""
+
+from .metrics import MetricsRegistry
+from .roofline import (
+    DEVICE_PEAKS,
+    hbm_util_frac,
+    peaks_for_device,
+    probe_bytes,
+    sort_bytes,
+)
+from .trace import WaveTracer
+
+__all__ = [
+    "DEVICE_PEAKS",
+    "MetricsRegistry",
+    "WaveTracer",
+    "hbm_util_frac",
+    "peaks_for_device",
+    "probe_bytes",
+    "sort_bytes",
+]

@@ -1,0 +1,52 @@
+"""A small thread-safe metrics registry.
+
+Every checker carries one (the engine host loop writes, the Explorer's
+``GET /.metrics`` endpoint and ``Checker.metrics()`` read).  Deliberately
+minimal — flat names, numeric values, one lock — because the write side
+sits on the engine host loop: a wave record is a handful of dict stores,
+never a device sync.  Metric names are part of the observable surface and
+documented in docs/OBSERVABILITY.md; changing one is a breaking change to
+anything scraping ``/.metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Union
+
+Number = Union[int, float]
+
+
+class MetricsRegistry:
+    """Flat name -> value store with counter and gauge semantics.
+
+    ``inc`` accumulates (counters: monotone over a run), ``set``
+    overwrites (gauges: last-value-wins).  ``snapshot()`` returns a plain
+    dict copy safe to serialize while writers keep running.
+    """
+
+    def __init__(self, **initial: Number):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Number] = dict(initial)
+
+    def inc(self, name: str, delta: Number = 1) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + delta
+
+    def set(self, name: str, value: Number) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def update(self, **values: Number) -> None:
+        """Set several gauges under one lock acquisition (the per-wave
+        hot path writes ~10 values)."""
+        with self._lock:
+            self._values.update(values)
+
+    def get(self, name: str, default: Optional[Number] = None):
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self) -> Dict[str, Number]:
+        with self._lock:
+            return dict(self._values)

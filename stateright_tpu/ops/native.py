@@ -103,6 +103,14 @@ def load():
         ]
         lib.sr_fpset_contains.restype = ctypes.c_int32
         lib.sr_fpset_contains.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.sr_twophase_bfs.restype = ctypes.c_int32
+        lib.sr_twophase_bfs.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         _lib = lib
         return _lib
 
@@ -190,6 +198,37 @@ class NativeFpSet:
         ptr, self._ptr = getattr(self, "_ptr", None), None
         if ptr:
             self._lib.sr_fpset_free(ptr)
+
+
+def twophase_bfs_native(n_rms: int, max_unique: int = 0) -> Optional[dict]:
+    """Exhaustive single-threaded C++ BFS of the direct two-phase-commit
+    model (native/stateright_core.cpp: packed successor generation +
+    fingerprint + open-addressing dedup, NO property evaluation) — the
+    honest-denominator hot loop bench.py's ``denominator_native`` phase
+    measures.  Returns ``{"unique_states", "generated", "max_depth"}``,
+    None if the native core is unavailable.  Raises on bad arguments or
+    a blown ``max_unique`` memory guard (0 = unlimited)."""
+    lib = load()
+    if lib is None:
+        return None
+    unique = ctypes.c_uint64()
+    generated = ctypes.c_uint64()
+    depth = ctypes.c_uint64()
+    rc = lib.sr_twophase_bfs(
+        n_rms, max_unique, ctypes.byref(unique), ctypes.byref(generated),
+        ctypes.byref(depth),
+    )
+    if rc != 0:
+        raise RuntimeError(
+            f"sr_twophase_bfs(n_rms={n_rms}, max_unique={max_unique}) "
+            f"failed (rc={rc}): bad arguments or unique-state guard "
+            "exceeded"
+        )
+    return {
+        "unique_states": unique.value,
+        "generated": generated.value,
+        "max_depth": depth.value,
+    }
 
 
 def available() -> bool:

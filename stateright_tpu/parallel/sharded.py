@@ -131,6 +131,7 @@ class ShardedTpuChecker(Checker):
         checkpoint_path: Optional[str] = None,
         checkpoint_every_waves: Optional[int] = None,
         checkpoint_every_sec: Optional[float] = None,
+        trace: bool = False,
     ):
         """Same checkpoint/journal hooks as the single-chip engine
         (wavefront.py): ``journal`` streams wave-level telemetry as JSON
@@ -138,12 +139,30 @@ class ShardedTpuChecker(Checker):
         atomic mid-run snapshots, and ``resume_from`` continues a saved
         run.  A sharded snapshot is bound to the MESH SIZE (global ids
         encode the owner shard), but adopts the snapshot's per-shard
-        capacity and chunk geometry as data."""
+        capacity and chunk geometry as data.
+
+        ``trace``: run the wave loop in phase-timed segments (step /
+        canon+fp / dedup-sort+probe / exchange / append / host
+        readback), one host sync per wave, with roofline byte accounting
+        per phase AND the exchange instrumented live — measured payload
+        bytes and lane occupancy PER WAVE in the journal (the fused
+        loop only totals them at run end).  Same kernels and commit
+        order as the fused loop; throughput is not comparable (per-wave
+        dispatch+sync).  ``trace=False`` leaves the fused single-program
+        path byte-for-byte unchanged.  Traced runs do not support
+        ``resume_from``; docs/OBSERVABILITY.md states the contract."""
         super().__init__(options.model)
         import jax
 
         if options._visitor is not None:
             raise ValueError("spawn_tpu_sharded() does not support visitors")
+        self._trace = bool(trace)
+        if self._trace and resume_from is not None:
+            raise ValueError(
+                "spawn_tpu_sharded(trace=True) does not support "
+                "resume_from: tracing is a diagnostic mode; resume "
+                "untraced and trace a fresh (bounded) run instead"
+            )
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
         # Symmetry: dedup — and therefore OWNER ROUTING — keys on the
@@ -240,6 +259,10 @@ class ShardedTpuChecker(Checker):
         self._discoveries_cache: Optional[Dict[str, Path]] = None
         self._accounting: dict = {}
         self._resume_from = resume_from
+        from ..obs.metrics import MetricsRegistry
+
+        self._metrics = MetricsRegistry()
+        self._tracer = None  # built by the traced host loop
         from ..runtime.journal import as_journal
 
         self._journal = as_journal(journal)
@@ -803,7 +826,591 @@ class ShardedTpuChecker(Checker):
         finally:
             self._done.set()
 
+    # --- traced (phase-timed) mode -------------------------------------------
+
+    def _traced_programs(self):
+        """Phase-program set for ``trace=True``, cached like the fused
+        program.  Host-driven knobs (waves, finish_when, depth gating)
+        are not baked in — the traced loop decides them per wave."""
+        key = (
+            "traced",
+            self._compiled.cache_key(),
+            hasattr(self._compiled, "step_valid")
+            and hasattr(self._compiled, "step_lane"),
+            self._canon is not None,
+            self._cap_s,
+            self._chunk,
+            self._dedup_factor,
+            tuple((d.platform, d.id) for d in self._mesh.devices.flat),
+            tuple(p.expectation for p in self._properties),
+        )
+        from .wave_common import cached_program
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced
+        )
+
+    def _build_traced(self):
+        """The sharded wave as six separately-dispatched shard_map phase
+        programs — the same kernels as the fused ``body``, cut at the
+        roofline's phase boundaries (step kernel / canon+fp / local
+        dedup-sort / exchange / table insert / append), with level and
+        termination bookkeeping moved to the host (per-shard control
+        scalars ride a tiny uploaded ctrl vector; all cross-shard
+        reductions become host sums over per-shard outputs, so the only
+        collective left is the all_to_all itself)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import (
+            HashSet, compact_valid_indices, insert_batch_compact, prededup,
+        )
+        from .wave_common import wave_eval
+
+        cm = self._compiled
+        w = cm.state_width
+        fpw = cm.fp_words or w
+        canon = self._canon
+
+        def fp_of(rows):
+            rows_c = rows if canon is None else jax.vmap(canon)(rows)
+            return device_fp64(rows_c[:, :fpw])
+
+        a = cm.max_actions
+        f = self._chunk
+        n = self._n
+        cap_s = self._cap_s
+        qcap = cap_s
+        slot_bits = self._slot_bits
+        props = self._properties
+        ev_indices = self._ev_indices
+        dedup_factor = self._dedup_factor
+        b = f * a
+        u = jnp.uint32
+        shard = P("shards")
+
+        def sharded(fn, n_in, donate=()):
+            return jax.jit(
+                _shard_map(
+                    fn, mesh=self._mesh,
+                    in_specs=(shard,) * n_in, out_specs=shard,
+                ),
+                donate_argnums=donate,
+            )
+
+        def step_shard(store, ebits, queue, disc, ctrl):
+            me = jax.lax.axis_index("shards").astype(u)
+            level_start = ctrl[0, 0]
+            level_end = ctrl[0, 1]
+            count = jnp.minimum(level_end - level_start, u(f))
+            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
+            lane = jnp.arange(f, dtype=u)
+            active = lane < count
+            safe_slots = jnp.where(active, chunk, 0)
+            states = store[safe_slots]
+            my_gids = (me << u(slot_bits)) | safe_slots
+            disc_v, eb, nexts, valid, gen_local, step_flag = wave_eval(
+                cm, props, ev_indices, states, active, my_gids,
+                ebits[safe_slots], disc[0], allow_two_phase=True,
+            )
+            flat_valid = valid.reshape(b)
+            v_orig, v_act, _n_valid, local_overflow = compact_valid_indices(
+                flat_valid, dedup_factor
+            )
+            if nexts is None:
+                # Two-phase: construct successors only for the compacted
+                # valid lanes (the fused body's phase B).
+                rows_v, _vv, lane_flags_v = jax.vmap(cm.step_lane)(
+                    states[v_orig // u(a)], v_orig % u(a)
+                )
+                step_flag = step_flag | jnp.any(lane_flags_v & v_act)
+            else:
+                rows_v = nexts.reshape(b, w)[v_orig]
+            gid_v = my_gids[v_orig // u(a)]
+            eb_v = eb[v_orig // u(a)]
+            return (
+                disc_v[None], rows_v, gid_v, eb_v, v_act,
+                local_overflow[None], gen_local.astype(u)[None],
+                step_flag[None],
+            )
+
+        def canon_shard(rows_v):
+            hi, lo = fp_of(rows_v)
+            return hi, lo
+
+        def prededup_shard(hi, lo, rows_v, gid_v, eb_v, v_act):
+            # dd=1 over the already-compacted buffer, exactly the fused
+            # body's local pre-dedup: representatives in sorted key
+            # order, one lane per distinct local key.
+            u_hi, u_lo, u_origin0, u_valid, _never = prededup(
+                hi, lo, v_act, dedup_factor=1
+            )
+            rows_u = rows_v[u_origin0]
+            gid_u = gid_v[u_origin0]
+            eb_u = eb_v[u_origin0]
+            n_cand = jnp.sum(u_valid, dtype=u)
+            return u_hi, u_lo, rows_u, gid_u, eb_u, u_valid, n_cand[None]
+
+        def exchange_shard(u_hi, u_lo, rows_u, gid_u, eb_u, u_valid):
+            # Bucket by owner + the single packed all_to_all (the fused
+            # body's exchange block), plus the receiver-side
+            # re-fingerprint of the arrived rows — charged to this phase
+            # because it only exists when an exchange happened.
+            u_sz = u_hi.shape[0]
+            owner = _owner_mix(u_hi, u_lo) % u(n)
+            key = jnp.where(u_valid, owner, u(n))
+            order = jnp.argsort(key, stable=True)
+            key_s = key[order]
+            counts = jnp.stack(
+                [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
+            )
+            offsets = jnp.concatenate(
+                [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
+            )
+            pos = jnp.arange(u_sz, dtype=u) - offsets[key_s]
+            dst = jnp.where(key_s < n, key_s, u(n))
+            payload = jnp.concatenate(
+                [
+                    rows_u,
+                    gid_u[:, None],
+                    eb_u[:, None],
+                    u_valid.astype(u)[:, None],
+                ],
+                axis=1,
+            )
+            send = jnp.zeros((n, u_sz, w + 3), u)
+            send = send.at[dst, pos].set(payload[order], mode="drop")
+            recv = jax.lax.all_to_all(
+                send, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+            flatrecv = recv.reshape(n * u_sz, w + 3)
+            rw = flatrecv[:, :w]
+            rhi, rlo = fp_of(rw)
+            return (
+                rw, flatrecv[:, w], flatrecv[:, w + 1],
+                flatrecv[:, w + 2], rhi, rlo,
+            )
+
+        def insert_shard(key_hi, key_lo, rhi, rlo, rv):
+            (
+                table, r_slot, r_new, r_origin, _ra, probe_ok,
+                dd_overflow, rounds,
+            ) = insert_batch_compact(
+                HashSet(key_hi, key_lo), rhi, rlo,
+                rv.astype(jnp.bool_), dedup_factor=1, with_rounds=True,
+            )
+            return (
+                table.key_hi, table.key_lo, r_slot, r_new, r_origin,
+                probe_ok[None], dd_overflow[None], rounds[None],
+            )
+
+        def append_shard(store, parent, ebits, queue, rw, rg, reb,
+                         r_slot, r_new, r_origin, ctrl):
+            tail = ctrl[0, 0]
+            rows_r = rw[r_origin]
+            sslot = jnp.where(r_new, r_slot, u(cap_s))
+            store = store.at[sslot].set(rows_r, mode="drop")
+            parent = parent.at[sslot].set(rg[r_origin], mode="drop")
+            ebits = ebits.at[sslot].set(reb[r_origin], mode="drop")
+            n_new = jnp.sum(r_new, dtype=u)
+            qpos = tail + jnp.cumsum(r_new.astype(u)) - 1
+            qidx = jnp.where(r_new, qpos, u(qcap + f))
+            queue = queue.at[qidx].set(r_slot, mode="drop")
+            return store, parent, ebits, queue, n_new[None]
+
+        return {
+            "step": sharded(step_shard, 5),
+            "canon": sharded(canon_shard, 1),
+            "prededup": sharded(prededup_shard, 6),
+            "exchange": sharded(exchange_shard, 6),
+            "insert": sharded(insert_shard, 5, donate=(0, 1)),
+            "append": sharded(append_shard, 11, donate=(0, 1, 2, 3)),
+        }
+
+    def _traced_wave_bytes(self, probe_rounds: int, two_phase: bool) -> dict:
+        """Modeled PER-SHARD HBM bytes for one traced wave (each shard
+        streams the same fixed-width buffers in parallel, so per-shard
+        bytes over measured wall time is per-device bandwidth;
+        obs/roofline.py documents the model)."""
+        from ..obs.roofline import copy_bytes, probe_bytes, sort_bytes
+        from .hashset import unique_buffer_size
+
+        cm = self._compiled
+        w = cm.state_width
+        fpw = cm.fp_words or w
+        n = self._n
+        f = self._chunk
+        b = f * cm.max_actions
+        u_sz = unique_buffer_size(b, self._dedup_factor)
+        recv = n * u_sz if n > 1 else u_sz  # post-exchange insert lanes
+        step = copy_bytes(f, w) + b * 4 + copy_bytes(u_sz, w)
+        if not two_phase:
+            step += b * w * 4
+        canon = (copy_bytes(u_sz, w) if self._canon is not None else 0)
+        canon += u_sz * fpw * 4 + 2 * u_sz * 4
+        dedup = (
+            sort_bytes(u_sz, 3) + 4 * u_sz * 4 + copy_bytes(u_sz, w)
+            + sort_bytes(recv, 3)
+            + probe_bytes(recv, probe_rounds) + 4 * recv * 4
+        )
+        exchange = 0
+        if n > 1:
+            # send-buffer scatter + the a2a move (in and out) + the
+            # receiver-side re-fingerprint.
+            exchange = (
+                3 * n * u_sz * (w + 3) * 4
+                + recv * fpw * 4 + 2 * recv * 4
+            )
+        append = copy_bytes(recv, w) + 2 * copy_bytes(recv, 1) + recv * 4
+        return {
+            "step": step, "canon": canon, "dedup": dedup,
+            "exchange": exchange, "append": append,
+        }
+
+    def _check_traced(self) -> None:
+        """The ``trace=True`` host loop: one wave per iteration, six
+        phase dispatches timed with ``block_until_ready``, per-shard
+        control scalars driven from the host, and the exchange measured
+        live — payload bytes and lane occupancy per wave in the journal.
+        Overflows raise (no growth path exists in this engine anyway);
+        results match the fused loop exactly."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        opts = self._options
+        cm = self._compiled
+        props = self._properties
+        n = self._n
+        f = self._chunk
+        cap_s = self._cap_s
+        qcap = cap_s
+        w = cm.state_width
+        deadline = (
+            _time.monotonic() + opts._timeout
+            if opts._timeout is not None else None
+        )
+        from ..obs.trace import WaveTracer
+        from .hashset import unique_buffer_size
+        from .wave_common import two_phase_capable
+
+        two_phase = two_phase_capable(cm)
+
+        u_sz = unique_buffer_size(f * cm.max_actions, self._dedup_factor)
+        tracer = WaveTracer(
+            self._mesh.devices.flat[0], f"tpu-sharded-{n}"
+        )
+        self._tracer = tracer
+        shard = NamedSharding(self._mesh, P("shards"))
+        k_stats = S_DISC + len(props)
+        target_depth = opts._target_max_depth or 0
+
+        progs = self._traced_programs()
+        key_hi, key_lo, store, parent, ebits, queue, stats = (
+            self._seed_initial(shard)
+        )
+        stats_h = np.asarray(stats).reshape(n, k_stats).astype(np.int64)
+        if int(stats_h[0, S_FLAGS]) & 16:
+            raise RuntimeError(
+                "init-state seeding overflowed the insert buffers; "
+                "raise capacity or lower dedup_factor"
+            )
+        level_start = np.zeros(n, np.int64)
+        level_end = stats_h[:, S_LEVEL_END].copy()
+        tails = stats_h[:, S_TAIL].copy()
+        unique_l = stats_h[:, S_UNIQUE_L].copy()
+        cand_total = np.zeros(n, np.int64)
+        depth = 0
+        disc = jax.device_put(
+            jnp.asarray(np.full((n, len(props)), NO_GID, np.uint32)), shard
+        )
+        disc_h = np.asarray(disc).reshape(n, len(props))
+        waves = 0
+
+        while int((level_end - level_start).sum()) > 0:
+            if target_depth and depth >= target_depth - 1:
+                break
+            counts = np.minimum(level_end - level_start, f)
+            ctrl = jax.device_put(
+                jnp.asarray(
+                    np.stack([level_start, level_end], axis=1)
+                    .astype(np.uint32)
+                ),
+                shard,
+            )
+            t0 = _time.perf_counter()
+            (
+                disc, rows_v, gid_v, eb_v, v_act, local_ovf_d, gen_d,
+                stepflag_d,
+            ) = progs["step"](store, ebits, queue, disc, ctrl)
+            jax.block_until_ready(rows_v)
+            t1 = _time.perf_counter()
+            hi_v, lo_v = progs["canon"](rows_v)
+            jax.block_until_ready(lo_v)
+            t2 = _time.perf_counter()
+            (
+                u_hi, u_lo, rows_u, gid_u, eb_u, u_valid, n_cand_d,
+            ) = progs["prededup"](hi_v, lo_v, rows_v, gid_v, eb_v, v_act)
+            jax.block_until_ready(u_valid)
+            t3 = _time.perf_counter()
+            if n > 1:
+                rw, rg, reb, rv, rhi, rlo = progs["exchange"](
+                    u_hi, u_lo, rows_u, gid_u, eb_u, u_valid
+                )
+                jax.block_until_ready(rlo)
+            else:
+                # 1-shard mesh: every owner is self — elide the whole
+                # exchange, like the fused program.
+                rw, rg, reb, rv, rhi, rlo = (
+                    rows_u, gid_u, eb_u, u_valid, u_hi, u_lo
+                )
+            t4 = _time.perf_counter()
+            (
+                key_hi, key_lo, r_slot, r_new, r_origin, probe_ok_d,
+                dd_ovf_d, rounds_d,
+            ) = progs["insert"](key_hi, key_lo, rhi, rlo, rv)
+            jax.block_until_ready(key_lo)
+            t5 = _time.perf_counter()
+            tailctrl = jax.device_put(
+                jnp.asarray(tails[:, None].astype(np.uint32)), shard
+            )
+            store, parent, ebits, queue, n_new_d = progs["append"](
+                store, parent, ebits, queue, rw, rg, reb, r_slot,
+                r_new, r_origin, tailctrl,
+            )
+            jax.block_until_ready(queue)
+            t6 = _time.perf_counter()
+            # Host readback: the per-wave scalar sync.
+            n_new = np.asarray(n_new_d).astype(np.int64)
+            gen_h = np.asarray(gen_d).astype(np.int64)
+            n_cand = np.asarray(n_cand_d).astype(np.int64)
+            rounds = int(np.asarray(rounds_d).max())
+            disc_h = np.asarray(disc).reshape(n, len(props))
+            flags = 0
+            if (
+                not bool(np.asarray(probe_ok_d).all())
+                or ((unique_l + n_new) * 2 > cap_s).any()
+            ):
+                flags |= 1
+            if ((tails + n_new) > qcap).any():
+                flags |= 2
+            if (
+                bool(np.asarray(dd_ovf_d).any())
+                or bool(np.asarray(local_ovf_d).any())
+            ):
+                flags |= 4
+            if bool(np.asarray(stepflag_d).any()):
+                flags |= 8
+            t7 = _time.perf_counter()
+
+            tails += n_new
+            unique_l += n_new
+            cand_total += n_cand
+            level_start = level_start + counts
+            if int((level_end - level_start).sum()) == 0:
+                depth += 1
+                level_end = tails.copy()
+            remaining = int((level_end - level_start).sum())
+            waves += 1
+            with self._lock:
+                self._state_count += int(gen_h.sum())
+                self._unique_count += int(n_new.sum())
+                self._max_depth = depth + (1 if remaining else 0)
+                for d in range(n):
+                    for p, prop in enumerate(props):
+                        g = int(disc_h[d, p])
+                        if g != NO_GID:
+                            self._discovery_gids.setdefault(prop.name, g)
+
+            if flags & 1:
+                raise RuntimeError(
+                    f"sharded fingerprint table overfull (per-shard "
+                    f"capacity {cap_s}); raise capacity"
+                )
+            if flags & 2:
+                raise RuntimeError(
+                    "a shard's frontier queue overflowed its backstop "
+                    "bound; raise capacity"
+                )
+            if flags & 4:
+                raise RuntimeError(
+                    "a shard's chunk overflowed its compaction/dedup "
+                    f"buffers; lower dedup_factor (now "
+                    f"{self._dedup_factor}; 1 is always safe) or "
+                    "chunk_size"
+                )
+            if flags & 8:
+                raise RuntimeError(
+                    "the model step kernel flagged an encoding-capacity "
+                    "overflow (a successor exceeded the packed layout's "
+                    "bounds)"
+                )
+
+            phases = {
+                "step": t1 - t0,
+                "canon": t2 - t1,
+                "dedup": (t3 - t2) + (t5 - t4),
+                "exchange": t4 - t3,
+                "append": t6 - t5,
+                "readback": t7 - t6,
+            }
+            # The MEASURED exchange instrumentation: useful payload
+            # bytes this wave vs the static transmitted buffer.
+            useful = int(n_cand.sum()) * (w + 3) * 4 if n > 1 else 0
+            occ_wave = (
+                float(n_cand.sum()) / (n * n * u_sz) if n > 1 else 0.0
+            )
+            enrich = tracer.record_wave(
+                phases, self._traced_wave_bytes(rounds, two_phase),
+                probe_rounds=rounds,
+                exchange_payload_bytes=useful,
+            )
+            enrich["exchange_occupancy"] = round(occ_wave, 6)
+            if self._journal:
+                self._journal.append(
+                    "wave",
+                    waves=waves,
+                    remaining=remaining,
+                    unique=self._unique_count,
+                    states=self._state_count,
+                    depth=depth,
+                    flags=0,
+                    call_sec=round(t7 - t0, 6),
+                    occupancy=round(float(unique_l.max()) / cap_s, 6),
+                    **enrich,
+                )
+            self._metrics.update(
+                waves=waves,
+                table_occupancy=round(float(unique_l.max()) / cap_s, 6),
+                last_call_sec=round(t7 - t0, 6),
+                exchange_occupancy=round(occ_wave, 6),
+            )
+            self._metrics.inc("device_call_sec_total", t7 - t0)
+            self._metrics.inc("device_calls", 1)
+
+            if opts._finish_when.matches(
+                frozenset(self._discovery_gids), props
+            ):
+                break
+            if (
+                opts._target_state_count is not None
+                and opts._target_state_count <= self._state_count
+            ):
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+
+        self._accounting = self._build_accounting(
+            waves, cand_total, unique_l
+        )
+        self._tables_dev = (parent, store)
+        # Snapshot-ready carry, like the fused loop: the stats matrix is
+        # reconstructed from the host-tracked control state (sc/unique_g
+        # replicated per shard, exactly as the psums leave them).
+        stats_np = np.zeros((n, k_stats), np.uint32)
+        stats_np[:, S_LEVEL_START] = level_start.astype(np.uint32)
+        stats_np[:, S_LEVEL_END] = level_end.astype(np.uint32)
+        stats_np[:, S_TAIL] = tails.astype(np.uint32)
+        stats_np[:, S_SC_LO] = self._state_count & 0xFFFFFFFF
+        stats_np[:, S_SC_HI] = (self._state_count >> 32) & 0xFFFFFFFF
+        stats_np[:, S_UNIQUE_G] = self._unique_count
+        stats_np[:, S_UNIQUE_L] = unique_l.astype(np.uint32)
+        stats_np[:, S_CAND_LO] = (cand_total & 0xFFFFFFFF).astype(np.uint32)
+        stats_np[:, S_CAND_HI] = (cand_total >> 32).astype(np.uint32)
+        stats_np[:, S_DEPTH] = depth
+        stats_np[:, S_DISC:] = disc_h.astype(np.uint32)
+        self._carry_dev = {
+            "key_hi": key_hi,
+            "key_lo": key_lo,
+            "store": store,
+            "parent": parent,
+            "ebits": ebits,
+            "queue": queue,
+            "stats": stats_np,
+        }
+        if self._checkpoint_path is not None:
+            self._write_snapshot(self._checkpoint_path, self._carry_dev)
+            if self._journal:
+                self._journal.append(
+                    "checkpoint",
+                    path=self._checkpoint_path,
+                    unique=self._unique_count,
+                    depth=self._max_depth,
+                    final=True,
+                )
+        if self._journal:
+            self._journal.append("trace_summary", **tracer.summary())
+            self._journal.append(
+                "engine_done",
+                unique=self._unique_count,
+                states=self._state_count,
+                depth=self._max_depth,
+            )
+
+    def _seed_initial(self, shard):
+        """Host-side owner routing + the seed program: one upload + one
+        dispatch mints every device buffer (the spawn-cost story in
+        ``_seed_program``).  Shared by the fused and traced host loops
+        so seeding semantics cannot drift between them."""
+        import jax
+        import jax.numpy as jnp
+
+        cm = self._compiled
+        n = self._n
+        # Seed init states host-side: fingerprints and owners computed
+        # on the HOST (bit-identical by the pinned host/device fp
+        # parity), so the whole spawn is one upload + one seed
+        # dispatch — the seed program mints every device buffer and
+        # the run loop's stats vector itself.
+        from ..ops.fingerprint import fp64_words
+
+        init = cm.init_packed()
+        n_init = init.shape[0]
+        fpw = cm.fp_words or cm.state_width
+        if self._canon is not None:
+            # Owner placement must use the CANONICAL fingerprint (the
+            # dedup/routing key); evaluated on the CPU backend via
+            # the same traced kernel, so it is bit-identical to the
+            # device's without a device round trip.  The shards still
+            # receive (and store) the original rows.
+            from .canon import canon_batch_host
+
+            fp_rows = canon_batch_host(cm, init)
+        else:
+            fp_rows = init
+        fps = [fp64_words(row[:fpw].tolist()) for row in fp_rows]
+        owner = np.array(
+            [
+                _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF)
+                % n
+                for fp in fps
+            ],
+            np.uint32,
+        )
+
+        # Per-shard seed batches, padded to a common width; validity
+        # rides as one extra word column so the upload is one array.
+        seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
+        packed_np = np.zeros((n, seed_w, cm.state_width + 1), np.uint32)
+        for d in range(n):
+            idx = np.flatnonzero(owner == d)
+            packed_np[d, : len(idx), : cm.state_width] = init[idx]
+            packed_np[d, : len(idx), cm.state_width] = 1
+
+        seed = self._seed_program(int(seed_w))
+        out = seed(jax.device_put(jnp.asarray(packed_np), shard))
+
+        self._state_count = n_init
+        self._unique_count = len(set(fps))
+        return out
+
     def _check(self) -> None:
+        if self._trace:
+            return self._check_traced()
         import time as _time
 
         import jax
@@ -884,53 +1491,9 @@ class ShardedTpuChecker(Checker):
         else:
             cap_s = self._cap_s
             f = self._chunk
-            # Seed init states host-side: fingerprints and owners computed
-            # on the HOST (bit-identical by the pinned host/device fp
-            # parity), so the whole spawn is one upload + one seed
-            # dispatch — the seed program mints every device buffer and
-            # the run loop's stats vector itself.
-            from ..ops.fingerprint import fp64_words
-
-            init = cm.init_packed()
-            n_init = init.shape[0]
-            fpw = cm.fp_words or cm.state_width
-            if self._canon is not None:
-                # Owner placement must use the CANONICAL fingerprint (the
-                # dedup/routing key); evaluated on the CPU backend via
-                # the same traced kernel, so it is bit-identical to the
-                # device's without a device round trip.  The shards still
-                # receive (and store) the original rows.
-                from .canon import canon_batch_host
-
-                fp_rows = canon_batch_host(cm, init)
-            else:
-                fp_rows = init
-            fps = [fp64_words(row[:fpw].tolist()) for row in fp_rows]
-            owner = np.array(
-                [
-                    _owner_mix_host((fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF)
-                    % n
-                    for fp in fps
-                ],
-                np.uint32,
+            key_hi, key_lo, store, parent, ebits, queue, stats = (
+                self._seed_initial(shard)
             )
-
-            # Per-shard seed batches, padded to a common width; validity
-            # rides as one extra word column so the upload is one array.
-            seed_w = max(int((owner == d).sum()) for d in range(n)) or 1
-            packed_np = np.zeros((n, seed_w, cm.state_width + 1), np.uint32)
-            for d in range(n):
-                idx = np.flatnonzero(owner == d)
-                packed_np[d, : len(idx), : cm.state_width] = init[idx]
-                packed_np[d, : len(idx), cm.state_width] = 1
-
-            seed = self._seed_program(int(seed_w))
-            key_hi, key_lo, store, parent, ebits, queue, stats = seed(
-                jax.device_put(jnp.asarray(packed_np), shard)
-            )
-
-            self._state_count = n_init
-            self._unique_count = len(set(fps))
 
         waves_per_call = self._waves_per_call
 
@@ -997,6 +1560,17 @@ class ShardedTpuChecker(Checker):
                         float(stats_h[:, S_UNIQUE_L].max()) / cap_s, 6
                     ),
                 )
+            # Metrics ride the scalars this loop already read back —
+            # never an extra device sync (the trace-off contract).
+            self._metrics.update(
+                waves=waves_total,
+                table_occupancy=round(
+                    float(stats_h[:, S_UNIQUE_L].max()) / cap_s, 6
+                ),
+                last_call_sec=round(call_sec, 6),
+            )
+            self._metrics.inc("device_call_sec_total", call_sec)
+            self._metrics.inc("device_calls", 1)
             if (
                 self._checkpoint_path is not None
                 and flags_h == 0
@@ -1087,50 +1661,11 @@ class ShardedTpuChecker(Checker):
         # Weak-scaling accounting: lockstep waves, the static all_to_all
         # payload, and its measured occupancy/skew (docs/SHARDED_SCALING.md;
         # replaces the former unquantified "statistically balanced" claim).
-        from .hashset import unique_buffer_size
-
-        b = f * cm.max_actions
-        u_sz = unique_buffer_size(b, self._dedup_factor)
         cand_h = (
             stats_h[:, S_CAND_HI].astype(np.int64) << 32
         ) | stats_h[:, S_CAND_LO].astype(np.int64)
         uniq_h = stats_h[:, S_UNIQUE_L].astype(np.int64)
-        self._accounting = {
-            "shards": n,
-            "waves": waves_total,
-            "chunk_size": f,
-            "exchange_lanes_per_shard": u_sz,
-            # On a 1-shard mesh the whole exchange is elided at trace
-            # time (owner is always self), so no bytes move at all.
-            "exchange_elided": n == 1,
-            "all_to_all_bytes_per_wave_per_shard": (
-                0 if n == 1
-                else int(n * u_sz * (cm.state_width + 3) * 4)
-            ),
-            "all_to_all_bytes_total": (
-                0 if n == 1
-                else int(
-                    waves_total * n * n * u_sz * (cm.state_width + 3) * 4
-                )
-            ),
-            "candidates_sent_per_shard": cand_h.tolist(),
-            # Fraction of TRANSMITTED lanes carrying a real candidate:
-            # each shard ships [n, u_sz] lanes per wave (one u_sz bucket
-            # per destination), so the denominator is waves * n^2 * u_sz
-            # across the mesh — occupancy * all_to_all_bytes_total =
-            # useful bytes.
-            # 0.0 when elided: nothing is transmitted, so the identity
-            # occupancy × all_to_all_bytes_total = useful bytes holds.
-            "exchange_occupancy": (
-                float(cand_h.sum() / (waves_total * n * n * u_sz))
-                if waves_total and n > 1
-                else 0.0
-            ),
-            "unique_per_shard": uniq_h.tolist(),
-            "unique_skew_max_over_mean": (
-                float(uniq_h.max() / uniq_h.mean()) if uniq_h.sum() else 1.0
-            ),
-        }
+        self._accounting = self._build_accounting(waves_total, cand_h, uniq_h)
 
         # Keep the device arrays; path reconstruction pulls them lazily —
         # an eager pull is ~10 s of tunnel bandwidth for a 2^20-slot store
@@ -1168,6 +1703,58 @@ class ShardedTpuChecker(Checker):
                 states=self._state_count,
                 depth=self._max_depth,
             )
+
+    def _build_accounting(self, waves_total: int, cand_h, uniq_h) -> dict:
+        """The weak-scaling accounting dict from measured per-shard
+        counters (``cand_h``/``uniq_h``: int64[n]); shared by the fused
+        and traced host loops so the payload geometry and occupancy
+        definitions cannot drift between them."""
+        from .hashset import unique_buffer_size
+
+        cm = self._compiled
+        n = self._n
+        f = self._chunk
+        b = f * cm.max_actions
+        u_sz = unique_buffer_size(b, self._dedup_factor)
+        return {
+            "shards": n,
+            "waves": waves_total,
+            "chunk_size": f,
+            "exchange_lanes_per_shard": u_sz,
+            # On a 1-shard mesh the whole exchange is elided at trace
+            # time (owner is always self), so no bytes move at all.
+            "exchange_elided": n == 1,
+            "all_to_all_bytes_per_wave_per_shard": (
+                0 if n == 1
+                else int(n * u_sz * (cm.state_width + 3) * 4)
+            ),
+            "all_to_all_bytes_total": (
+                0 if n == 1
+                else int(
+                    waves_total * n * n * u_sz * (cm.state_width + 3) * 4
+                )
+            ),
+            "candidates_sent_per_shard": cand_h.tolist(),
+            # Fraction of TRANSMITTED lanes carrying a real candidate:
+            # each shard ships [n, u_sz] lanes per wave (one u_sz bucket
+            # per destination), so the denominator is waves * n^2 * u_sz
+            # across the mesh — occupancy * all_to_all_bytes_total =
+            # useful bytes.
+            # 0.0 when elided: nothing is transmitted, so the identity
+            # occupancy × all_to_all_bytes_total = useful bytes holds.
+            "exchange_occupancy": (
+                float(cand_h.sum() / (waves_total * n * n * u_sz))
+                if waves_total and n > 1
+                else 0.0
+            ),
+            "exchange_payload_bytes_total": int(
+                cand_h.sum() * (cm.state_width + 3) * 4
+            ) if n > 1 else 0,
+            "unique_per_shard": uniq_h.tolist(),
+            "unique_skew_max_over_mean": (
+                float(uniq_h.max() / uniq_h.mean()) if uniq_h.sum() else 1.0
+            ),
+        }
 
     def _snapshot_key(self) -> str:
         """Process-stable compatibility key for sharded snapshots — the
@@ -1244,6 +1831,38 @@ class ShardedTpuChecker(Checker):
 
     def max_depth(self) -> int:
         return self._max_depth
+
+    def metrics(self) -> dict:
+        """Live observability snapshot (names: docs/OBSERVABILITY.md);
+        safe to call mid-run.  Includes the weak-scaling accounting once
+        the run completes and, under ``trace=True``, the roofline trace
+        summary with the measured per-wave exchange totals."""
+        out = super().metrics()
+        out.update(
+            engine="tpu-sharded",
+            shards=self._n,
+            trace=self._trace,
+            capacity_per_shard=self._cap_s,
+            chunk_size=self._chunk,
+            dedup_factor=self._dedup_factor,
+        )
+        out.update(self._metrics.snapshot())
+        if self._accounting:
+            out["accounting"] = dict(self._accounting)
+        if self._tracer is not None:
+            out["trace_summary"] = self._tracer.summary()
+        return out
+
+    def trace_summary(self) -> dict:
+        """The finished traced run's roofline reduction (per-phase
+        seconds, modeled bytes, ``hbm_util_frac``, measured exchange
+        payload totals).  Requires ``trace=True``."""
+        self.join()
+        if self._tracer is None:
+            raise RuntimeError(
+                "trace_summary() requires spawn_tpu_sharded(trace=True)"
+            )
+        return self._tracer.summary()
 
     def _gid_path(self, gid: int) -> Path:
         # The lazy ~GB-scale host pull happens at most once (guarded: two

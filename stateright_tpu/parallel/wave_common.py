@@ -93,6 +93,19 @@ def make_finish_when_device(fw, props):
     return matched
 
 
+def two_phase_capable(cm) -> bool:
+    """Host-side mirror of :func:`wave_eval`'s two-phase gate: the model
+    exposes ``step_valid`` + ``step_lane`` AND is unbounded (``boundary``
+    None).  The traced engine loops (wavefront/sharded ``trace=True``)
+    use it to pick the matching roofline byte model; keeping it beside
+    the trace-time gate keeps the two from drifting."""
+    import numpy as np
+
+    if not (hasattr(cm, "step_valid") and hasattr(cm, "step_lane")):
+        return False
+    return cm.boundary(np.zeros((cm.state_width,), np.uint32)) is None
+
+
 def cached_program(cache: dict, max_size: int, key, build):
     """Bounded-FIFO memo for compiled engine programs, shared by the
     single-chip and sharded engines so the key-tuple + eviction idiom

@@ -183,6 +183,7 @@ class TpuChecker(Checker):
         checkpoint_path: Optional[str] = None,
         checkpoint_every_waves: Optional[int] = None,
         checkpoint_every_sec: Optional[float] = None,
+        trace: bool = False,
     ):
         """``capacity`` sizes the fingerprint table (slots; load is kept
         below 50%), ``log_capacity`` the append-only row log (positions =
@@ -213,18 +214,44 @@ class TpuChecker(Checker):
         ``waves_per_call`` quanta — the host-loop granularity) or
         ``checkpoint_every_sec`` seconds (default 30 when only the path
         is given); a killed run resumes from the latest checkpoint via
-        ``resume_from``."""
+        ``resume_from``.
+
+        ``trace``: run the wave loop in PHASE-TIMED SEGMENTS (step
+        kernel / canon+fingerprint / dedup-sort+probe / append / host
+        readback) instead of the fused ``lax.while_loop`` — one host
+        sync per wave, each phase a separate dispatch timed with
+        ``block_until_ready`` and charged modeled bytes against the
+        device's peak HBM bandwidth (obs/roofline.py).  Results are
+        identical (same kernels, same commit order); throughput is not —
+        a traced run pays per-wave dispatch+sync overhead and exists to
+        say WHERE the untraced run's time goes, never to be the measured
+        number.  With ``trace=False`` (the default) the fused device
+        program is byte-for-byte unchanged and the host loop issues no
+        additional per-wave syncs.  Tracing surfaces: enriched ``wave``
+        journal records, ``metrics()`` (the Explorer's ``/.metrics``),
+        and ``trace_summary()``.  Traced runs auto-grow in place on
+        overflow exactly like the fused loop (an aborted wave never
+        commits; the rehash erases its keys), but do not support
+        ``resume_from`` and ignore the mid-run checkpoint cadence (the
+        final completion checkpoint still lands).  A visitor forces
+        ``trace`` on — a visitor-instrumented default-knob run still
+        completes, it just runs at traced speed.
+
+        Visitors: a ``visitor()`` on the builder is supported at COARSE
+        WAVE GRANULARITY via the traced readback path (``trace`` is
+        forced on): every unique state is visited exactly once, at
+        expansion, as a single-state path — BFS level order across
+        waves, fingerprint-sorted order within a level, no action
+        prefix.  docs/OBSERVABILITY.md states the full contract."""
         super().__init__(options.model)
         import jax
 
         if options._visitor is not None:
-            # The wavefront never materializes per-state paths during the
-            # run; failing beats silently skipping the visits spawn_bfs
-            # would have made.
-            raise ValueError(
-                "spawn_tpu() does not support visitors; use spawn_bfs()/"
-                "spawn_dfs() for visitor-instrumented runs"
-            )
+            # The wavefront never materializes per-state paths during
+            # the run; visits ride the traced per-wave readback instead
+            # (coarse wave granularity — see the docstring above).
+            trace = True
+        self._trace = bool(trace)
         self._options = options
         self._compiled = compiled or compiled_model_for(options.model)
         # Symmetry reduction: dedup on the fingerprint of the CANONICAL
@@ -317,6 +344,16 @@ class TpuChecker(Checker):
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
         self._resume_from = resume_from
+        if self._trace and resume_from is not None:
+            raise ValueError(
+                "spawn_tpu(trace=True) does not support resume_from: "
+                "tracing is a diagnostic mode; resume the run untraced "
+                "and trace a fresh (bounded) run instead"
+            )
+        from ..obs.metrics import MetricsRegistry
+
+        self._metrics = MetricsRegistry()
+        self._tracer = None  # built by the traced host loop
         from ..runtime.journal import as_journal
 
         self._journal = as_journal(journal)
@@ -762,6 +799,76 @@ class TpuChecker(Checker):
                     self._unique_count = 0
                     self._max_depth = 0
 
+    def _grow_on_flags(self, flags_h, qcap, pad, rows, parent, ebits,
+                       tail_h, unique_h, depth_h):
+        """In-place auto-tune growth for in-loop overflow flags (bits
+        1/2/4), shared by the fused and traced host loops so their
+        recovery semantics cannot drift: grow the tripped knobs
+        (:meth:`_grow`, honoring the dragged-log rule), resize the
+        row/parent/ebits buffers if the log geometry changed, and
+        rebuild the table from the committed row-log prefix (erasing any
+        keys the aborted wave wrote).  Returns ``(rows, parent, ebits,
+        key_hi, key_lo, qcap, pad)``; raises RuntimeError when the
+        tripped knob cannot grow (or ``auto_tune`` is off).  The caller
+        re-derives its capacity/frontier locals and programs from self
+        and re-runs the same chunk."""
+        msgs = {
+            1: (
+                f"fingerprint table overfull (capacity "
+                f"{self._capacity}); raise spawn_tpu(capacity=...)"
+            ),
+            2: (
+                f"the state row log is full (log_capacity {qcap}); "
+                "raise spawn_tpu(log_capacity=...)"
+            ),
+            4: (
+                "a wave generated more VALID successor candidates than "
+                "the compaction/dedup buffers hold (batch/dedup_factor); "
+                f"lower spawn_tpu(dedup_factor=...) (now "
+                f"{self._dedup_factor}; 1 is always safe)"
+            ),
+        }
+        grown = []
+        for bit in (1, 2, 4):
+            if flags_h & bit:
+                if bit == 2 and self._log_capacity > qcap:
+                    # A simultaneous table growth (bit 1, processed
+                    # above) already dragged the log past the tripped
+                    # size — the flag is addressed; raising here would
+                    # kill a run whose log just grew.
+                    grown.append(
+                        f"log_capacity={self._log_capacity} (dragged)"
+                    )
+                    continue
+                g = self._grow(bit) if self._auto_tune else None
+                if g is None:
+                    raise RuntimeError(msgs[bit])
+                grown.append(g)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "auto-tune: overflow flags=%d; growing in place (%s) at "
+            "unique=%d depth=%d",
+            flags_h, "; ".join(grown), unique_h, depth_h,
+        )
+        if self._journal:
+            self._journal.append(
+                "grow", flags=flags_h, grown="; ".join(grown),
+                unique=unique_h, depth=depth_h,
+            )
+        new_qcap = self._log_capacity
+        new_pad = self._block_pad()
+        if (new_qcap + new_pad) != (qcap + pad):
+            n_new_len = new_qcap + new_pad
+            rows = _resize_flat(
+                rows, n_new_len * self._compiled.state_width, 0
+            )
+            parent = _resize_flat(parent, n_new_len, NO_SLOT_HOST)
+            ebits = _resize_flat(ebits, n_new_len, 0)
+            qcap, pad = new_qcap, new_pad
+        key_hi, key_lo = self._rehash(rows, tail_h)
+        return rows, parent, ebits, key_hi, key_lo, qcap, pad
+
     def _grow(self, flag: int):
         """Adjust the knob named by ``flag``; None if it cannot grow.
 
@@ -842,6 +949,8 @@ class TpuChecker(Checker):
         return None
 
     def _check_once(self, deadline=None) -> None:
+        if self._trace:
+            return self._check_once_traced(deadline)
         import time as _time
 
         import jax
@@ -1021,6 +1130,15 @@ class TpuChecker(Checker):
                         call_sec=round(call_sec, 4),
                         occupancy=round(unique_h / cap, 6),
                     )
+                # Metrics ride the scalars this loop already read back —
+                # never an extra device sync (the trace-off contract).
+                self._metrics.update(
+                    waves=waves_done,
+                    table_occupancy=round(unique_h / cap, 6),
+                    last_call_sec=round(call_sec, 6),
+                )
+                self._metrics.inc("device_call_sec_total", call_sec)
+                self._metrics.inc("device_calls", 1)
                 if (
                     self._checkpoint_path is not None
                     and flags_h == 0
@@ -1075,66 +1193,13 @@ class TpuChecker(Checker):
                     # committed row-log prefix (erasing any keys the
                     # aborted wave managed to write), and continue from
                     # the same chunk — no work is redone.
-                    msgs = {
-                        1: (
-                            f"fingerprint table overfull (capacity {cap}); "
-                            "raise spawn_tpu(capacity=...)"
-                        ),
-                        2: (
-                            f"the state row log is full (log_capacity "
-                            f"{qcap}); raise spawn_tpu(log_capacity=...)"
-                        ),
-                        4: (
-                            "a wave generated more VALID successor "
-                            "candidates than the compaction/dedup buffers "
-                            "hold (batch/dedup_factor); lower "
-                            f"spawn_tpu(dedup_factor=...) (now "
-                            f"{self._dedup_factor}; 1 is always safe)"
-                        ),
-                    }
-                    grown = []
-                    for bit in (1, 2, 4):
-                        if flags_h & bit:
-                            if bit == 2 and self._log_capacity > qcap:
-                                # A simultaneous table growth (bit 1,
-                                # processed above) already dragged the
-                                # log past the tripped size — the flag
-                                # is addressed; raising here would kill
-                                # a run whose log just grew.
-                                grown.append(
-                                    f"log_capacity={self._log_capacity}"
-                                    " (dragged)"
-                                )
-                                continue
-                            g = self._grow(bit) if self._auto_tune else None
-                            if g is None:
-                                raise RuntimeError(msgs[bit])
-                            grown.append(g)
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "auto-tune: overflow flags=%d; growing in place "
-                        "(%s) at unique=%d depth=%d",
-                        flags_h, "; ".join(grown), unique_h, depth_h,
+                    rows, parent, ebits, key_hi, key_lo, qcap, pad = (
+                        self._grow_on_flags(
+                            flags_h, qcap, pad, rows, parent, ebits,
+                            tail_h, unique_h, depth_h,
+                        )
                     )
-                    if self._journal:
-                        self._journal.append(
-                            "grow", flags=flags_h,
-                            grown="; ".join(grown),
-                            unique=unique_h, depth=depth_h,
-                        )
-                    new_qcap = self._log_capacity
-                    new_pad = self._block_pad()
-                    if (new_qcap + new_pad) != (qcap + pad):
-                        n_new_len = new_qcap + new_pad
-                        rows = _resize_flat(
-                            rows, n_new_len * cm.state_width, 0
-                        )
-                        parent = _resize_flat(parent, n_new_len, NO_SLOT_HOST)
-                        ebits = _resize_flat(ebits, n_new_len, 0)
-                        qcap, pad = new_qcap, new_pad
                     cap = self._capacity
-                    key_hi, key_lo = self._rehash(rows, tail_h)
                     seed, run = self._programs()
                     continue
                 if remaining_h == 0:
@@ -1184,6 +1249,442 @@ class TpuChecker(Checker):
                         final=True,
                     )
             if self._journal:
+                self._journal.append(
+                    "engine_done",
+                    unique=self._unique_count,
+                    states=self._state_count,
+                    depth=self._max_depth,
+                )
+
+    # --- traced (phase-timed) mode -------------------------------------------
+
+    def _traced_programs(self):
+        """Phase-program set for ``trace=True`` (cached like the fused
+        pair).  The key covers everything the closures trace over; host-
+        driven knobs (waves_per_call, finish_when, target depth) are NOT
+        baked in — the traced loop decides them per wave on the host."""
+        key = (
+            "traced",
+            self._compiled.cache_key(),
+            hasattr(self._compiled, "step_valid")
+            and hasattr(self._compiled, "step_lane"),
+            self._canon is not None,
+            self._max_frontier,
+            self._dedup_factor,
+            self._block_pad(),
+            tuple(p.expectation for p in self._properties),
+        )
+        from .wave_common import cached_program
+
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced
+        )
+
+    def _build_traced(self):
+        """The wave loop as four separately-dispatched phase programs —
+        the SAME kernels as the fused ``wave_body``, cut at the phase
+        boundaries the roofline models (step kernel / canon+fingerprint /
+        dedup-sort+probe / append) so the host can time each with
+        ``block_until_ready``.  Commit order, dedup keys, position
+        assignment, and discovery folding are identical to the fused
+        path; level/depth bookkeeping moves to the host (one sync per
+        wave is the traced mode's documented cost)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_fp import device_fp64
+        from .hashset import (
+            HashSet, compact_valid_indices, insert_batch_compact,
+        )
+        from .wave_common import compact, wave_eval
+
+        cm = self._compiled
+        w = cm.state_width
+        fpw = cm.fp_words or w
+        canon = self._canon
+        a = cm.max_actions
+        f = self._max_frontier
+        pad = self._block_pad()
+        dedup_factor = self._dedup_factor
+        props = self._properties
+        ev_indices = self._ev_indices
+
+        @jax.jit
+        def t_step(rows, ebits, disc, level_start, level_end):
+            count = jnp.minimum(level_end - level_start, jnp.uint32(f))
+            lane = jnp.arange(f, dtype=jnp.uint32)
+            active = lane < count
+            ids = level_start + lane
+            states = jax.lax.dynamic_slice(
+                rows, (level_start * jnp.uint32(w),), (f * w,)
+            ).reshape(f, w)
+            eb_chunk = jax.lax.dynamic_slice(ebits, (level_start,), (f,))
+            disc, eb, nexts, valid, generated, step_flag = wave_eval(
+                cm, props, ev_indices, states, active, ids, eb_chunk,
+                disc, allow_two_phase=True,
+            )
+            flat_valid = valid.reshape(f * a)
+            v_orig, v_act, n_valid, v_overflow = compact_valid_indices(
+                flat_valid, dedup_factor
+            )
+            if nexts is None:
+                # Two-phase: construct successors only for the compacted
+                # valid lanes (the fused path's phase B).
+                src_state = v_orig // jnp.uint32(a)
+                cand_rows, _vu, lane_flags_u = jax.vmap(cm.step_lane)(
+                    states[src_state], v_orig % jnp.uint32(a)
+                )
+                step_flag = step_flag | jnp.any(lane_flags_u & v_act)
+                cand_src = src_state
+            else:
+                # Single-phase: compact the constructed rows.  Same keys
+                # and representatives as the fused compact_valid-on-keys
+                # order (compaction preserves lane order).
+                cand_rows = nexts.reshape(f * a, w)[v_orig]
+                cand_src = v_orig // jnp.uint32(a)
+            return (
+                disc, eb, states, cand_rows, cand_src, v_act,
+                n_valid, v_overflow, generated, step_flag,
+            )
+
+        @jax.jit
+        def t_fp(cand_rows):
+            rows_c = (
+                cand_rows if canon is None else jax.vmap(canon)(cand_rows)
+            )
+            return device_fp64(rows_c[:, :fpw])
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def t_insert(key_hi, key_lo, hi, lo, cand_act):
+            (
+                table, _u_slot, u_new, u_origin, _u_active, probe_ok,
+                dd_overflow, rounds,
+            ) = insert_batch_compact(
+                HashSet(key_hi, key_lo), hi, lo, cand_act,
+                dedup_factor=1, with_rounds=True,
+            )
+            n_new = jnp.sum(u_new, dtype=jnp.uint32)
+            return (
+                table.key_hi, table.key_lo, u_new, u_origin, n_new,
+                probe_ok, dd_overflow, rounds,
+            )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def t_append(rows, parent, ebits, cand_rows, cand_src, eb, u_new,
+                     u_origin, level_start, tail):
+            u = u_new.shape[0]
+            sel = compact(u_new, jnp.arange(u, dtype=jnp.uint32), pad)
+            sel_u = u_origin[sel]
+            rows_blk = cand_rows[sel_u]
+            src_state = cand_src[sel_u]
+            par_blk = level_start + src_state
+            eb_blk = eb[src_state]
+            rows = jax.lax.dynamic_update_slice(
+                rows, rows_blk.reshape(-1), (tail * jnp.uint32(w),)
+            )
+            parent = jax.lax.dynamic_update_slice(parent, par_blk, (tail,))
+            ebits = jax.lax.dynamic_update_slice(ebits, eb_blk, (tail,))
+            return rows, parent, ebits
+
+        return {
+            "step": t_step, "fp": t_fp, "insert": t_insert,
+            "append": t_append,
+        }
+
+    def _traced_wave_bytes(self, probe_rounds: int, two_phase: bool) -> dict:
+        """Modeled HBM bytes touched by one traced wave, per phase
+        (obs/roofline.py documents the model and its biases).  Buffer-
+        proportional, not count-proportional: the device streams full
+        fixed-width buffers regardless of how many lanes are live, so
+        charging the full widths is what matches what HBM actually
+        moves."""
+        from ..obs.roofline import copy_bytes, probe_bytes, sort_bytes
+        from .hashset import unique_buffer_size
+
+        cm = self._compiled
+        w = cm.state_width
+        fpw = cm.fp_words or w
+        a = cm.max_actions
+        f = self._max_frontier
+        b = f * a
+        u_sz = unique_buffer_size(b, self._dedup_factor)
+        pad = self._block_pad()
+        # step: chunk read + candidate construction + the valid-lane
+        # index compaction scan.  Two-phase constructs only U rows (and
+        # gathers their U parents); single-phase materializes all B.
+        step = f * w * 4 + b * 4 + copy_bytes(u_sz, w)
+        if not two_phase:
+            step += b * w * 4
+        canon = (copy_bytes(u_sz, w) if self._canon is not None else 0)
+        canon += u_sz * fpw * 4 + 2 * u_sz * 4
+        dedup = (
+            sort_bytes(u_sz, 3)
+            + probe_bytes(u_sz, probe_rounds)
+            + 4 * u_sz * 4  # representative compaction planes
+        )
+        append = copy_bytes(pad, w) + 2 * copy_bytes(pad, 1) + u_sz * 4
+        return {
+            "step": step, "canon": canon, "dedup": dedup, "append": append,
+        }
+
+    def _check_once_traced(self, deadline=None) -> None:
+        """The ``trace=True`` host loop: one wave per iteration, each
+        phase dispatched and timed separately, scalars read back every
+        wave (this is the documented trace cost), the visitor stream
+        delivered from the chunk-state readback.  Overflow flags grow
+        the tripped buffers in place and re-run the chunk, exactly like
+        the fused loop (the aborted wave never commits its append or
+        counters, and the rehash erases its table keys)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        opts = self._options
+        cm = self._compiled
+        props = self._properties
+        f = self._max_frontier
+        cap = self._capacity
+        qcap = self._log_capacity
+        pad = self._block_pad()
+        from .wave_common import two_phase_capable
+
+        two_phase = two_phase_capable(cm)
+        from ..obs.trace import WaveTracer
+
+        tracer = WaveTracer(self._device, "tpu-wavefront")
+        self._tracer = tracer
+        visitor = opts._visitor
+        model = self._model
+        target_depth = opts._target_max_depth or 0
+
+        with jax.default_device(self._device):
+            seed, _run = self._programs()
+            progs = self._traced_programs()
+            init = cm.init_packed()
+            n_init = init.shape[0]
+            if n_init > f:
+                raise ValueError(
+                    f"{n_init} init states exceed the chunk size ({f}); "
+                    "raise spawn_tpu(max_frontier=...) to at least the "
+                    "init-state count (interior levels are unbounded)"
+                )
+            key_hi, key_lo, rows, parent, ebits, stats = seed(
+                jnp.asarray(init.astype(np.uint32)), jnp.uint32(n_init)
+            )
+            stats_h = np.asarray(stats)
+            if int(stats_h[STAT_FLAGS]):
+                raise _OverflowRetry(
+                    1,
+                    "init-state seeding overflowed the fingerprint "
+                    "table; raise spawn_tpu(capacity=...)",
+                )
+            level_start = int(stats_h[STAT_LEVEL_START])
+            level_end = int(stats_h[STAT_LEVEL_END])
+            tail = int(stats_h[STAT_TAIL])
+            depth = 0
+            disc = _device_owned(jnp.asarray(
+                np.full((len(props),), NO_SLOT_HOST, np.uint32)
+            ))
+            disc_h = np.asarray(disc)
+            with self._lock:
+                self._state_count = n_init
+                self._unique_count = int(stats_h[STAT_UNIQUE])
+
+            wave_idx = 0
+            while level_start < level_end:
+                if target_depth and depth >= target_depth - 1:
+                    # The next wave would expand states at depth+1; the
+                    # reference counts-but-never-expands target-depth
+                    # states (same gate as the fused wave_cond).
+                    break
+                count = min(level_end - level_start, f)
+                t0 = _time.perf_counter()
+                (
+                    disc, eb, states, cand_rows, cand_src, cand_act,
+                    n_valid_d, v_ovf_d, gen_d, stepflag_d,
+                ) = progs["step"](
+                    rows, ebits, disc,
+                    jnp.uint32(level_start), jnp.uint32(level_end),
+                )
+                jax.block_until_ready(cand_rows)
+                t1 = _time.perf_counter()
+                hi, lo = progs["fp"](cand_rows)
+                jax.block_until_ready(lo)
+                t2 = _time.perf_counter()
+                (
+                    key_hi, key_lo, u_new, u_origin, n_new_d, probe_ok_d,
+                    dd_ovf_d, rounds_d,
+                ) = progs["insert"](key_hi, key_lo, hi, lo, cand_act)
+                jax.block_until_ready(key_lo)
+                t3 = _time.perf_counter()
+                # Host readback: the per-wave scalar sync, plus the chunk
+                # states when a visitor is attached (the device visitor
+                # stream), plus the visitor callbacks themselves.
+                n_new = int(np.asarray(n_new_d))
+                generated = int(np.asarray(gen_d))
+                rounds = int(np.asarray(rounds_d))
+                flags = 0
+                if (
+                    not bool(np.asarray(probe_ok_d))
+                    or (self._unique_count + n_new) * 2 > cap
+                ):
+                    flags |= 1
+                if tail + n_new > qcap:
+                    flags |= 2
+                if bool(np.asarray(dd_ovf_d)) or bool(np.asarray(v_ovf_d)):
+                    flags |= 4
+                if bool(np.asarray(stepflag_d)):
+                    flags |= 8
+                disc_h = np.asarray(disc)
+                if visitor is not None and flags == 0:
+                    states_h = np.asarray(states)
+                    for i in range(count):
+                        visitor.visit(
+                            model,
+                            Path([(cm.decode(states_h[i]), None)]),
+                        )
+                t4 = _time.perf_counter()
+                if flags & 8:
+                    raise RuntimeError(
+                        "the model step kernel flagged an encoding-"
+                        "capacity overflow (a successor exceeded the "
+                        "packed layout's bounds); the compiled model's "
+                        "capacity assumptions do not hold for this "
+                        "configuration"
+                    )
+                if flags and deadline is not None and (
+                    _time.monotonic() >= deadline
+                ):
+                    # Growth costs a rehash + re-run; a run already past
+                    # its budget keeps its partial result instead (the
+                    # fused loop's policy).
+                    break
+                if flags:
+                    # Same IN-PLACE auto-tune growth as the fused loop
+                    # (one shared helper, so recovery semantics cannot
+                    # drift): this wave's append and counters have not
+                    # committed (both are gated below on flags == 0),
+                    # and the rehash erases any keys the aborted insert
+                    # wrote — the chunk simply re-runs at the grown
+                    # geometry.  ``disc`` keeps the aborted wave's
+                    # candidates: the re-run sees identical inputs
+                    # (rows/ebits/level bounds are untouched by growth),
+                    # so it recomputes exactly the same candidates —
+                    # equivalent to the fused loop's disc revert.
+                    rows, parent, ebits, key_hi, key_lo, qcap, pad = (
+                        self._grow_on_flags(
+                            flags, qcap, pad, rows, parent, ebits,
+                            tail, self._unique_count, depth,
+                        )
+                    )
+                    cap = self._capacity
+                    f = self._max_frontier  # dd growth may halve it
+                    progs = self._traced_programs()
+                    continue
+                rows, parent, ebits = progs["append"](
+                    rows, parent, ebits, cand_rows, cand_src, eb, u_new,
+                    u_origin, jnp.uint32(level_start), jnp.uint32(tail),
+                )
+                jax.block_until_ready(ebits)
+                t5 = _time.perf_counter()
+
+                tail += n_new
+                level_start += count
+                if level_start >= level_end:
+                    depth += 1
+                    level_end = tail
+                remaining = level_end - level_start
+                with self._lock:
+                    self._state_count += generated
+                    self._unique_count += n_new
+                    self._max_depth = depth + (1 if remaining else 0)
+                    for p, prop in enumerate(props):
+                        if int(disc_h[p]) != NO_SLOT_HOST:
+                            self._discovery_slots.setdefault(
+                                prop.name, int(disc_h[p])
+                            )
+                wave_idx += 1
+                phases = {
+                    "step": t1 - t0,
+                    "canon": t2 - t1,
+                    "dedup": t3 - t2,
+                    "append": t5 - t4,
+                    "readback": t4 - t3,
+                }
+                enrich = tracer.record_wave(
+                    phases, self._traced_wave_bytes(rounds, two_phase),
+                    probe_rounds=rounds,
+                )
+                if self._journal:
+                    self._journal.append(
+                        "wave",
+                        waves=wave_idx,
+                        remaining=remaining,
+                        tail=tail,
+                        unique=self._unique_count,
+                        states=self._state_count,
+                        depth=depth,
+                        flags=0,
+                        call_sec=round(t5 - t0, 6),
+                        occupancy=round(self._unique_count / cap, 6),
+                        **enrich,
+                    )
+                self._metrics.update(
+                    waves=wave_idx,
+                    table_occupancy=round(self._unique_count / cap, 6),
+                    last_call_sec=round(t5 - t0, 6),
+                )
+                self._metrics.inc("device_call_sec_total", t5 - t0)
+                self._metrics.inc("device_calls", 1)
+
+                if opts._finish_when.matches(
+                    frozenset(self._discovery_slots), props
+                ):
+                    break
+                if (
+                    opts._target_state_count is not None
+                    and opts._target_state_count <= self._state_count
+                ):
+                    break
+                if deadline is not None and _time.monotonic() >= deadline:
+                    break
+
+            # Same snapshot-ready tail as the fused loop: device tables
+            # for path reconstruction, a carry for save_snapshot, the
+            # final checkpoint, and the engine_done journal record.
+            self._tables_dev = (parent, rows)
+            stats_final = np.concatenate([
+                np.array(
+                    [
+                        level_start,
+                        level_end,
+                        tail,
+                        self._state_count & 0xFFFFFFFF,
+                        (self._state_count >> 32) & 0xFFFFFFFF,
+                        self._unique_count,
+                        depth,
+                        0,
+                    ],
+                    np.uint32,
+                ),
+                disc_h.astype(np.uint32),
+            ])
+            self._carry_dev = self._carry_from(
+                key_hi, key_lo, rows, parent, ebits, stats_final
+            )
+            if self._checkpoint_path is not None:
+                self._write_snapshot(self._checkpoint_path, self._carry_dev)
+                if self._journal:
+                    self._journal.append(
+                        "checkpoint",
+                        path=self._checkpoint_path,
+                        unique=self._unique_count,
+                        depth=self._max_depth,
+                        final=True,
+                    )
+            if self._journal:
+                self._journal.append("trace_summary", **tracer.summary())
                 self._journal.append(
                     "engine_done",
                     unique=self._unique_count,
@@ -1322,6 +1823,38 @@ class TpuChecker(Checker):
 
     def max_depth(self) -> int:
         return self._max_depth
+
+    def metrics(self) -> dict:
+        """Live observability snapshot (names: docs/OBSERVABILITY.md).
+        Safe to call mid-run — it reads the registry the host loop
+        updates from scalars it already synced, never the device.  The
+        Explorer's ``GET /.metrics`` serves exactly this."""
+        out = super().metrics()
+        out.update(
+            engine="tpu-wavefront",
+            device=str(self._device),
+            trace=self._trace,
+            capacity=self._capacity,
+            log_capacity=self._log_capacity,
+            max_frontier=self._max_frontier,
+            dedup_factor=self._dedup_factor,
+        )
+        out.update(self._metrics.snapshot())
+        if self._tracer is not None:
+            out["trace_summary"] = self._tracer.summary()
+        return out
+
+    def trace_summary(self) -> dict:
+        """The finished traced run's roofline reduction: per-phase
+        seconds (``wave_breakdown``), modeled bytes, and
+        ``hbm_util_frac`` against the device's peak table.  Requires
+        ``trace=True``."""
+        self.join()
+        if self._tracer is None:
+            raise RuntimeError(
+                "trace_summary() requires spawn_tpu(trace=True)"
+            )
+        return self._tracer.summary()
 
     def _rehash_program(self):
         """Device program inserting one row-log chunk's fingerprints into

@@ -63,9 +63,19 @@ def run_child(run_dir: str) -> int:
         relax = load_json_or_default(os.path.join(run_dir, RELAX_FILE), {})
 
         checkpoint = os.path.join(run_dir, CHECKPOINT_FILE)
-        resumed = bool(cfg.get("resume", True)) and os.path.exists(checkpoint)
         engine_kwargs = dict(spec.engine_kwargs)
         engine_kwargs.update(relax)
+        # Traced children never resume: the engines refuse trace=True +
+        # resume_from (tracing is a diagnostic mode), and a restart that
+        # passed both would die in __init__ on every attempt — burning
+        # the supervisor's restarts in seconds.  A traced child restarts
+        # from scratch instead; its journal still carries every
+        # completed wave's trace records.
+        resumed = (
+            bool(cfg.get("resume", True))
+            and os.path.exists(checkpoint)
+            and not bool(engine_kwargs.get("trace"))
+        )
         engine_kwargs.update(
             journal=journal,
             checkpoint_path=checkpoint,
@@ -119,6 +129,10 @@ def run_child(run_dir: str) -> int:
                 name: checker.discovery_classification(name)
                 for name in discoveries
             },
+            # The observability snapshot rides the durable result, so a
+            # supervised run's wave cadence / occupancy / trace summary
+            # survive the child process (docs/OBSERVABILITY.md).
+            "metrics": checker.metrics(),
         }
         tmp = os.path.join(run_dir, RESULT_FILE + ".tmp")
         with open(tmp, "w", encoding="utf-8") as fh:

@@ -11,7 +11,12 @@ per-call deadline is declared hung and restarted from the last checkpoint.
 Event schema (full field lists in docs/RUNTIME.md): every event carries
 ``t`` (unix wall time, float seconds) and ``event`` (a string tag).
 Engine events: ``resume``, ``wave``, ``checkpoint``, ``grow``,
-``engine_done``.  Child events: ``run_start``, ``run_end``,
+``engine_done``, and — traced runs only — ``trace_summary``.  Under
+``trace=True`` each ``wave`` event is enriched with ``wave_breakdown``
+(per-phase seconds), ``bytes`` (modeled bytes touched), and
+``hbm_util_frac`` (plus measured ``exchange_payload_bytes`` /
+``exchange_occupancy`` on the sharded engine) — the journal doubles as
+the wave-trace stream (docs/OBSERVABILITY.md).  Child events: ``run_start``, ``run_end``,
 ``child_error``.  Supervisor events: ``supervisor_start``, ``crash``,
 ``hang``, ``relax``, ``restart``, ``wall_timeout``, ``give_up``,
 ``supervisor_done``.  Chaos-runtime events (``runtime/chaos.py``, see

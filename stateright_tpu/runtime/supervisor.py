@@ -22,6 +22,16 @@ supervisor turns those primitives into resilience:
 
 This is the swarm-verification / TLC-checkpointing recipe (PAPERS.md):
 restartable workers plus durable progress state.
+
+Observability: the child's ``result.json`` carries the checker's full
+``metrics()`` snapshot, and a child spawned with ``trace=True`` in its
+engine kwargs streams enriched per-wave trace records (and a final
+``trace_summary`` event) into the run dir's ``journal.jsonl`` — the
+wave-trace artifact (docs/OBSERVABILITY.md).  ``relax_geometry`` never
+touches ``trace``: backoff changes tuning knobs only, and whether a run
+is traced is a user decision, not a geometry.  Traced children never
+RESUME (the engines refuse trace+resume); a restarted traced child
+starts from scratch, keeping its journaled trace records.
 """
 
 from __future__ import annotations

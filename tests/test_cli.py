@@ -215,6 +215,46 @@ def test_checkpoint_dir_flag_value_missing_is_clean_error():
     assert "requires a directory" in r.stderr
 
 
+def test_trace_flag_requires_check_tpu_and_rejects_resume():
+    r = run_cli("twophase", "check", "3", "--trace")
+    assert r.returncode == 2
+    assert "check-tpu" in r.stderr
+    r = run_cli("twophase", "check-tpu", "3", "--trace", "--resume",
+                "--checkpoint-dir", "/tmp/x")
+    assert r.returncode == 2
+    assert "--trace" in r.stderr
+
+
+@pytest.mark.slow
+def test_check_tpu_trace_emits_breakdown(tmp_path):
+    """`check-tpu --trace` completes with the golden count, prints the
+    one-line roofline reduction, and (with --checkpoint-dir) leaves the
+    enriched wave-trace records in the run journal — the CI artifact
+    path (docs/OBSERVABILITY.md)."""
+    run_dir = str(tmp_path / "trace-run")
+    r = run_cli(
+        "twophase", "check-tpu", "3", "--trace",
+        "--checkpoint-dir", run_dir, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "unique=288" in r.stdout
+    trace_line = next(
+        ln for ln in r.stdout.splitlines() if ln.startswith("trace: ")
+    )
+    summary = json.loads(trace_line[len("trace: "):])
+    assert summary["traced_waves"] >= 1
+    assert set(summary["wave_breakdown"]) == {
+        "step", "canon", "dedup", "append", "readback",
+    }
+    from stateright_tpu.runtime.journal import read_journal
+
+    waves = [
+        e for e in read_journal(os.path.join(run_dir, "journal.jsonl"))
+        if e["event"] == "wave"
+    ]
+    assert waves and all("wave_breakdown" in w for w in waves)
+
+
 @pytest.mark.slow
 def test_check_tpu_supervised_writes_journal_and_checkpoint(tmp_path):
     """`check-tpu --supervise --checkpoint-dir` completes the check

@@ -59,6 +59,48 @@ def test_states_endpoint_navigation(served):
     assert deeper[0]["state"] == "0"
 
 
+def test_metrics_endpoint_parity_with_status(served):
+    """GET /.metrics beside /.status: same counts, plus the engine tag —
+    the live observability surface (docs/OBSERVABILITY.md)."""
+    _checker, base = served
+    status = _get(base + "/.status")
+    metrics = _get(base + "/.metrics")
+    for key in ("state_count", "unique_state_count", "max_depth", "done"):
+        assert metrics[key] == status[key]
+    assert metrics["engine"] == "OnDemandChecker"
+
+
+def test_metrics_endpoint_on_tpu_backed_explorer():
+    """A TPU-backed Explorer serves the device engine's metrics — wave
+    cadence and table occupancy appear once the run completes."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    checker = TwoPhaseSys(rm_count=3).checker().serve(
+        ("127.0.0.1", 0),
+        block=False,
+        engine="tpu",
+        capacity=1 << 14,
+        max_frontier=1 << 9,
+    )
+    try:
+        host, port = checker.explorer_address
+        base = f"http://{host}:{port}"
+        deadline = time.time() + 120
+        metrics = _get(base + "/.metrics")
+        while not metrics["done"] and time.time() < deadline:
+            time.sleep(0.2)
+            metrics = _get(base + "/.metrics")
+        assert metrics["done"]
+        assert metrics["engine"] == "tpu-wavefront"
+        assert metrics["unique_state_count"] == 288
+        assert metrics["waves"] >= 1
+        assert 0 < metrics["table_occupancy"] <= 1
+        status = _get(base + "/.status")
+        assert metrics["unique_state_count"] == status["unique_state_count"]
+    finally:
+        checker.explorer_server.shutdown()
+
+
 def test_states_endpoint_rejects_bad_fingerprints(served):
     _checker, base = served
     for bad in ("/.states/notanumber", "/.states/12345"):

@@ -142,3 +142,29 @@ def test_graph_engine_uses_native_set_when_threaded():
 
     dfs = model.checker().threads(2).spawn_dfs().join()
     assert dfs.unique_state_count() == single.unique_state_count()
+
+
+def test_twophase_native_bfs_reference_goldens():
+    """The C++ hot-loop BFS (bench.py's `denominator_native` phase)
+    explores exactly the direct 2pc reachable space: reference goldens
+    288 (3 RMs) and 8,832 (5 RMs, examples/2pc.rs:151-159), with the
+    framework's depth convention and generated-state counts."""
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.ops.native import twophase_bfs_native
+
+    host = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    r = twophase_bfs_native(3)
+    assert r["unique_states"] == host.unique_state_count() == 288
+    assert r["generated"] == host.state_count()
+    assert r["max_depth"] == host.max_depth()
+
+    assert twophase_bfs_native(5)["unique_states"] == 8_832
+
+
+def test_twophase_native_bfs_guards():
+    from stateright_tpu.ops.native import twophase_bfs_native
+
+    with pytest.raises(RuntimeError, match="rc="):
+        twophase_bfs_native(13)  # past the packed layout's 12-RM bound
+    with pytest.raises(RuntimeError, match="rc="):
+        twophase_bfs_native(5, max_unique=100)  # memory guard trips
