@@ -43,6 +43,16 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
                      " [--trace]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
+    lines.append(
+        "  serve [ADDRESS] [--journal PATH] [--knob-cache DIR]"
+        " [--workers N]"
+    )
+    lines.append(
+        f"  submit [{n_meta}]{net} [--address ADDR] [--engine ENGINE]"
+        " [--portfolio K] [--portfolio-seed S] [--priority P]"
+        " [--no-wait]"
+    )
+    lines.append("  status [JOB_ID] [--address ADDR]")
     if spec.spawn is not None:
         lines.append(
             "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
@@ -273,16 +283,8 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
     )
 
     run_dir = os.path.abspath(ckpt_dir)
-    # The model module's runnable name: the build callable's __module__,
-    # EXCEPT when this process was started as `python -m <module>` — then
-    # the lambda lives in __main__ and the real dotted name is on
-    # __main__.__spec__ (set by runpy).
-    module = spec.build.__module__
-    if module == "__main__":
-        main_spec = getattr(sys.modules.get("__main__"), "__spec__", None)
-        if main_spec is not None and main_spec.name:
-            module = main_spec.name
-    if module == "__main__":
+    module = _module_name(spec)
+    if module is None:
         print(
             "--supervise requires running the model module via "
             "`python -m stateright_tpu.models.<name>` (the supervisor "
@@ -318,6 +320,211 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
             f"checkpointed in {run_dir}",
             file=sys.stderr,
         )
+        return 1
+    # Propagate the child's verdict: a supervised check that completed
+    # WITH a violation still gates (VIOLATION_RC), it just isn't a
+    # crash the supervisor retries.
+    return sup.last_child_rc or 0
+
+
+# --- checking-service client verbs (docs/SERVING.md) -------------------------
+
+def _module_name(spec: "CliSpec") -> Optional[str]:
+    """The model module's runnable dotted name — the build callable's
+    __module__, EXCEPT when this process was started as `python -m
+    <module>`: then the lambda lives in __main__ and the real name is on
+    __main__.__spec__ (set by runpy)."""
+    module = spec.build.__module__
+    if module == "__main__":
+        main_spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+        if main_spec is not None and main_spec.name:
+            module = main_spec.name
+    return None if module == "__main__" else module
+
+
+def _workload_name(spec: "CliSpec") -> Optional[str]:
+    """The service workload name this model module is registered under
+    (serve/workloads.py): the module's last dotted component."""
+    module = _module_name(spec)
+    if module is None or not module.startswith("stateright_tpu.models."):
+        return None
+    return module.rsplit(".", 1)[1]
+
+
+def _http_json(method: str, url: str, body=None, timeout: float = 30.0):
+    """One JSON request against the checking service; raises ValueError
+    with the server's error message on a 4xx/5xx."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    data = None if body is None else _json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            detail = _json.loads(e.read()).get("error", "")
+        except Exception:
+            detail = ""
+        raise ValueError(
+            f"{method} {url}: HTTP {e.code}"
+            + (f": {detail}" if detail else "")
+        ) from None
+    except urllib.error.URLError as e:
+        raise ValueError(
+            f"cannot reach the checking service at {url}: {e.reason} "
+            "(start one with the `serve` subcommand or "
+            "`python -m stateright_tpu.serve`)"
+        ) from None
+
+
+class SubmitOptions:
+    def __init__(self):
+        # One source of truth for the service's default address: the
+        # daemon entry point the client verbs talk to.
+        from .serve.__main__ import DEFAULT_ADDRESS
+
+        self.address = DEFAULT_ADDRESS
+        self.engine: Optional[str] = None
+        self.portfolio = 0
+        self.portfolio_seed = 0
+        self.priority = 0
+        self.no_wait = False
+
+
+def _parse_submit_flags(args):
+    """Flags for ``submit``/``status``; returns (positionals, options)
+    or raises ValueError."""
+    opts = SubmitOptions()
+    out = []
+    i = 0
+
+    def value_of(flag, cast=str):
+        nonlocal i
+        i += 1
+        if i >= len(args):
+            raise ValueError(f"{flag} requires a value")
+        try:
+            return cast(args[i])
+        except ValueError:
+            raise ValueError(f"{flag} requires a {cast.__name__}") from None
+
+    while i < len(args):
+        a = args[i]
+        if a == "--address":
+            opts.address = value_of(a)
+        elif a == "--engine":
+            opts.engine = value_of(a)
+        elif a == "--portfolio":
+            opts.portfolio = value_of(a, int)
+        elif a == "--portfolio-seed":
+            opts.portfolio_seed = value_of(a, int)
+        elif a == "--priority":
+            opts.priority = value_of(a, int)
+        elif a == "--no-wait":
+            opts.no_wait = True
+        else:
+            out.append(a)
+        i += 1
+    return out, opts
+
+
+def _run_submit(spec: "CliSpec", args) -> int:
+    """Client half of the checking service: POST this model as a job,
+    poll to a terminal state, exit on the verdict — 0 clean,
+    VIOLATION_RC on a discovered violation, 1 on failure/cancellation
+    (so CI can gate on a served check exactly like on check-tpu)."""
+    import json as _json
+
+    from .runtime.supervisor import VIOLATION_RC
+
+    try:
+        args, opts = _parse_submit_flags(args)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    n = _parse_n(args, spec.default_n)
+    network = None
+    if spec.default_network is not None and args and args[0] in Network.names():
+        network = args.pop(0)
+    _reject_leftovers(args, spec)
+    workload = _workload_name(spec)
+    if workload is None:
+        print(
+            "submit requires running the model module via "
+            "`python -m stateright_tpu.models.<name>` (the job names "
+            "that workload to the service)",
+            file=sys.stderr,
+        )
+        return 2
+    body = {
+        "workload": workload,
+        "n": n,
+        "engine": opts.engine or ("tpu" if spec.tpu else "bfs"),
+        "priority": opts.priority,
+    }
+    if network is not None:
+        body["network"] = network
+    if opts.portfolio:
+        body["portfolio"] = {
+            "size": opts.portfolio, "seed": opts.portfolio_seed,
+        }
+    base = f"http://{opts.address}"
+    try:
+        resp = _http_json("POST", base + "/jobs", body)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 1
+    job_id = resp["id"]
+    print(f"submitted {job_id} ({workload} n={n}) to {base}")
+    if opts.no_wait:
+        return 0
+    while True:
+        try:
+            snap = _http_json(
+                "GET", f"{base}/jobs/{job_id}/result?wait=10",
+                timeout=30.0,
+            )
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 1
+        if snap["state"] not in ("queued", "running"):
+            break
+    print(_json.dumps(snap, sort_keys=True))
+    if snap["state"] != "done":
+        print(f"job {job_id} {snap['state']}: {snap.get('error') or ''}",
+              file=sys.stderr)
+        return 1
+    if (snap.get("result") or {}).get("violation"):
+        print(
+            f"violation discovered: {snap['result']['violation']}",
+            file=sys.stderr,
+        )
+        return VIOLATION_RC
+    return 0
+
+
+def _run_status(spec: "CliSpec", args) -> int:
+    import json as _json
+
+    try:
+        args, opts = _parse_submit_flags(args)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    job_id = args.pop(0) if args else None
+    _reject_leftovers(args, spec)
+    base = f"http://{opts.address}"
+    url = f"{base}/jobs/{job_id}" if job_id else f"{base}/jobs"
+    try:
+        print(_json.dumps(_http_json("GET", url), sort_keys=True))
+    except ValueError as e:
+        print(e, file=sys.stderr)
         return 1
     return 0
 
@@ -437,6 +644,24 @@ def example_main(spec: CliSpec, argv=None) -> int:
             import json as _json
 
             print("trace: " + _json.dumps(checker.trace_summary()))
+        if sub == "check-tpu":
+            # Gateable verdict (docs/SERVING.md): a COMPLETED check that
+            # discovered a counterexample exits VIOLATION_RC so CI and
+            # service callers can gate on the result without parsing the
+            # report.  Examples (sometimes-property discoveries) are not
+            # violations.
+            from .runtime.supervisor import VIOLATION_RC
+
+            violations = sorted(
+                name for name in checker.discoveries()
+                if checker.discovery_classification(name) == "counterexample"
+            )
+            if violations:
+                print(
+                    "violation discovered: " + ", ".join(violations),
+                    file=sys.stderr,
+                )
+                return VIOLATION_RC
         return 0
 
     if sub == "check-simulation":
@@ -512,6 +737,20 @@ def example_main(spec: CliSpec, argv=None) -> int:
             return 2
         rc = spec.spawn(chaos=chaos)
         return int(rc) if rc else 0
+
+    if sub == "serve":
+        # The checking-service daemon (serve/server.py): one process,
+        # one mesh, many jobs — every registered workload is servable,
+        # whichever model module launched it.
+        from .serve.__main__ import main as serve_main
+
+        return serve_main(args)
+
+    if sub == "submit":
+        return _run_submit(spec, args)
+
+    if sub == "status":
+        return _run_status(spec, args)
 
     print(_usage(spec.name, spec))
     return 2

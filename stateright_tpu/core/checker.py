@@ -9,6 +9,7 @@ framework — as a first-class sibling of the reference spawn methods.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -168,6 +169,13 @@ class Checker:
 
     def __init__(self, model: Model):
         self._model = model
+        # Cooperative cancellation (the serving layer's job-cancel path,
+        # serve/scheduler.py): request_stop() asks the engine to wind
+        # down at its next host-side check, exactly like a wall-clock
+        # timeout — partial counts stand, is_done() becomes true, join()
+        # returns.  Engines poll stop_requested() at the same points they
+        # poll their deadline.
+        self._stop_requested = threading.Event()
 
     # --- interface implemented by engines -----------------------------------
 
@@ -202,6 +210,18 @@ class Checker:
 
     def run_to_completion(self) -> None:
         pass  # only meaningful for on-demand checking
+
+    def request_stop(self) -> None:
+        """Ask a running check to stop early (cooperative, never blocks):
+        the engine finishes its current block/device call, keeps every
+        committed count and discovery, and completes like a timed-out
+        run.  Idempotent; a no-op on an already-finished checker.
+        Engines with extra wakeup machinery extend this (the host graph
+        engine closes its job market so idle workers drain)."""
+        self._stop_requested.set()
+
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
 
     def metrics(self) -> dict:
         """Live observability snapshot — counts every engine has; the

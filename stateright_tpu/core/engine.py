@@ -169,6 +169,8 @@ class GraphChecker(Checker):
                     if not pending:
                         return
                 self._check_block(pending, BLOCK_SIZE)
+                if self._stop_requested.is_set():
+                    return
                 if (
                     self._close_at is not None
                     and time.monotonic() >= self._close_at
@@ -333,6 +335,12 @@ class GraphChecker(Checker):
 
     def handles(self) -> List[threading.Thread]:
         return self._handles
+
+    def request_stop(self) -> None:
+        # Busy workers see the event after their current block; idle
+        # workers blocked in market.pop() need the market closed to wake.
+        super().request_stop()
+        self._market.close()
 
     def is_done(self) -> bool:
         return self._market.is_closed or len(self._discoveries) == len(

@@ -248,6 +248,10 @@ class SimulationChecker(Checker):
         ``timeout`` nor ``target_state_count``)."""
         self._shutdown.set()
 
+    def request_stop(self) -> None:
+        super().request_stop()
+        self._shutdown.set()
+
     def is_done(self) -> bool:
         return all(not h.is_alive() for h in self._handles)
 

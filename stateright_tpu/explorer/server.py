@@ -199,6 +199,18 @@ def serve(builder, address, block: bool = True, engine: str = "on_demand",
         checker = builder.spawn_tpu(**engine_kwargs)
     else:
         raise ValueError(f"unknown explorer engine {engine!r}")
+    return serve_checker(checker, address, block=block, snapshot=snapshot)
+
+
+def serve_checker(checker, address, block: bool = True, snapshot=None):
+    """Serve the Explorer UI over an EXISTING checker — the attach path
+    the checking service uses to open a browser on a completed job's
+    checker (serve/server.py ``POST /jobs/<id>/explore``) without
+    re-running the check.  ``snapshot`` is the recent-path sampling
+    visitor when the caller wired one into the spawn; state views are
+    host-re-executed against the checker's model exactly as in
+    :func:`serve`."""
+    snapshot = snapshot or _Snapshot()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet

@@ -279,9 +279,9 @@ def run_chaos_audit(chaos, fault=None, client_count=2, put_count=2) -> dict:
     )
 
 
-def main(argv=None) -> int:
-    """CLI mirroring examples/linearizable-register.rs."""
-    from ..cli import CliSpec, example_main, spawn_register_system
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec, spawn_register_system
 
     def spawn_servers(chaos=None):
         import json as _json
@@ -338,21 +338,25 @@ def main(argv=None) -> int:
             make_transport=make_transport,
         )
 
-    return example_main(
-        CliSpec(
-            name="ABD linearizable register",
-            build=lambda n, net: AbdModelCfg(
-                client_count=n, server_count=2, network=net
-            ).into_model(),
-            default_n=2,
-            n_meta="CLIENT_COUNT",
-            default_network="unordered_nonduplicating",
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 13, max_frontier=1 << 8),
-            spawn=spawn_servers,
-        ),
-        argv,
+    return CliSpec(
+        name="ABD linearizable register",
+        build=lambda n, net: AbdModelCfg(
+            client_count=n, server_count=2, network=net
+        ).into_model(),
+        default_n=2,
+        n_meta="CLIENT_COUNT",
+        default_network="unordered_nonduplicating",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 13, max_frontier=1 << 8),
+        spawn=spawn_servers,
     )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/linearizable-register.rs."""
+    from ..cli import example_main
+
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

@@ -232,6 +232,33 @@ class TrapCounterCompiled(CompiledModel):
         return CanonSpec(n=0)
 
 
+def cli_spec():
+    """CLI/workload spec for :class:`TrapCounter` — the smallest
+    KNOWN-VIOLATING workload with a compiled device form ("reaches
+    limit" has a genuine counterexample ending in the trap terminal).
+    Registered so the checking service (serve/workloads.py), its CI
+    smoke, and the violation-exit-code CLI test all have a fast
+    violating job to submit."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="trap counter",
+        # limit must clear trap_at=2 or the trap edge is unreachable
+        # and the fixture stops violating.
+        build=lambda n: TrapCounter(limit=max(n, 3)),
+        default_n=5,
+        n_meta="LIMIT",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 10, max_frontier=1 << 6),
+    )
+
+
+def main(argv=None) -> int:
+    from ..cli import example_main
+
+    return example_main(cli_spec(), argv)
+
+
 class FnModel(Model):
     """A model defined by a function ``fn(prev_state_or_None, out_list)`` —
     the analog of the reference's blanket Model impl for functions
@@ -250,3 +277,9 @@ class FnModel(Model):
 
     def next_state(self, state, action):
         return action
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

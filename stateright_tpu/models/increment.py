@@ -125,29 +125,34 @@ class IncrementLock(Model):
         ]
 
 
+def cli_spec(lock: bool = False):
+    """This module's CLI/workload spec (resolved by serve/workloads.py);
+    the unlocked variant genuinely violates its "fin" invariant."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="increment-lock" if lock else "increment",
+        build=lambda n: (IncrementLock if lock else Increment)(
+            thread_count=n
+        ),
+        default_n=2,
+        n_meta="THREAD_COUNT",
+        symmetry=True,
+    )
+
+
 def main(argv=None) -> int:
     """CLI mirroring examples/increment.rs and examples/increment_lock.rs;
     pass ``lock`` as the first argument for the locked variant."""
     import sys as _sys
 
-    from ..cli import CliSpec, example_main
+    from ..cli import example_main
 
     args = list(_sys.argv[1:] if argv is None else argv)
     lock = bool(args) and args[0] == "lock"
     if lock:
         args = args[1:]
-    return example_main(
-        CliSpec(
-            name="increment-lock" if lock else "increment",
-            build=lambda n: (IncrementLock if lock else Increment)(
-                thread_count=n
-            ),
-            default_n=2,
-            n_meta="THREAD_COUNT",
-            symmetry=True,
-        ),
-        args,
-    )
+    return example_main(cli_spec(lock), args)
 
 
 if __name__ == "__main__":

@@ -125,27 +125,31 @@ def build_model(num_actors: int = 2) -> ActorModel:
     )
 
 
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="LWW-register CRDT",
+        build=lambda n: build_model(num_actors=n),
+        default_n=2,
+        n_meta="ACTOR_COUNT",
+        # The CRDT walk is unbounded (clocks skew forever); the
+        # reference's check bounds depth at 8 by default
+        # (examples/lww-register.rs:194-196).  The device run bounds
+        # tighter to fit its default table capacity.
+        target_max_depth=8,
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 9),
+        tpu_target_max_depth=6,
+    )
+
+
 def main(argv=None) -> int:
     """CLI mirroring examples/lww-register.rs."""
-    from ..cli import CliSpec, example_main
+    from ..cli import example_main
 
-    return example_main(
-        CliSpec(
-            name="LWW-register CRDT",
-            build=lambda n: build_model(num_actors=n),
-            default_n=2,
-            n_meta="ACTOR_COUNT",
-            # The CRDT walk is unbounded (clocks skew forever); the
-            # reference's check bounds depth at 8 by default
-            # (examples/lww-register.rs:194-196).  The device run bounds
-            # tighter to fit its default table capacity.
-            target_max_depth=8,
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 9),
-            tpu_target_max_depth=6,
-        ),
-        argv,
-    )
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

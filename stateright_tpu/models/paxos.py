@@ -240,9 +240,9 @@ class PaxosModelCfg:
         return model
 
 
-def main(argv=None) -> int:
-    """CLI mirroring examples/paxos.rs:355-513."""
-    from ..cli import CliSpec, example_main, spawn_register_system
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec, spawn_register_system
 
     def spawn_servers():
         from ..actor.register import (
@@ -265,21 +265,25 @@ def main(argv=None) -> int:
             "Single Decree Paxos",
         )
 
-    return example_main(
-        CliSpec(
-            name="Single Decree Paxos",
-            build=lambda n, net: PaxosModelCfg(
-                client_count=n, server_count=3, network=net
-            ).into_model(),
-            default_n=2,
-            n_meta="CLIENT_COUNT",
-            default_network="unordered_nonduplicating",
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 23, max_frontier=1 << 13),
-            spawn=spawn_servers,
-        ),
-        argv,
+    return CliSpec(
+        name="Single Decree Paxos",
+        build=lambda n, net: PaxosModelCfg(
+            client_count=n, server_count=3, network=net
+        ).into_model(),
+        default_n=2,
+        n_meta="CLIENT_COUNT",
+        default_network="unordered_nonduplicating",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 23, max_frontier=1 << 13),
+        spawn=spawn_servers,
     )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/paxos.rs:355-513."""
+    from ..cli import example_main
+
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

@@ -104,23 +104,27 @@ class PingPongCfg:
         )
 
 
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="ping_pong",
+        build=lambda n: PingPongCfg(
+            maintains_history=False, max_nat=n
+        ).into_model(),
+        default_n=5,
+        n_meta="MAX_NAT",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 10),
+    )
+
+
 def main(argv=None) -> int:
     """CLI for the ping_pong fixture (src/actor/actor_test_util.rs)."""
-    from ..cli import CliSpec, example_main
+    from ..cli import example_main
 
-    return example_main(
-        CliSpec(
-            name="ping_pong",
-            build=lambda n: PingPongCfg(
-                maintains_history=False, max_nat=n
-            ).into_model(),
-            default_n=5,
-            n_meta="MAX_NAT",
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 16, max_frontier=1 << 10),
-        ),
-        argv,
-    )
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

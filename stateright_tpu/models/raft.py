@@ -431,26 +431,30 @@ class RaftModelCfg:
         return model
 
 
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="raft",
+        build=lambda n, net: RaftModelCfg(
+            server_count=n, network=net
+        ).into_model(),
+        default_n=3,
+        n_meta="SERVER_COUNT",
+        default_network="unordered_nonduplicating",
+        target_max_depth=12,
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 10),
+        tpu_target_max_depth=9,
+    )
+
+
 def main(argv=None) -> int:
     """CLI mirroring examples/raft.rs (default check bounds depth at 12)."""
-    from ..cli import CliSpec, example_main
+    from ..cli import example_main
 
-    return example_main(
-        CliSpec(
-            name="raft",
-            build=lambda n, net: RaftModelCfg(
-                server_count=n, network=net
-            ).into_model(),
-            default_n=3,
-            n_meta="SERVER_COUNT",
-            default_network="unordered_nonduplicating",
-            target_max_depth=12,
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 10),
-            tpu_target_max_depth=9,
-        ),
-        argv,
-    )
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

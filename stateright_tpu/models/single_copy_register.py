@@ -84,9 +84,9 @@ class SingleCopyModelCfg:
         return model
 
 
-def main(argv=None) -> int:
-    """CLI mirroring examples/single-copy-register.rs."""
-    from ..cli import CliSpec, example_main, spawn_register_system
+def cli_spec():
+    """This module's CLI/workload spec (resolved by serve/workloads.py)."""
+    from ..cli import CliSpec, spawn_register_system
 
     def spawn_servers():
         from ..actor.register import (
@@ -101,21 +101,25 @@ def main(argv=None) -> int:
             "single-copy register",
         )
 
-    return example_main(
-        CliSpec(
-            name="single-copy register",
-            build=lambda n, net: SingleCopyModelCfg(
-                client_count=n, server_count=1, network=net
-            ).into_model(),
-            default_n=2,
-            n_meta="CLIENT_COUNT",
-            default_network="unordered_nonduplicating",
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 12, max_frontier=1 << 7),
-            spawn=spawn_servers,
-        ),
-        argv,
+    return CliSpec(
+        name="single-copy register",
+        build=lambda n, net: SingleCopyModelCfg(
+            client_count=n, server_count=1, network=net
+        ).into_model(),
+        default_n=2,
+        n_meta="CLIENT_COUNT",
+        default_network="unordered_nonduplicating",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 12, max_frontier=1 << 7),
+        spawn=spawn_servers,
     )
+
+
+def main(argv=None) -> int:
+    """CLI mirroring examples/single-copy-register.rs."""
+    from ..cli import example_main
+
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

@@ -145,22 +145,27 @@ class TwoPhaseSys(Model):
         ]
 
 
+def cli_spec():
+    """This module's CLI/workload spec — also the unit the checking
+    service resolves job submissions against (serve/workloads.py)."""
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="two-phase commit",
+        build=lambda n: TwoPhaseSys(rm_count=n),
+        default_n=3,
+        n_meta="RM_COUNT",
+        symmetry=True,
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 13),
+    )
+
+
 def main(argv=None) -> int:
     """CLI mirroring examples/2pc.rs:172-239."""
-    from ..cli import CliSpec, example_main
+    from ..cli import example_main
 
-    return example_main(
-        CliSpec(
-            name="two-phase commit",
-            build=lambda n: TwoPhaseSys(rm_count=n),
-            default_n=3,
-            n_meta="RM_COUNT",
-            symmetry=True,
-            tpu=True,
-            tpu_kwargs=dict(capacity=1 << 20, max_frontier=1 << 13),
-        ),
-        argv,
-    )
+    return example_main(cli_spec(), argv)
 
 
 if __name__ == "__main__":

@@ -50,3 +50,17 @@ class MetricsRegistry:
     def snapshot(self) -> Dict[str, Number]:
         with self._lock:
             return dict(self._values)
+
+
+# Process-global registry for counters that outlive any one checker —
+# the compiled-program cache's hit/miss counters in particular
+# (parallel/wave_common.cached_program), which are the measured evidence
+# behind the serving layer's warm-start story: a second identical job
+# reuses the first job's compiled programs, so its hit counter moves and
+# its warmup does not (docs/SERVING.md).  Served by the check service's
+# aggregated ``GET /.metrics`` (serve/server.py).
+GLOBAL = MetricsRegistry()
+
+
+def global_metrics() -> MetricsRegistry:
+    return GLOBAL

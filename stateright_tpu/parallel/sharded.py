@@ -1303,6 +1303,10 @@ class ShardedTpuChecker(Checker):
                 break
             if deadline is not None and _time.monotonic() >= deadline:
                 break
+            if self._stop_requested.is_set():
+                # Cooperative cancel (serve/scheduler.py): wind down like
+                # a deadline — committed counts stand.
+                break
 
         self._accounting = self._build_accounting(
             waves, cand_total, unique_l
@@ -1656,6 +1660,8 @@ class ShardedTpuChecker(Checker):
             ):
                 break
             if deadline is not None and _time.monotonic() >= deadline:
+                break
+            if self._stop_requested.is_set():
                 break
 
         # Weak-scaling accounting: lockstep waves, the static all_to_all

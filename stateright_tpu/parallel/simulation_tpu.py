@@ -372,6 +372,10 @@ class TpuSimulationChecker(Checker):
         ``_shutdown`` event, core/simulation.py)."""
         self._shutdown.set()
 
+    def request_stop(self) -> None:
+        super().request_stop()
+        self._shutdown.set()
+
     def join(self) -> "TpuSimulationChecker":
         self._thread.join()
         if self._errors:

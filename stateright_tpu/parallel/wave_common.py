@@ -110,13 +110,23 @@ def cached_program(cache: dict, max_size: int, key, build):
     """Bounded-FIFO memo for compiled engine programs, shared by the
     single-chip and sharded engines so the key-tuple + eviction idiom
     exists once.  The KEY must cover everything the built closure traces
-    over — a stale hit is a silent wrong-program bug."""
+    over — a stale hit is a silent wrong-program bug.
+
+    Hits and misses count into the process-global metrics registry
+    (``program_cache_hits`` / ``program_cache_misses``): the observable
+    evidence that a warm repeat of a workload skipped its compiles —
+    the checking service's warmup-reuse counter (docs/SERVING.md)."""
+    from ..obs.metrics import GLOBAL
+
     prog = cache.get(key)
     if prog is None:
+        GLOBAL.inc("program_cache_misses")
         prog = build()
         while len(cache) >= max_size:
             cache.pop(next(iter(cache)))
         cache[key] = prog
+    else:
+        GLOBAL.inc("program_cache_hits")
     return prog
 
 

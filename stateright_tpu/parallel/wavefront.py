@@ -1179,12 +1179,14 @@ class TpuChecker(Checker):
                         "bounds); the compiled model's capacity assumptions "
                         "do not hold for this configuration"
                     )
-                if flags_h and deadline is not None and (
-                    _time.monotonic() >= deadline
+                if flags_h and (
+                    self._stop_requested.is_set()
+                    or (deadline is not None
+                        and _time.monotonic() >= deadline)
                 ):
                     # Growth costs a recompile + rehash + re-run; a run
-                    # already past its budget keeps its partial result
-                    # instead.
+                    # already past its budget (or asked to stop) keeps
+                    # its partial result instead.
                     break
                 if flags_h:
                     # The flagged wave did not commit (see wave_body), so
@@ -1219,6 +1221,10 @@ class TpuChecker(Checker):
                 ):
                     break
                 if deadline is not None and _time.monotonic() >= deadline:
+                    break
+                if self._stop_requested.is_set():
+                    # Cooperative cancel (serve/scheduler.py): wind down
+                    # exactly like a deadline — committed counts stand.
                     break
 
             # Keep the device arrays; path reconstruction walks the parent
@@ -1553,12 +1559,14 @@ class TpuChecker(Checker):
                         "capacity assumptions do not hold for this "
                         "configuration"
                     )
-                if flags and deadline is not None and (
-                    _time.monotonic() >= deadline
+                if flags and (
+                    self._stop_requested.is_set()
+                    or (deadline is not None
+                        and _time.monotonic() >= deadline)
                 ):
                     # Growth costs a rehash + re-run; a run already past
-                    # its budget keeps its partial result instead (the
-                    # fused loop's policy).
+                    # its budget (or asked to stop) keeps its partial
+                    # result instead (the fused loop's policy).
                     break
                 if flags:
                     # Same IN-PLACE auto-tune growth as the fused loop
@@ -1648,6 +1656,8 @@ class TpuChecker(Checker):
                 ):
                     break
                 if deadline is not None and _time.monotonic() >= deadline:
+                    break
+                if self._stop_requested.is_set():
                     break
 
             # Same snapshot-ready tail as the fused loop: device tables

@@ -36,36 +36,46 @@ from typing import Dict, List, Optional
 
 class Journal:
     """Appends events to a JSONL file; safe to share a path across
-    processes (each instance holds its own append-mode handle) and to
-    share one instance across threads (the chaos transport's actor and
-    delay-timer threads all append through a single journal)."""
+    processes (each instance holds its own ``O_APPEND`` descriptor) and
+    to share one instance across threads (the chaos transport's actor and
+    delay-timer threads — and the checking service's concurrent jobs
+    (serve/scheduler.py) — all append through a single journal).
+
+    Line atomicity is the contract concurrent writers rely on: each
+    event is one ``os.write`` of the whole encoded line on an
+    ``O_APPEND`` descriptor, so the kernel's atomic append (offset
+    lookup + write under the inode lock) lands every line contiguously
+    at the true end of file — a buffered ``TextIOWrapper`` could split
+    one line across several syscalls and interleave torn halves from
+    two writers (pinned by tests/test_runtime.py's interleaved-writer
+    test)."""
 
     def __init__(self, path: str):
         self.path = str(path)
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        self._fh = None
+        self._fd: Optional[int] = None
         self._lock = threading.Lock()
 
     def append(self, event: str, **fields) -> dict:
         record = {"t": time.time(), "event": event}
         record.update(fields)
-        line = json.dumps(record, sort_keys=True, default=str) + "\n"
+        line = (json.dumps(record, sort_keys=True, default=str) + "\n").encode(
+            "utf-8"
+        )
         with self._lock:
-            if self._fh is None:
-                # O_APPEND semantics: every writer's line lands at the
-                # true end of file even when the supervisor and child
-                # interleave.
-                self._fh = open(self.path, "a", encoding="utf-8")
-            self._fh.write(line)
-            self._fh.flush()
+            if self._fd is None:
+                self._fd = os.open(
+                    self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+                )
+            os.write(self._fd, line)
         return record
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
 
     def __enter__(self) -> "Journal":
         return self

@@ -16,21 +16,47 @@ undersized knobs in place mid-run, and the caller golden-gates every
 measured run — a cache entry that no longer reproduces the golden is
 dropped (:func:`drop_knobs`) and the caller falls back to a fresh
 discovery.  Writes are atomic (write + rename) so concurrent children
-can never leave a torn file; last writer wins, which is fine for a
-cache.
+can never leave a torn file.  Within one process every mutation holds a
+module lock around its read-merge-write, so the checking service's
+concurrent jobs (serve/scheduler.py) never lose each other's entries;
+ACROSS processes (bench suite children) last-whole-file-writer wins,
+which is fine for a cache whose entries are all independently
+rediscoverable.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional
 
 KNOBS_FILE = "knobs.json"
 
+# Serializes read-merge-write cycles within this process (two service
+# jobs storing knobs for different workloads must both survive).
+_LOCK = threading.Lock()
+
 
 def _path(cache_dir: str) -> str:
     return os.path.join(cache_dir, KNOBS_FILE)
+
+
+def knob_key(label: str, engine: str = "tpu-wavefront-v1") -> str:
+    """The canonical cache key: workload label + device identity +
+    engine/protocol version (geometry defaults change what discovery
+    finds).  One definition shared by bench.py and the checking service
+    (serve/scheduler.py) so the key FORMAT cannot drift; their label
+    namespaces stay deliberately disjoint ("2pc_check_5" vs
+    "serve:twophase:5") because the two discover different things —
+    bench persists auto-tune-shrunk measurement sizes, the service its
+    jobs' exact final spawn geometry.  Imports jax lazily — callers
+    already run on a device."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform)
+    return f"{label}|{d.platform}|{kind}|{engine}"
 
 
 def _read_all(cache_dir: str) -> dict:
@@ -68,16 +94,20 @@ def load_knobs(cache_dir: str, key: str) -> Optional[dict]:
 
 
 def store_knobs(cache_dir: str, key: str, knobs: dict, **meta) -> None:
-    """Merge one entry into the cache file (atomic write + rename).
-    ``meta`` keys (e.g. the golden count that validated the knobs) are
-    stored alongside for human inspection; only ``knobs`` is read back."""
-    data = _read_all(cache_dir)
-    data[key] = {"knobs": {k: int(v) for k, v in knobs.items()}, **meta}
-    _write_all(cache_dir, data)
+    """Merge one entry into the cache file (atomic write + rename, under
+    the process lock).  ``meta`` keys (e.g. the golden count that
+    validated the knobs) are stored alongside for human inspection; only
+    ``knobs`` is read back."""
+    with _LOCK:
+        data = _read_all(cache_dir)
+        data[key] = {"knobs": {k: int(v) for k, v in knobs.items()}, **meta}
+        _write_all(cache_dir, data)
 
 
 def drop_knobs(cache_dir: str, key: str) -> None:
-    """Invalidate one entry (a golden-gate failure at cached knobs)."""
-    data = _read_all(cache_dir)
-    if data.pop(key, None) is not None:
-        _write_all(cache_dir, data)
+    """Invalidate one entry (a golden-gate failure at cached knobs, or a
+    served job that errored at cached sizes)."""
+    with _LOCK:
+        data = _read_all(cache_dir)
+        if data.pop(key, None) is not None:
+            _write_all(cache_dir, data)

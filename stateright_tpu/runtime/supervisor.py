@@ -76,6 +76,13 @@ CHILD_LOG_FILE = "child.log"
 # as opposed to a runtime kill (signal) or an interpreter abort.
 CHILD_ERROR_RC = 3
 
+# Exit code for a COMPLETED check that discovered a property violation
+# (cli.py check-tpu / submit): nonzero so CI and service callers can
+# gate on the verdict, distinct from crash (1) / usage (2) / error (3).
+# The supervisor treats a CLI child exiting with this code as done —
+# a found counterexample is a result, not a failure to retry.
+VIOLATION_RC = 4
+
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -347,6 +354,9 @@ class RunSupervisor:
         self._engine_kwargs = dict(
             spec.engine_kwargs if spec is not None else (engine_kwargs or {})
         )
+        # The completing child's exit code ("done" outcomes only): lets
+        # the CLI propagate a VIOLATION_RC verdict through supervision.
+        self.last_child_rc: Optional[int] = None
 
     # -- setup ----------------------------------------------------------------
 
@@ -620,10 +630,16 @@ class RunSupervisor:
                 return "crash"
             time.sleep(cfg.poll_interval_sec)
 
-        if rc == 0 and (
+        if (
+            rc == 0
+            or (rc == VIOLATION_RC and self._child_argv is not None)
+        ) and (
             self._child_argv is not None
             or os.path.exists(self.result_path)
         ):
+            # rc=VIOLATION_RC from a CLI child is a COMPLETED check whose
+            # verdict was a violation — done, never a crash to retry.
+            self.last_child_rc = rc
             return "done"
         if rc == CHILD_ERROR_RC:
             # A clean Python-level failure: transient tunnel errors are

@@ -1,0 +1,21 @@
+"""The persistent multi-job checking service (docs/SERVING.md).
+
+Verification as a service, not a script: one process owns the mesh and
+serves many check jobs over it — job queueing with priorities and
+cancellation (serve/jobs.py, serve/scheduler.py), compiled-program and
+knob-cache reuse across requests (the warm-start story), a swarm
+portfolio mode racing diversified configs to the first counterexample
+(serve/portfolio.py, after Holzmann-Joshi-Groce's Swarm Verification),
+and an HTTP surface with aggregated metrics (serve/server.py).
+
+Run the daemon with ``python -m stateright_tpu.serve`` or a model
+module's ``serve`` subcommand; submit from the CLI with ``submit``.
+"""
+
+from .jobs import (  # noqa: F401
+    CANCELLED, DONE, FAILED, QUEUED, RUNNING, Job, JobSpec, JobStore,
+)
+from .portfolio import MemberConfig, diversify  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401
+from .server import CheckService, serve  # noqa: F401
+from .workloads import workload_names  # noqa: F401

@@ -1,0 +1,77 @@
+"""Checking-service daemon entry point::
+
+    python -m stateright_tpu.serve [HOST:PORT]
+        [--journal PATH] [--knob-cache DIR] [--workers N]
+
+Serves until interrupted.  docs/SERVING.md documents the endpoints,
+the job lifecycle, and the journal layout.
+"""
+
+from __future__ import annotations
+
+import sys
+
+DEFAULT_ADDRESS = "localhost:3100"
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] in ("-h", "--help", "help"):
+        print(__doc__.strip())
+        return 0
+    address = DEFAULT_ADDRESS
+    journal = None
+    knob_cache = None
+    workers = 1
+    positional = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--journal":
+            i += 1
+            if i >= len(args):
+                print("--journal requires a path", file=sys.stderr)
+                return 2
+            journal = args[i]
+        elif a == "--knob-cache":
+            i += 1
+            if i >= len(args):
+                print("--knob-cache requires a directory", file=sys.stderr)
+                return 2
+            knob_cache = args[i]
+        elif a == "--workers":
+            i += 1
+            try:
+                workers = int(args[i])
+            except (IndexError, ValueError):
+                print("--workers requires an integer", file=sys.stderr)
+                return 2
+        else:
+            positional.append(a)
+        i += 1
+    if positional:
+        address = positional[0]
+    host, _, port = address.partition(":")
+    try:
+        port = int(port or DEFAULT_ADDRESS.rpartition(":")[2])
+    except ValueError:
+        print(f"invalid ADDRESS port: {address!r}", file=sys.stderr)
+        return 2
+
+    from .server import serve
+    from .workloads import workload_names
+
+    print(
+        f"Checking service on http://{host}:{port} "
+        f"(workers={workers}, workloads: {', '.join(workload_names())})",
+        flush=True,
+    )
+    serve(
+        (host, port), block=True, journal=journal,
+        knob_cache_dir=knob_cache, workers=workers,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Portfolio (swarm) mode: fan one job into N diversified configs.
+
+Straight out of Holzmann-Joshi-Groce's *Swarm Verification Techniques*
+(PAPERS.md): instead of one monolithic search, run many cheap,
+diversified, restartable search configurations against the same model —
+different geometries, symmetry on/off, and seeded Monte-Carlo walkers
+beside the exhaustive anchor — and let the first counterexample win.
+The mapping onto this package is direct: the diversification axes are
+exactly the engine knobs the knob cache already persists, the
+"restartable" requirement is the engines' bounded/stoppable runs, and
+the shared trail is the service journal every member appends to.
+
+Semantics (pinned by tests/test_serve.py):
+
+- ``diversify`` is a pure function of ``(size, seed, base config)`` —
+  the same portfolio seed always yields the same member set.
+- Member 0 is always the UNMODIFIED exhaustive config: whatever the
+  swarm finds early, completeness is anchored by construction.
+- First failure-classified discovery wins; every other member is
+  cancelled — running members via the engines' cooperative
+  ``request_stop``, queued members without ever starting.
+- With ``parallelism=1`` (the default: one mesh, one device job at a
+  time) members run in index order, so the winning member — and its
+  counterexample — is deterministic given the seed set.
+- The winner (member config + discovery) is journaled
+  (``portfolio_winner``) and folded back into the knob cache by the
+  scheduler, so the next job on this workload starts from the config
+  that actually found the bug.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+# Member terminal statuses.
+WON = "won"
+COMPLETED = "completed"
+STOPPED = "stopped"  # was running when another member won
+CANCELLED = "cancelled"  # never started: a winner existed first
+MEMBER_FAILED = "failed"
+
+# Simulation members must terminate on clean models; this caps their
+# walk when the job itself sets no target.
+_SIM_DEFAULT_TARGET = 200_000
+
+
+@dataclass
+class MemberConfig:
+    """One diversified search configuration."""
+
+    index: int
+    kind: str  # "exhaustive" | "simulation"
+    engine: str  # tpu | bfs | dfs | tpu_simulation | simulation
+    engine_kwargs: dict = field(default_factory=dict)
+    symmetry: bool = False
+    seed: int = 0
+    target_state_count: Optional[int] = None
+
+    def describe(self) -> dict:
+        return {
+            "index": self.index,
+            "kind": self.kind,
+            "engine": self.engine,
+            "engine_kwargs": dict(self.engine_kwargs),
+            "symmetry": self.symmetry,
+            "seed": self.seed,
+        }
+
+
+def diversify(
+    size: int,
+    seed: int,
+    base_engine: str,
+    base_kwargs: dict,
+    symmetry_capable: bool = False,
+    include_simulation: bool = True,
+) -> List[MemberConfig]:
+    """The deterministic member set for one portfolio.
+
+    Axes (Swarm §3's "search diversification" menu, mapped to this
+    package): dedup/probe geometry, frontier chunk size, device symmetry
+    reduction on/off, and seeded simulation walkers vs exhaustive
+    search.  Everything derives from ``random.Random(seed)`` — same
+    seed, same portfolio."""
+    if size < 2:
+        raise ValueError("portfolio size must be >= 2")
+    rng = random.Random(seed)
+    device_engine = base_engine in ("tpu", "sharded")
+    sim_engine = "tpu_simulation" if device_engine else "simulation"
+    members = [
+        MemberConfig(
+            index=0, kind="exhaustive", engine=base_engine,
+            engine_kwargs=dict(base_kwargs),
+        )
+    ]
+    for i in range(1, size):
+        if include_simulation and i % 3 == 2:
+            # Every third member is a Monte-Carlo walker with its own
+            # derived seed — the cheap, restartable random searches of
+            # the swarm recipe.
+            members.append(
+                MemberConfig(
+                    index=i, kind="simulation", engine=sim_engine,
+                    seed=rng.randrange(1 << 31),
+                    target_state_count=_SIM_DEFAULT_TARGET,
+                )
+            )
+            continue
+        kwargs = dict(base_kwargs)
+        if device_engine:
+            kwargs["dedup_factor"] = rng.choice([1, 2, 4, 8])
+            mf = int(kwargs.get("max_frontier", 1 << 15))
+            shift = rng.choice([-1, 0, 1])
+            kwargs["max_frontier"] = max(
+                64, mf >> 1 if shift < 0 else mf << shift
+            )
+        members.append(
+            MemberConfig(
+                index=i, kind="exhaustive", engine=base_engine,
+                engine_kwargs=kwargs,
+                symmetry=bool(symmetry_capable and rng.random() < 0.5),
+            )
+        )
+    return members
+
+
+def run_portfolio(
+    members: List[MemberConfig],
+    spawn_member: Callable[[MemberConfig], object],
+    cancel_event: threading.Event,
+    journal=None,
+    parallelism: int = 1,
+    poll_interval: float = 0.02,
+) -> dict:
+    """Race the members; first failure-classified discovery wins.
+
+    ``spawn_member(member)`` builds and spawns a checker for one config
+    (the scheduler owns model construction).  Returns the portfolio
+    result dict; raises nothing member-related — a member that errors is
+    recorded as ``failed`` and the race continues (one bad geometry must
+    not sink the swarm)."""
+    stop = threading.Event()  # a winner exists (or the job was cancelled)
+    lock = threading.Lock()
+    state = {"winner": None}
+    entries: List[Optional[dict]] = [None] * len(members)
+    next_index = {"i": 0}
+
+    def log(event: str, **fields) -> None:
+        if journal is not None:
+            journal.append(event, **fields)
+
+    def claim() -> Optional[MemberConfig]:
+        with lock:
+            if stop.is_set() or cancel_event.is_set():
+                return None
+            i = next_index["i"]
+            if i >= len(members):
+                return None
+            next_index["i"] = i + 1
+            return members[i]
+
+    def run_one(member: MemberConfig) -> None:
+        log("portfolio_member_start", member=member.index,
+            **{"config": member.describe()})
+        t0 = time.monotonic()
+        entry = {"status": MEMBER_FAILED, **member.describe()}
+        entries[member.index] = entry
+        try:
+            checker = spawn_member(member)
+        except Exception as exc:  # bad geometry/config: race continues
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+            log("portfolio_member_failed", member=member.index,
+                error=entry["error"])
+            return
+        stopped_early = False
+        while not checker.is_done():
+            if stop.is_set() or cancel_event.is_set():
+                checker.request_stop()
+                stopped_early = True
+            time.sleep(poll_interval)
+        try:
+            checker.join()
+        except Exception as exc:
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+            log("portfolio_member_failed", member=member.index,
+                error=entry["error"])
+            return
+        summary = checker_summary(checker)
+        entry.update(
+            unique_state_count=summary["unique_state_count"],
+            state_count=summary["state_count"],
+            max_depth=summary["max_depth"],
+            violation=summary["violation"],
+            sec=round(time.monotonic() - t0, 3),
+        )
+        entry["checker"] = checker
+        entry["summary"] = summary
+        with lock:
+            if (
+                summary["violation"] is not None
+                and state["winner"] is None
+                and not cancel_event.is_set()
+            ):
+                state["winner"] = member.index
+                entry["status"] = WON
+                stop.set()
+            elif stopped_early:
+                entry["status"] = STOPPED
+            else:
+                entry["status"] = COMPLETED
+        log("portfolio_member_done", member=member.index,
+            status=entry["status"], unique=entry["unique_state_count"],
+            violation=entry["violation"])
+
+    def worker() -> None:
+        while True:
+            member = claim()
+            if member is None:
+                return
+            run_one(member)
+
+    parallelism = max(1, min(int(parallelism), len(members)))
+    if parallelism == 1:
+        worker()  # in-line: index order, fully deterministic
+    else:
+        threads = [
+            threading.Thread(target=worker, daemon=True,
+                             name=f"portfolio-{i}")
+            for i in range(parallelism)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    for member in members:  # never-started members were cancelled
+        if entries[member.index] is None:
+            entries[member.index] = {
+                "status": CANCELLED, **member.describe(),
+            }
+            log("portfolio_member_cancelled", member=member.index)
+
+    winner_idx = state["winner"]
+    result = {
+        "members": [
+            {k: v for k, v in e.items() if k not in ("checker", "summary")}
+            for e in entries
+        ],
+        "winner": None,
+    }
+    if winner_idx is not None:
+        win = entries[winner_idx]
+        result["winner"] = {
+            "member": winner_idx,
+            "config": members[winner_idx].describe(),
+            "violation": win["violation"],
+            "discovery": win["summary"]["discoveries"].get(win["violation"]),
+        }
+        log("portfolio_winner", **result["winner"])
+    return {
+        "portfolio": result,
+        "entries": entries,  # scheduler-internal (checkers, summaries)
+        "winner_index": winner_idx,
+    }
+
+
+def checker_summary(checker) -> dict:
+    """The common result shape for one finished checker: counts, per-
+    property verdicts, encoded discoveries, and the first failure-
+    classified discovery (in the model's property order — the
+    deterministic 'violation' the portfolio race keys on)."""
+    model = checker.model()
+    discoveries = checker.discoveries()
+    props = []
+    violation = None
+    for p in model.properties():
+        found = p.name in discoveries
+        classification = (
+            checker.discovery_classification(p.name) if found else None
+        )
+        if found and classification == "counterexample" and violation is None:
+            violation = p.name
+        props.append({
+            "name": p.name,
+            "expectation": p.expectation.name,
+            "discovered": found,
+            "classification": classification,
+        })
+    return {
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "properties": props,
+        "discoveries": {
+            name: {
+                "classification": checker.discovery_classification(name),
+                "fingerprints": path.encode(model),
+                "actions": repr(path.into_actions()),
+            }
+            for name, path in discoveries.items()
+        },
+        "violation": violation,
+    }

@@ -1,0 +1,275 @@
+"""HTTP surface of the checking service (sibling of explorer/server.py).
+
+Endpoints (JSON everywhere; full shapes in docs/SERVING.md):
+
+- ``POST /jobs`` — submit a :class:`~stateright_tpu.serve.jobs.JobSpec`
+  body; returns ``{"id", "state"}`` immediately (the check runs on the
+  scheduler's workers).
+- ``GET /jobs`` — every job's snapshot, id-ordered.
+- ``GET /jobs/{id}`` — one job's snapshot (state, spec, result, error).
+- ``GET /jobs/{id}/result`` — blocks up to ``?wait=SECONDS`` (default 0)
+  for a terminal state, then returns the snapshot; the natural client
+  poll loop collapses to one request.
+- ``POST /jobs/{id}/cancel`` — cancel queued or running; returns the
+  snapshot (409 when already terminal).
+- ``POST /jobs/{id}/explore`` — attach the interactive Explorer to a
+  COMPLETED job's retained checker (explorer/server.serve_checker) on an
+  ephemeral port; returns its address.
+- ``GET /.metrics`` — the aggregated service view: job counts by state,
+  scheduler counters (``knob_cache_hits``, ``jobs_completed``, ...), and
+  the process-global compiled-program cache counters
+  (``program_cache_hits``) that evidence warm-start reuse.
+- ``GET /.status`` — uptime, worker count, job counts, workload names.
+
+The server is a ThreadingHTTPServer like the Explorer's: requests are
+cheap metadata operations; all checking happens on the scheduler's
+workers against the one mesh this process owns.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..obs.metrics import GLOBAL
+from ..runtime.journal import as_journal
+from .jobs import DONE, JobSpec, JobStore
+from .scheduler import Scheduler
+from .workloads import workload_names
+
+
+class CheckService:
+    """Composition root: store + journal + scheduler, one per mesh."""
+
+    def __init__(
+        self,
+        journal=None,
+        knob_cache_dir: Optional[str] = None,
+        workers: int = 1,
+        retain_checkers: int = 4,
+    ):
+        self.journal = as_journal(journal)
+        self.store = JobStore(journal=self.journal)
+        self.scheduler = Scheduler(
+            self.store,
+            journal=self.journal,
+            knob_cache_dir=knob_cache_dir,
+            workers=workers,
+            retain_checkers=retain_checkers,
+        )
+        self.started_at = time.time()
+        self.workers = max(1, workers)
+        self.http_server = None
+        self.address = None
+        if self.journal is not None:
+            self.journal.append(
+                "service_start", workers=self.workers,
+                knob_cache_dir=knob_cache_dir,
+            )
+
+    def submit(self, spec) -> "object":
+        if isinstance(spec, dict):
+            spec = JobSpec.from_dict(spec)
+        return self.scheduler.submit(spec)
+
+    def cancel(self, job_id: str) -> bool:
+        return self.scheduler.cancel(job_id)
+
+    def get(self, job_id: str):
+        return self.store.get(job_id)
+
+    def metrics(self) -> dict:
+        out = {
+            "service": "stateright-tpu-serve",
+            "uptime_sec": round(time.time() - self.started_at, 1),
+            "workers": self.workers,
+            "jobs": self.store.counts(),
+        }
+        out.update(self.scheduler.metrics.snapshot())
+        # The process-global counters: compiled-program cache hits are
+        # the direct evidence that a repeat submission reused the first
+        # run's programs instead of recompiling.
+        out.update(GLOBAL.snapshot())
+        return out
+
+    def status(self) -> dict:
+        return {
+            "service": "stateright-tpu-serve",
+            "uptime_sec": round(time.time() - self.started_at, 1),
+            "workers": self.workers,
+            "jobs": self.store.counts(),
+            "workloads": workload_names(),
+        }
+
+    def explore(self, job, port: int = 0):
+        """Attach the Explorer to a completed job's retained checker;
+        returns the (host, port) it serves on."""
+        if job.state != DONE or job.checker is None:
+            raise ValueError(
+                f"job {job.id} has no attached checker (state "
+                f"{job.state}; completed checkers past the retention "
+                "cap are released — resubmit the job to explore it)"
+            )
+        if job.explorer_address is not None:
+            return job.explorer_address
+        from ..explorer.server import serve_checker
+
+        serve_checker(job.checker, ("127.0.0.1", port), block=False)
+        job.explorer_address = job.checker.explorer_address
+        if self.journal is not None:
+            self.journal.append(
+                "explorer_attached", job=job.id,
+                address=list(job.explorer_address),
+            )
+        return job.explorer_address
+
+    def shutdown(self) -> None:
+        self.scheduler.shutdown()
+        if self.http_server is not None:
+            self.http_server.shutdown()
+        if self.journal is not None:
+            self.journal.append("service_stop")
+            self.journal.close()
+
+
+def serve(
+    address,
+    block: bool = True,
+    journal=None,
+    knob_cache_dir: Optional[str] = None,
+    workers: int = 1,
+    retain_checkers: int = 4,
+) -> CheckService:
+    """Start the checking service on ``address`` ((host, port); port 0
+    binds an ephemeral one).  ``block=False`` serves on a background
+    thread and returns the service immediately (``service.address``
+    carries the bound port)."""
+    service = CheckService(
+        journal=journal, knob_cache_dir=knob_cache_dir, workers=workers,
+        retain_checkers=retain_checkers,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, message: str) -> None:
+            self._send(code, {"error": message})
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length == 0:
+                return {}
+            raw = self.rfile.read(length)
+            data = json.loads(raw)
+            if not isinstance(data, dict):
+                raise ValueError("request body must be a JSON object")
+            return data
+
+        def _job_or_404(self, job_id: str):
+            job = service.get(job_id)
+            if job is None:
+                self._error(404, f"unknown job {job_id!r}")
+            return job
+
+        def _query(self) -> dict:
+            from urllib.parse import parse_qsl, urlsplit
+
+            return dict(parse_qsl(urlsplit(self.path).query))
+
+        def do_GET(self) -> None:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            try:
+                if path == "/.metrics":
+                    self._send(200, service.metrics())
+                elif path in ("", "/.status"):
+                    self._send(200, service.status())
+                elif path == "/jobs":
+                    self._send(
+                        200,
+                        [j.snapshot() for j in service.store.list()],
+                    )
+                elif path.startswith("/jobs/"):
+                    parts = path.split("/")[2:]
+                    job = self._job_or_404(parts[0])
+                    if job is None:
+                        return
+                    if len(parts) == 1:
+                        self._send(200, job.snapshot())
+                    elif parts[1] == "result":
+                        wait = float(self._query().get("wait", 0) or 0)
+                        if wait > 0:
+                            job.wait(min(wait, 600.0))
+                        self._send(200, job.snapshot())
+                    else:
+                        self._error(404, f"unknown endpoint {path!r}")
+                else:
+                    self._error(404, f"unknown endpoint {path!r}")
+            except Exception as e:  # surface, don't reset the connection
+                self._error(500, f"{type(e).__name__}: {e}")
+
+        def do_POST(self) -> None:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            try:
+                if path == "/jobs":
+                    try:
+                        job = service.submit(self._body())
+                    except (ValueError, json.JSONDecodeError) as e:
+                        return self._error(400, str(e))
+                    self._send(
+                        202, {"id": job.id, "state": job.state}
+                    )
+                elif path.startswith("/jobs/") and path.endswith("/cancel"):
+                    job = self._job_or_404(path.split("/")[2])
+                    if job is None:
+                        return
+                    if not service.cancel(job.id):
+                        return self._error(
+                            409, f"job {job.id} is already {job.state}"
+                        )
+                    self._send(200, job.snapshot())
+                elif path.startswith("/jobs/") and path.endswith("/explore"):
+                    job = self._job_or_404(path.split("/")[2])
+                    if job is None:
+                        return
+                    try:
+                        addr = service.explore(
+                            job, int(self._body().get("port", 0))
+                        )
+                    except ValueError as e:
+                        return self._error(409, str(e))
+                    self._send(
+                        200, {"id": job.id, "explorer_address": list(addr)}
+                    )
+                else:
+                    self._error(404, f"unknown endpoint {path!r}")
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
+
+    server = ThreadingHTTPServer(tuple(address), Handler)
+    service.http_server = server
+    service.address = server.server_address
+    if block:  # serve on the calling thread (reference Explorer behavior)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            service.shutdown()
+    else:
+        t = threading.Thread(
+            target=server.serve_forever, daemon=True, name="serve-http"
+        )
+        t.start()
+    return service

@@ -1,0 +1,81 @@
+"""The service's workload registry.
+
+Every model module under ``stateright_tpu.models`` that exposes a
+module-level ``cli_spec()`` is a servable workload: the same spec that
+drives its mini-binary CLI (cli.py) tells the service how to build the
+model, which engines it supports, and the right-sized device knobs to
+start from.  One definition per workload — the CLI, the bench, and the
+service cannot drift apart on how e.g. ``paxos 3`` is constructed.
+
+The registry is a fixed allowlist (not a blind ``importlib`` of
+caller-supplied strings): a job submission names a workload, never a
+module path.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import List, Optional, Tuple
+
+# Model modules with a cli_spec(); fixtures is the known-violating
+# TrapCounter workload the service's own smoke tests submit.
+SERVABLE = (
+    "twophase",
+    "paxos",
+    "abd",
+    "raft",
+    "ping_pong",
+    "lww_register",
+    "single_copy_register",
+    "increment",
+    "fixtures",
+)
+
+
+def workload_names() -> List[str]:
+    return list(SERVABLE)
+
+
+def cli_spec_for(workload: str):
+    """The workload's CliSpec; ``ValueError`` on an unknown name."""
+    if workload not in SERVABLE:
+        raise ValueError(
+            f"unknown workload {workload!r} "
+            f"(one of: {', '.join(SERVABLE)})"
+        )
+    module = importlib.import_module(f"..models.{workload}", __package__)
+    return module.cli_spec()
+
+
+def build_model(
+    workload: str, n: Optional[int] = None, network: Optional[str] = None
+) -> Tuple[object, object, int]:
+    """Build the workload's model: ``(model, cli_spec, resolved_n)``.
+    ``n`` defaults to the spec's CLI default; ``network`` (a name from
+    the actor network registry) is resolved exactly like the CLI's
+    NETWORK positional — an unknown name raises, never a silent
+    default."""
+    from ..actor.network import Network
+
+    spec = cli_spec_for(workload)
+    resolved_n = spec.default_n if n is None else int(n)
+    if spec.default_network is None:
+        if network is not None:
+            raise ValueError(
+                f"workload {workload!r} takes no network parameter"
+            )
+        return spec.build(resolved_n), spec, resolved_n
+    net = Network.from_name(network or spec.default_network)
+    return spec.build(resolved_n, net), spec, resolved_n
+
+
+def workload_label(workload: str, n: int, network: Optional[str],
+                   symmetry: bool = False) -> str:
+    """The knob-cache label for one served workload configuration
+    (runtime/knob_cache.knob_key adds device + engine identity)."""
+    parts = [f"serve:{workload}", str(n)]
+    if network:
+        parts.append(network)
+    if symmetry:
+        parts.append("sym")
+    return ":".join(parts)

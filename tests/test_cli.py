@@ -281,6 +281,86 @@ def test_check_tpu_supervised_writes_journal_and_checkpoint(tmp_path):
     assert os.path.exists(os.path.join(run_dir, "checkpoint.npz"))
 
 
+def test_check_tpu_violating_model_exits_violation_rc():
+    """Satellite pin: a COMPLETED check-tpu that discovered a property
+    violation exits VIOLATION_RC (4) so CI and service callers can gate
+    on the verdict.  fixtures = TrapCounter, the known-violating
+    compiled workload ("reaches limit" counterexample)."""
+    r = run_cli("fixtures", "check-tpu", "5", timeout=600)
+    assert r.returncode == 4, (r.returncode, r.stderr)
+    assert "violation discovered: reaches limit" in r.stderr
+    assert 'Discovered "reaches limit" counterexample' in r.stdout
+
+
+def test_usage_lists_service_verbs():
+    r = run_cli("twophase")
+    for verb in ("serve [ADDRESS]", "submit [RM_COUNT]", "status [JOB_ID]"):
+        assert verb in r.stdout, r.stdout
+
+
+def test_submit_without_server_is_clean_error():
+    # Port 9 (discard) refuses connections; the client must say what to
+    # start, not stack-trace.
+    r = run_cli("twophase", "submit", "3", "--address", "127.0.0.1:9")
+    assert r.returncode == 1
+    assert "cannot reach the checking service" in r.stderr
+
+
+def test_submit_rejects_bad_flag_values():
+    r = run_cli("twophase", "submit", "3", "--portfolio", "x")
+    assert r.returncode == 2
+    assert "--portfolio requires a int" in r.stderr
+    r = run_cli("twophase", "submit", "3", "--address")
+    assert r.returncode == 2
+    assert "requires a value" in r.stderr
+
+
+@pytest.mark.slow
+def test_serve_submit_status_end_to_end(tmp_path):
+    """The service UX end to end through real processes: a daemon, a
+    clean submit (rc 0), a violating portfolio submit (rc VIOLATION_RC),
+    and status.  The per-push CI serve smoke covers the same flow; this
+    is the nightly in-tree pin."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    address = "127.0.0.1:3923"
+    journal = str(tmp_path / "journal.jsonl")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "stateright_tpu.serve", address,
+         "--journal", journal, "--knob-cache", str(tmp_path / "knobs")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, cwd=REPO,
+    )
+    try:
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://{address}/.status", timeout=2
+                ) as resp:
+                    up = json.loads(resp.read())["service"] is not None
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert up, "service daemon never came up"
+        clean = run_cli("twophase", "submit", "3", "--address", address,
+                        timeout=600)
+        assert clean.returncode == 0, clean.stderr
+        assert "submitted job-" in clean.stdout
+        viol = run_cli("fixtures", "submit", "5", "--address", address,
+                       "--portfolio", "3", timeout=600)
+        assert viol.returncode == 4, (viol.returncode, viol.stderr)
+        assert "violation discovered: reaches limit" in viol.stderr
+        status = run_cli("twophase", "status", "--address", address)
+        assert status.returncode == 0
+        jobs = json.loads(status.stdout.strip().splitlines()[-1])
+        assert [j["state"] for j in jobs] == ["done", "done"]
+        events = [json.loads(ln)["event"] for ln in open(journal)]
+        assert "portfolio_winner" in events
+    finally:
+        daemon.terminate()
+        daemon.wait(timeout=10)
+
+
 def test_wire_codec_malformed_messages_raise_valueerror():
     """A hand-typed probe datagram with wrong fields must surface as
     ValueError (which the UDP runtime drops) — never a TypeError that

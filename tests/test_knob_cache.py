@@ -1,0 +1,141 @@
+"""runtime/knob_cache.py under service load: stale-entry drop,
+concurrent read/write from multiple jobs, and the scheduler-level
+warm-start behaviors the checking service relies on (docs/SERVING.md)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from stateright_tpu.runtime.knob_cache import (
+    KNOBS_FILE, drop_knobs, load_knobs, store_knobs,
+)
+
+
+def test_store_load_drop_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert load_knobs(d, "k") is None
+    store_knobs(d, "k", {"capacity": 1 << 14, "dedup_factor": 4},
+                unique=288, depth=11)
+    assert load_knobs(d, "k") == {"capacity": 1 << 14, "dedup_factor": 4}
+    # Meta rides alongside for humans but is never read back as knobs.
+    raw = json.load(open(os.path.join(d, KNOBS_FILE)))
+    assert raw["k"]["unique"] == 288
+    drop_knobs(d, "k")
+    assert load_knobs(d, "k") is None
+    drop_knobs(d, "k")  # idempotent
+
+
+def test_stale_entry_drop_is_per_key(tmp_path):
+    """The golden-gate staleness contract: dropping one failed entry
+    leaves every other workload's knobs intact."""
+    d = str(tmp_path)
+    store_knobs(d, "good", {"capacity": 1024})
+    store_knobs(d, "stale", {"capacity": 64})
+    drop_knobs(d, "stale")
+    assert load_knobs(d, "stale") is None
+    assert load_knobs(d, "good") == {"capacity": 1024}
+
+
+def test_torn_or_garbage_file_degrades_to_rediscovery(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, KNOBS_FILE), "w") as fh:
+        fh.write('{"k": {"knobs": {"capacity": 10')  # torn writer
+    assert load_knobs(d, "k") is None
+    store_knobs(d, "k", {"capacity": 32})  # recovers by overwriting
+    assert load_knobs(d, "k") == {"capacity": 32}
+
+
+def test_concurrent_jobs_never_lose_each_others_entries(tmp_path):
+    """Service load: many jobs storing/reading different keys through
+    one cache dir concurrently.  Every writer's final entry must
+    survive (in-process mutations are read-merge-write under the module
+    lock) and the file must always parse (atomic write + rename)."""
+    d = str(tmp_path)
+    writers, rounds = 8, 30
+    errors = []
+
+    def job(k):
+        try:
+            key = f"workload-{k}"
+            for i in range(rounds):
+                store_knobs(d, key, {"capacity": 1024 + i, "round": i})
+                got = load_knobs(d, f"workload-{(k + 1) % writers}")
+                assert got is None or isinstance(got, dict)
+                if i % 10 == 9:
+                    drop_knobs(d, f"tmp-{k}")
+        except Exception as e:  # surfaced below; threads must not hide it
+            errors.append(e)
+
+    threads = [threading.Thread(target=job, args=(k,))
+               for k in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for k in range(writers):
+        assert load_knobs(d, f"workload-{k}") == {
+            "capacity": 1024 + rounds - 1, "round": rounds - 1,
+        }
+    json.load(open(os.path.join(d, KNOBS_FILE)))  # parses whole
+
+
+def test_scheduler_drops_stale_entry_and_recovers(tmp_path):
+    """Service-level stale-entry drop (the golden-gate analog): a cached
+    entry the engine can no longer accept — here a knob name from a
+    retired protocol version, the failure mode of a cache outliving an
+    engine change — fails the spawn, is dropped, and the job recovers
+    with a fresh run whose working geometry replaces it."""
+    pytest.importorskip("jax")
+    from stateright_tpu.runtime.knob_cache import knob_key
+    from stateright_tpu.serve import CheckService
+    from stateright_tpu.serve.workloads import workload_label
+
+    d = str(tmp_path / "knobs")
+    key = knob_key(workload_label("twophase", 3, None))
+    store_knobs(d, key, {"retired_knob_name": 7})
+    svc = CheckService(journal=str(tmp_path / "j.jsonl"),
+                       knob_cache_dir=d)
+    try:
+        job = svc.submit({"workload": "twophase", "n": 3})
+        assert job.wait(300)
+        assert job.state == "done", job.error
+        assert job.result["unique_state_count"] == 288
+        # The poisoned entry was dropped and replaced by the fresh
+        # run's working geometry.
+        knobs = load_knobs(d, key)
+        assert knobs is not None and "retired_knob_name" not in knobs
+        from stateright_tpu.runtime.journal import read_journal
+
+        events = [e["event"] for e in read_journal(str(tmp_path / "j.jsonl"))]
+        assert "knobs_dropped" in events
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_second_job_skips_autotune_warm_start(tmp_path):
+    """Satellite pin: the second identical job loads the first job's
+    final geometry instead of re-running discovery — asserted via the
+    per-job knob_cache_hit flag and the stored entry equality."""
+    pytest.importorskip("jax")
+    from stateright_tpu.runtime.knob_cache import knob_key
+    from stateright_tpu.serve import CheckService
+    from stateright_tpu.serve.workloads import workload_label
+
+    d = str(tmp_path / "knobs")
+    svc = CheckService(knob_cache_dir=d)
+    try:
+        j1 = svc.submit({"workload": "fixtures", "n": 5})
+        assert j1.wait(300) and j1.state == "done", j1.error
+        stored = load_knobs(d, knob_key(workload_label("fixtures", 5, None)))
+        assert stored is not None
+        j2 = svc.submit({"workload": "fixtures", "n": 5})
+        assert j2.wait(300) and j2.state == "done", j2.error
+        assert j2.result["knob_cache_hit"] is True
+        assert j2.result["unique_state_count"] == j1.result[
+            "unique_state_count"
+        ]
+    finally:
+        svc.scheduler.shutdown()

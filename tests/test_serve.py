@@ -1,0 +1,436 @@
+"""The checking service: job lifecycle, warm starts, portfolio racing,
+cancellation, and the HTTP surface (docs/SERVING.md).
+
+Everything runs in-process against CPU jax; the serve smoke in CI
+exercises the same flows through a real daemon.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.runtime.journal import read_journal  # noqa: E402
+from stateright_tpu.runtime.knob_cache import (  # noqa: E402
+    knob_key, load_knobs,
+)
+from stateright_tpu.serve import (  # noqa: E402
+    CANCELLED, DONE, CheckService, JobSpec, diversify,
+)
+from stateright_tpu.serve.workloads import (  # noqa: E402
+    build_model, workload_label, workload_names,
+)
+
+
+@pytest.fixture
+def service(tmp_path):
+    svc = CheckService(
+        journal=str(tmp_path / "journal.jsonl"),
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    yield svc
+    svc.scheduler.shutdown()
+
+
+def submit_and_wait(svc, spec, timeout=300):
+    job = svc.submit(spec)
+    assert job.wait(timeout), f"job {job.id} never finished"
+    return job
+
+
+SMALL_2PC = {
+    "workload": "twophase", "n": 3,
+    "engine_kwargs": {"capacity": 1 << 14, "max_frontier": 1 << 7},
+}
+
+
+# --- single-job lifecycle ----------------------------------------------------
+
+
+def test_served_job_parity_with_direct_check(service):
+    """Acceptance: a job through the service reports identical
+    unique-state counts and property verdicts to the same check run
+    directly on the engine (the check-tpu path)."""
+    job = submit_and_wait(service, SMALL_2PC)
+    assert job.state == DONE, job.error
+    r = job.result
+    model, _, _ = build_model("twophase", 3)
+    direct = model.checker().spawn_tpu(
+        capacity=1 << 14, max_frontier=1 << 7
+    ).join()
+    assert r["unique_state_count"] == direct.unique_state_count() == 288
+    assert r["max_depth"] == direct.max_depth()
+    assert sorted(r["discoveries"]) == sorted(direct.discoveries())
+    assert r["violation"] is None
+    by_name = {p["name"]: p for p in r["properties"]}
+    assert by_name["consistent"]["discovered"] is False
+    assert by_name["commit agreement"]["classification"] == "example"
+
+
+def test_second_identical_job_reuses_programs_and_knobs(tmp_path):
+    """Acceptance: the second identical submission hits the knob cache
+    (skipping auto-tune sizing) and the compiled-program cache (skipping
+    compiles), visible both per-job and in the aggregated metrics."""
+    svc = CheckService(
+        journal=str(tmp_path / "j.jsonl"),
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    try:
+        j1 = submit_and_wait(svc, {"workload": "twophase", "n": 3})
+        j2 = submit_and_wait(svc, {"workload": "twophase", "n": 3})
+        assert j1.result["knob_cache_hit"] is False
+        assert j2.result["knob_cache_hit"] is True
+        # Identical persisted geometry => the spawn reproduces the first
+        # job's program-cache keys, so the warm run compiled nothing.
+        assert j2.result["program_cache_hits_delta"] > 0
+        assert j2.result["unique_state_count"] == 288
+        m = svc.metrics()
+        assert m["knob_cache_hits"] == 1
+        assert m["knob_cache_misses"] == 1
+        assert m["jobs_completed"] == 2
+        assert m["program_cache_hits"] >= j2.result[
+            "program_cache_hits_delta"
+        ]
+        # The persisted entry is the run's final geometry.
+        key = knob_key(workload_label("twophase", 3, None))
+        knobs = load_knobs(str(tmp_path / "knobs"), key)
+        assert knobs is not None and "capacity" in knobs
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_violating_job_reports_violation(service):
+    job = submit_and_wait(service, {"workload": "fixtures", "n": 5})
+    assert job.state == DONE, job.error
+    assert job.result["violation"] == "reaches limit"
+    disc = job.result["discoveries"]["reaches limit"]
+    assert disc["classification"] == "counterexample"
+    assert disc["fingerprints"].count("/") >= 1
+
+
+def test_job_priorities_order_the_queue(tmp_path):
+    """With one worker busy, a higher-priority submission overtakes an
+    earlier lower-priority one."""
+    svc = CheckService(knob_cache_dir=str(tmp_path / "knobs"))
+    try:
+        blocker = svc.submit({"workload": "fixtures", "n": 5})
+        low = svc.submit({"workload": "twophase", "n": 3,
+                          "engine": "bfs", "priority": 0})
+        high = svc.submit({"workload": "fixtures", "n": 4,
+                           "engine": "bfs", "priority": 5})
+        for j in (blocker, low, high):
+            assert j.wait(300)
+            assert j.state == DONE, j.error
+        assert high.started_at <= low.started_at
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_invalid_specs_are_rejected_at_submit():
+    with pytest.raises(ValueError, match="workload"):
+        JobSpec.from_dict({})
+    with pytest.raises(ValueError, match="engine"):
+        JobSpec.from_dict({"workload": "twophase", "engine": "warp"})
+    with pytest.raises(ValueError, match="unknown job field"):
+        JobSpec.from_dict({"workload": "twophase", "frobnicate": 1})
+    with pytest.raises(ValueError, match="portfolio.size"):
+        JobSpec.from_dict({"workload": "twophase", "portfolio": {"size": 1}})
+    with pytest.raises(ValueError, match="no engine_kwargs"):
+        JobSpec.from_dict({"workload": "twophase", "engine": "bfs",
+                           "engine_kwargs": {"capacity": 1 << 14}})
+    with pytest.raises(ValueError, match="unknown workload"):
+        build_model("does_not_exist")
+    assert "twophase" in workload_names()
+
+
+# --- cancellation ------------------------------------------------------------
+
+
+def test_cancel_queued_and_running_jobs(tmp_path):
+    """One worker: a long host-BFS job is cancelled mid-run (cooperative
+    request_stop — partial counts reported), and a job queued behind it
+    is cancelled without ever starting."""
+    svc = CheckService(
+        journal=str(tmp_path / "j.jsonl"),
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    try:
+        # 2pc rm=8 host BFS (~millions of state evaluations at 1
+        # thread): long enough that the cancel lands mid-run; the spec
+        # timeout is only the no-cancel backstop.
+        big = svc.submit({
+            "workload": "twophase", "n": 8, "engine": "bfs",
+            "threads": 1, "timeout": 120.0,
+        })
+        queued = svc.submit({"workload": "twophase", "n": 3})
+        deadline = time.time() + 60
+        while big.state != "running" and time.time() < deadline:
+            time.sleep(0.02)
+        assert big.state == "running"
+        assert svc.cancel(queued.id)
+        assert queued.state == CANCELLED
+        t_cancel = time.monotonic()
+        assert svc.cancel(big.id)
+        assert big.wait(60)
+        assert big.state == CANCELLED
+        # Cooperative stop is prompt (a timeout would take ~120 s).
+        assert time.monotonic() - t_cancel < 30
+        assert big.result["completed"] is False
+        assert big.result["unique_state_count"] > 0  # partial counts stand
+        events = [e["event"] for e in read_journal(str(tmp_path / "j.jsonl"))]
+        assert events.count("job_cancelled") == 2
+        # Cancelling a terminal job is refused.
+        assert not svc.cancel(big.id)
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_request_stop_stops_tpu_engine_promptly():
+    """Engine-level pin for the service's cancel path: request_stop on a
+    running wavefront checker winds it down like a deadline."""
+    model, _, _ = build_model("twophase", 5)
+    # timeout forces waves_per_call=1, so the stop lands between waves.
+    ck = model.checker().timeout(300).spawn_tpu(
+        capacity=1 << 14, max_frontier=1 << 5
+    )
+    ck.request_stop()
+    t0 = time.monotonic()
+    ck.join()
+    assert time.monotonic() - t0 < 60
+    assert ck.is_done()
+    assert ck.stop_requested()
+
+
+# --- portfolio mode ----------------------------------------------------------
+
+
+def test_diversify_is_deterministic_and_anchored():
+    a = diversify(6, seed=42, base_engine="tpu",
+                  base_kwargs={"capacity": 1 << 12, "max_frontier": 1 << 6},
+                  symmetry_capable=True)
+    b = diversify(6, seed=42, base_engine="tpu",
+                  base_kwargs={"capacity": 1 << 12, "max_frontier": 1 << 6},
+                  symmetry_capable=True)
+    assert [m.describe() for m in a] == [m.describe() for m in b]
+    assert a[0].kind == "exhaustive"
+    assert a[0].engine_kwargs == {"capacity": 1 << 12,
+                                  "max_frontier": 1 << 6}
+    assert any(m.kind == "simulation" for m in a)
+    c = diversify(6, seed=43, base_engine="tpu",
+                  base_kwargs={"capacity": 1 << 12, "max_frontier": 1 << 6})
+    assert [m.describe() for m in a] != [m.describe() for m in c]
+
+
+def run_portfolio_job(tmp_path, tag, seed=7):
+    svc = CheckService(
+        journal=str(tmp_path / f"{tag}.jsonl"),
+        knob_cache_dir=str(tmp_path / f"{tag}-knobs"),
+    )
+    try:
+        job = submit_and_wait(svc, {
+            "workload": "fixtures", "n": 5,
+            "portfolio": {"size": 4, "seed": seed},
+        })
+        return job, read_journal(str(tmp_path / f"{tag}.jsonl")), svc.metrics()
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_portfolio_first_winner_cancels_losers_deterministically(tmp_path):
+    """Acceptance: on a violating model the first counterexample wins,
+    remaining configs are cancelled, the winner (config + path) is
+    journaled, and the outcome is deterministic given the seed set."""
+    job1, events1, metrics1 = run_portfolio_job(tmp_path, "a")
+    job2, events2, _ = run_portfolio_job(tmp_path, "b")
+    for job in (job1, job2):
+        assert job.state == DONE, job.error
+        assert job.result["violation"] == "reaches limit"
+    p1, p2 = job1.result["portfolio"], job2.result["portfolio"]
+    assert p1["winner"] is not None
+    # Determinism given the seed set: same winner, same config, same
+    # counterexample fingerprints.
+    assert p1["winner"]["member"] == p2["winner"]["member"]
+    assert p1["winner"]["config"] == p2["winner"]["config"]
+    assert (p1["winner"]["discovery"]["fingerprints"]
+            == p2["winner"]["discovery"]["fingerprints"])
+    # First winner cancels every loser.
+    statuses = [m["status"] for m in p1["members"]]
+    assert statuses.count("won") == 1
+    win_idx = statuses.index("won")
+    assert all(s in ("cancelled", "stopped", "completed")
+               for i, s in enumerate(statuses) if i != win_idx)
+    assert statuses.count("cancelled") >= 1
+    kinds = [e["event"] for e in events1]
+    assert "portfolio_start" in kinds
+    assert "portfolio_winner" in kinds
+    assert "portfolio_member_cancelled" in kinds
+    assert metrics1["portfolio_wins"] == 1
+    assert metrics1["violations_found"] == 1
+    # The winning config is folded back into the knob cache.
+    winner = p1["winner"]
+    label = workload_label("fixtures", 5, None,
+                           winner["config"]["symmetry"])
+    if winner["config"]["engine"] != "tpu":
+        label += ":portfolio-winner"
+    assert load_knobs(str(tmp_path / "a-knobs"), knob_key(label)) is not None
+
+
+def test_portfolio_on_clean_model_completes_exhaustively(service):
+    """No violation anywhere: the exhaustive anchor completes and its
+    counts are authoritative; there is no winner."""
+    job = submit_and_wait(service, {
+        "workload": "twophase", "n": 3,
+        "engine_kwargs": {"capacity": 1 << 14, "max_frontier": 1 << 7},
+        "portfolio": {"size": 3, "seed": 1, "simulation": False},
+    })
+    assert job.state == DONE, job.error
+    assert job.result["violation"] is None
+    assert job.result["portfolio"]["winner"] is None
+    assert job.result["unique_state_count"] == 288
+
+
+# --- HTTP surface ------------------------------------------------------------
+
+
+@pytest.fixture
+def http_service(tmp_path):
+    from stateright_tpu.serve.server import serve
+
+    svc = serve(
+        ("127.0.0.1", 0), block=False,
+        journal=str(tmp_path / "journal.jsonl"),
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    host, port = svc.address
+    yield svc, f"http://{host}:{port}"
+    svc.http_server.shutdown()
+    svc.scheduler.shutdown()
+
+
+def http_json(method, url, body=None, timeout=30):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_submit_status_result_metrics_cancel(http_service):
+    svc, base = http_service
+    # Submit a clean job and a violating portfolio job over HTTP.
+    clean = http_json("POST", base + "/jobs", SMALL_2PC)
+    viol = http_json("POST", base + "/jobs", {
+        "workload": "fixtures", "n": 5,
+        "portfolio": {"size": 3, "seed": 7},
+    })
+    assert clean["state"] == "queued"
+    for jid, want_violation in ((clean["id"], None),
+                                (viol["id"], "reaches limit")):
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            snap = http_json("GET", f"{base}/jobs/{jid}/result?wait=10")
+            if snap["state"] not in ("queued", "running"):
+                break
+        assert snap["state"] == "done", snap
+        assert snap["result"]["violation"] == want_violation
+    listing = http_json("GET", base + "/jobs")
+    assert [j["id"] for j in listing] == [clean["id"], viol["id"]]
+    metrics = http_json("GET", base + "/.metrics")
+    assert metrics["jobs"]["done"] == 2
+    assert metrics["jobs_completed"] == 2
+    assert metrics["violations_found"] == 1
+    assert "program_cache_hits" in metrics
+    status = http_json("GET", base + "/.status")
+    assert "fixtures" in status["workloads"]
+    # Errors: unknown job 404, bad spec 400, cancel-after-done 409.
+    for method, path, body, code in (
+        ("GET", "/jobs/nope", None, 404),
+        ("POST", "/jobs", {"workload": "twophase", "bogus": 1}, 400),
+        ("POST", f"/jobs/{clean['id']}/cancel", None, 409),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http_json(method, base + path, body)
+        assert e.value.code == code
+
+
+def test_http_explore_attaches_explorer_to_completed_job(http_service):
+    svc, base = http_service
+    resp = http_json("POST", base + "/jobs", SMALL_2PC)
+    jid = resp["id"]
+    snap = http_json("GET", f"{base}/jobs/{jid}/result?wait=120")
+    assert snap["state"] == "done"
+    attach = http_json("POST", f"{base}/jobs/{jid}/explore", {})
+    ehost, eport = attach["explorer_address"]
+    estatus = http_json("GET", f"http://{ehost}:{eport}/.status")
+    assert estatus["unique_state_count"] == 288
+    emetrics = http_json("GET", f"http://{ehost}:{eport}/.metrics")
+    assert emetrics["engine"] == "tpu-wavefront"
+    # Idempotent: a second attach returns the same address.
+    again = http_json("POST", f"{base}/jobs/{jid}/explore", {})
+    assert again["explorer_address"] == attach["explorer_address"]
+
+
+def test_checker_retention_cap_releases_oldest(tmp_path):
+    """A persistent daemon must not pin every completed job's checker
+    (device table + row log) forever: past the retention cap the oldest
+    unexplored checker is released — the result survives, only
+    Explorer attach stops working."""
+    svc = CheckService(knob_cache_dir=str(tmp_path / "knobs"),
+                       retain_checkers=1)
+    try:
+        j1 = submit_and_wait(
+            svc, {"workload": "fixtures", "n": 5, "engine": "bfs"})
+        j2 = submit_and_wait(
+            svc, {"workload": "fixtures", "n": 6, "engine": "bfs"})
+        assert j1.checker is None  # released past the cap
+        assert j2.checker is not None
+        assert j1.result["violation"] == "reaches limit"  # result intact
+        with pytest.raises(ValueError, match="no attached checker"):
+            svc.explore(j1)
+        assert svc.explore(j2) is not None
+    finally:
+        svc.scheduler.shutdown()
+
+
+# --- service journal under concurrent jobs -----------------------------------
+
+
+def test_service_journal_lines_never_tear_under_concurrent_writers(tmp_path):
+    """Satellite pin: many threads appending through separate Journal
+    instances sharing one path never produce a torn JSONL line (each
+    append is a single O_APPEND write)."""
+    from stateright_tpu.runtime.journal import Journal
+
+    path = str(tmp_path / "shared.jsonl")
+    writers, per = 8, 200
+    payload = "x" * 512  # well past any buffered-chunk boundary
+
+    def write_events(k):
+        j = Journal(path)  # own descriptor, like a separate job/process
+        for i in range(per):
+            j.append("stress", writer=k, i=i, pad=payload)
+        j.close()
+
+    threads = [
+        threading.Thread(target=write_events, args=(k,))
+        for k in range(writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == writers * per
+    seen = set()
+    for line in lines:
+        rec = json.loads(line)  # raises on any torn/interleaved line
+        seen.add((rec["writer"], rec["i"]))
+    assert len(seen) == writers * per
