@@ -645,36 +645,75 @@ def phase_sharded_smoke(record: dict) -> None:
     # smoke elides the exchange entirely, so the occupancy evidence comes
     # from the 8-shard virtual CPU mesh (the same mesh the weak-scaling
     # table in docs/SHARDED_SCALING.md is generated on) — per-shard
-    # candidate counters measured by the engine, golden-gated.
+    # candidate counters measured by the engine, golden-gated.  Since the
+    # bucketed exchange (r06) this section is also the REGRESSION GAUGE
+    # for the payload shape: occupancy must stay ≥10× the r05 fixed-
+    # buffer baseline (0.28%) and the measured useful bytes (pure
+    # candidate counts — bucketing must not change WHAT is exchanged,
+    # only the buffers it rides in) must stay within 1% of the r05 run.
     cpu_devs = jax.devices("cpu")
     if len(cpu_devs) >= 8:
+        from stateright_tpu.runtime.knob_cache import SHARDED_ENGINE
+
+        # Warm-start the discovered bucket rung from the knob cache so a
+        # repeat round skips any overflow-retry ramp (and fold the rung
+        # found this round back in for the next one).
+        key8 = _knob_key("paxos_check_2_sharded8", engine=SHARDED_ENGINE)
+        cached8 = load_knobs(KNOB_CACHE_DIR, key8) or {}
         mesh8 = jax.sharding.Mesh(np.array(cpu_devs[:8]), ("shards",))
         c8 = run_device(
             lambda: paxos_model(2).checker().spawn_tpu_sharded(
-                mesh=mesh8, capacity=1 << 16, chunk_size=1 << 9
+                mesh=mesh8, capacity=1 << 16, chunk_size=1 << 9,
+                bucket_slack=cached8.get("bucket_slack"),
             )
         )
         assert c8.unique_state_count() == 16_668, (
             f"virtual-8 paxos2 unique={c8.unique_state_count()} != 16668"
         )
         acc8 = c8.accounting()
-        record["exchange_occupancy"] = round(acc8["exchange_occupancy"], 6)
+        store_knobs(
+            KNOB_CACHE_DIR, key8,
+            {"bucket_slack": acc8["bucket_slack"]},
+            golden_unique=16_668, shards=8,
+        )
+        # r05 baselines (BENCH_r05 round, fixed [n, u_sz] buffers,
+        # capacity=1<<16 chunk=1<<9 on the virtual-8 mesh):
+        R05_OCCUPANCY = 0.0028
+        R05_USEFUL_BYTES = 3_425_968
+        occ8 = acc8["exchange_occupancy"]
+        useful8 = acc8["exchange_payload_bytes_total"]
+        assert occ8 >= 10 * R05_OCCUPANCY, (
+            f"bucketed-exchange regression: occupancy {occ8:.4f} < 10x "
+            f"the r05 fixed-buffer baseline {R05_OCCUPANCY}"
+        )
+        assert abs(useful8 - R05_USEFUL_BYTES) / R05_USEFUL_BYTES <= 0.01, (
+            f"bucketed exchange changed the USEFUL payload: {useful8} B "
+            f"vs r05 {R05_USEFUL_BYTES} B (>1%) — the buckets must carry "
+            "exactly the same candidates"
+        )
+        record["exchange_occupancy"] = round(occ8, 6)
         record["sharded_virtual8"] = {
             "waves": acc8["waves"],
-            "exchange_occupancy": round(acc8["exchange_occupancy"], 6),
-            "exchange_payload_bytes_total": acc8[
-                "exchange_payload_bytes_total"
-            ],
+            "exchange_occupancy": round(occ8, 6),
+            "exchange_occupancy_gain_vs_r05": round(
+                occ8 / R05_OCCUPANCY, 1
+            ),
+            "exchange_payload_bytes_total": useful8,
             "all_to_all_bytes_total": acc8["all_to_all_bytes_total"],
+            "exchange_bucket_lanes": acc8["exchange_bucket_lanes"],
+            "bucket_slack": acc8["bucket_slack"],
+            "bucket_retries": acc8["bucket_retries"],
             "unique_skew_max_over_mean": round(
                 acc8["unique_skew_max_over_mean"], 4
             ),
         }
         log(
-            f"sharded virtual-8: paxos2 occupancy="
-            f"{acc8['exchange_occupancy']:.4f} payload="
-            f"{acc8['exchange_payload_bytes_total']} B useful of "
-            f"{acc8['all_to_all_bytes_total']} B transmitted"
+            f"sharded virtual-8: paxos2 occupancy={occ8:.4f} "
+            f"({occ8 / R05_OCCUPANCY:.0f}x r05) payload={useful8} B "
+            f"useful of {acc8['all_to_all_bytes_total']} B transmitted "
+            f"(bucket={acc8['exchange_bucket_lanes']} lanes, "
+            f"slack={acc8['bucket_slack']}%, "
+            f"retries={acc8['bucket_retries']})"
         )
     else:
         # Elided exchange moves zero bytes; the identity occupancy ×

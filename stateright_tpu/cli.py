@@ -41,7 +41,7 @@ def _usage(name: str, spec: "CliSpec") -> str:
     if spec.tpu:
         lines.append(f"  check-tpu [{n_meta}]{net}"
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
-                     " [--trace]")
+                     " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
         "  serve [ADDRESS] [--journal PATH] [--knob-cache DIR]"
@@ -106,12 +106,17 @@ def _parse_n(args, default):
 def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
-    ``(positional_args, supervise, checkpoint_dir, resume, trace)`` or
-    raises ``ValueError`` on a malformed flag."""
+    ``(positional_args, supervise, checkpoint_dir, resume, trace,
+    sharded, bucket_slack)`` — ``sharded`` is None (single-chip), 0
+    (mesh over every visible device), or a mesh width; ``bucket_slack``
+    is the sharded engine's exchange-bucket rung in percent — or raises
+    ``ValueError`` on a malformed flag."""
     supervise = False
     resume = False
     trace = False
     ckpt_dir = None
+    sharded = None
+    bucket_slack = None
     out = []
     i = 0
     while i < len(args):
@@ -122,6 +127,33 @@ def _extract_runtime_flags(args):
             resume = True
         elif a == "--trace":
             trace = True
+        elif a == "--sharded":
+            sharded = 0  # all visible devices
+        elif a.startswith("--sharded="):
+            try:
+                sharded = int(a.split("=", 1)[1])
+            except ValueError:
+                raise ValueError("--sharded=SHARDS requires an integer")
+            if sharded < 1:
+                raise ValueError("--sharded=SHARDS requires SHARDS >= 1")
+        elif a == "--bucket-slack" or a.startswith("--bucket-slack="):
+            if a == "--bucket-slack":
+                i += 1
+                if i >= len(args):
+                    raise ValueError(
+                        "--bucket-slack requires a percentage"
+                    )
+                val = args[i]
+            else:
+                val = a.split("=", 1)[1]
+            try:
+                bucket_slack = int(val)
+            except ValueError:
+                raise ValueError(
+                    "--bucket-slack requires an integer percentage"
+                )
+            if bucket_slack < 1:
+                raise ValueError("--bucket-slack must be >= 1")
         elif a == "--checkpoint-dir":
             i += 1
             if i >= len(args):
@@ -139,7 +171,7 @@ def _extract_runtime_flags(args):
         else:
             out.append(a)
         i += 1
-    return out, supervise, ckpt_dir, resume, trace
+    return out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack
 
 
 def _parse_chaos_flags(args):
@@ -538,9 +570,32 @@ def example_main(spec: CliSpec, argv=None) -> int:
         return 0
     sub = args.pop(0)
     try:
-        args, supervise, ckpt_dir, resume, trace = _extract_runtime_flags(args)
+        (
+            args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
+        ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
+        return 2
+    if (sharded is not None or bucket_slack is not None) and sub != "check-tpu":
+        print(
+            "--sharded/--bucket-slack require the check-tpu subcommand",
+            file=sys.stderr,
+        )
+        return 2
+    if bucket_slack is not None and sharded is None:
+        print(
+            "--bucket-slack requires --sharded (it sizes the sharded "
+            "engine's per-destination exchange buckets)",
+            file=sys.stderr,
+        )
+        return 2
+    if sharded is not None and (supervise or resume or ckpt_dir):
+        print(
+            "--sharded does not combine with --supervise/--checkpoint-dir/"
+            "--resume from the CLI yet; use runtime.RunSupervisor with "
+            "engine='sharded' for supervised sharded runs",
+            file=sys.stderr,
+        )
         return 2
     if (supervise or ckpt_dir or resume) and sub != "check-tpu":
         print(
@@ -633,7 +688,41 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 # --checkpoint-dir the enriched wave records land in the
                 # run dir's journal.jsonl — the wave-trace artifact.
                 tpu_kwargs["trace"] = True
-            checker = builder.spawn_tpu(**tpu_kwargs)
+            if sharded is not None:
+                # Multi-chip run over the first SHARDS visible devices
+                # (0 = all).  The spec's single-chip kwargs translate:
+                # max_frontier becomes the per-shard chunk, and the
+                # single-chip-only knobs drop.
+                import jax
+                import numpy as _np
+
+                devs = jax.devices()
+                n_mesh = sharded or len(devs)
+                if n_mesh > len(devs):
+                    print(
+                        f"--sharded={n_mesh} exceeds the {len(devs)} "
+                        "visible devices",
+                        file=sys.stderr,
+                    )
+                    return 2
+                mesh = jax.sharding.Mesh(
+                    _np.array(devs[:n_mesh]), ("shards",)
+                )
+                if "max_frontier" in tpu_kwargs:
+                    tpu_kwargs["chunk_size"] = tpu_kwargs.pop(
+                        "max_frontier"
+                    )
+                for single_chip_only in (
+                    "log_capacity", "waves_per_call", "auto_tune",
+                ):
+                    tpu_kwargs.pop(single_chip_only, None)
+                if bucket_slack is not None:
+                    tpu_kwargs["bucket_slack"] = bucket_slack
+                checker = builder.spawn_tpu_sharded(
+                    mesh=mesh, **tpu_kwargs
+                )
+            else:
+                checker = builder.spawn_tpu(**tpu_kwargs)
         else:
             checker = builder.spawn_bfs()
         checker.join_and_report(WriteReporter(sys.stdout))

@@ -24,7 +24,6 @@ rebalancing analog); skew shows up only as idle lanes in a chunked wave.
 from __future__ import annotations
 
 import threading
-from functools import partial
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -132,6 +131,8 @@ class ShardedTpuChecker(Checker):
         checkpoint_every_waves: Optional[int] = None,
         checkpoint_every_sec: Optional[float] = None,
         trace: bool = False,
+        bucket_slack: Optional[int] = None,
+        waves_per_call: Optional[int] = None,
     ):
         """Same checkpoint/journal hooks as the single-chip engine
         (wavefront.py): ``journal`` streams wave-level telemetry as JSON
@@ -150,7 +151,19 @@ class ShardedTpuChecker(Checker):
         order as the fused loop; throughput is not comparable (per-wave
         dispatch+sync).  ``trace=False`` leaves the fused single-program
         path byte-for-byte unchanged.  Traced runs do not support
-        ``resume_from``; docs/OBSERVABILITY.md states the contract."""
+        ``resume_from``; docs/OBSERVABILITY.md states the contract.
+
+        ``bucket_slack``: per-destination exchange bucket width, in
+        PERCENT of the even share ``u_sz/n`` (wave_loop.py's
+        ``exchange_bucket_lanes``; default 50).  The all_to_all ships
+        ``[n, bucket, W+3]`` per shard instead of the former fixed
+        ``[n, u_sz, W+3]`` — ~n× less transmitted per wave at the
+        measured occupancies (docs/SHARDED_SCALING.md).  A wave whose
+        candidates overflow any destination bucket commits NOTHING,
+        raises flag 32, and the host retries the same chunk at the next
+        rung (slack ×2) — the engine's standard overflow-flag + retry
+        contract.  Warm starts pass the discovered rung back in (the
+        knob cache persists it) and skip the ramp."""
         super().__init__(options.model)
         import jax
 
@@ -238,9 +251,25 @@ class ShardedTpuChecker(Checker):
             )
         self._chunk = chunk_size
         self._dedup_factor = dedup_factor
-        from .wave_common import default_waves_per_call
+        from .wave_loop import BUCKET_SLACK_DEFAULT
 
-        self._waves_per_call = default_waves_per_call(options)
+        self._bucket_slack = (
+            BUCKET_SLACK_DEFAULT if bucket_slack is None
+            else int(bucket_slack)
+        )
+        if self._bucket_slack < 1:
+            raise ValueError("bucket_slack must be a positive percentage")
+        self._bucket_retries = 0  # overflow-retry rungs climbed this run
+        if waves_per_call is None:
+            from .wave_common import default_waves_per_call
+
+            waves_per_call = default_waves_per_call(options)
+        elif int(waves_per_call) < 1:
+            # waves_per_call=0 would seed every run() call with an
+            # exhausted budget: the device loop returns immediately with
+            # no progress and the host loop spins forever.
+            raise ValueError("waves_per_call must be >= 1")
+        self._waves_per_call = int(waves_per_call)
         self._properties = self._model.properties()
         self._ev_indices = [
             i
@@ -280,6 +309,30 @@ class ShardedTpuChecker(Checker):
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    # --- exchange geometry ---------------------------------------------------
+
+    def _u_sz(self) -> int:
+        """Current compaction/dedup buffer width (hashset.py's single
+        definition), from the LIVE chunk/dedup knobs — auto-grow may have
+        relaxed them mid-run."""
+        from .hashset import unique_buffer_size
+
+        return unique_buffer_size(
+            self._chunk * self._compiled.max_actions, self._dedup_factor
+        )
+
+    def _bucket_lanes(self) -> int:
+        """Per-destination exchange bucket width at the CURRENT slack
+        rung — the one source of truth (wave_loop.exchange_bucket_lanes)
+        shared by the device programs, the traced byte model, and
+        ``accounting()``, so reported payload geometry can never drift
+        from what the device transmits."""
+        from .wave_loop import exchange_bucket_lanes
+
+        return exchange_bucket_lanes(
+            self._u_sz(), self._n, self._bucket_slack
+        )
+
     # --- device program ------------------------------------------------------
 
     def _build_run(self):
@@ -298,12 +351,16 @@ class ShardedTpuChecker(Checker):
         same branch — a requirement for collectives inside the loop body.
 
         Exchange-buffer memory: candidates are locally pre-deduped before
-        bucketing (hashset.prededup), so the all_to_all operates on
-        ``[n, U, W+3]`` uint32 per shard with
+        bucketing (hashset.prededup) and then routed into PER-DESTINATION
+        BUCKETS, so the all_to_all operates on ``[n, bkt, W+3]`` uint32
+        per shard with ``bkt = exchange_bucket_lanes(U, n, bucket_slack)``
+        (≈ ``U/n · slack``, wave_loop.py) and
         ``U = max(min(chunk*max_actions, 16K), chunk*max_actions /
-        dedup_factor)`` — e.g. n=8, chunk=2^11, A=32, W=42,
-        dedup_factor=4: ~24 MB per shard (4x smaller than shipping the
-        raw candidate batch).  Size ``chunk_size`` accordingly.
+        dedup_factor)`` — transmitted bytes per wave scale with the real
+        per-destination share instead of the full ``U`` buffer (the n²
+        wall docs/SHARDED_SCALING.md measured).  A destination bucket
+        overflow raises flag 32 and the wave commits nothing; the host
+        retries the chunk at the next slack rung.
         """
         import jax
         import jax.numpy as jnp
@@ -336,7 +393,10 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
-        b = f * a  # per-shard candidate lanes; also the exchange bucket size
+        b = f * a  # per-shard candidate lanes (pre-compaction)
+        # Per-destination exchange bucket (wave_loop.exchange_bucket_lanes
+        # via _bucket_lanes — the same number accounting() reports).
+        bkt = self._bucket_lanes()
         target_depth = self._options._target_max_depth or 0
         fw_found_matched = make_finish_when_device(
             self._options._finish_when, props
@@ -387,15 +447,17 @@ class ShardedTpuChecker(Checker):
             states = store[safe_slots]
 
             # Shared expansion-time evaluation; ids are global this time.
+            # ``disc_prev`` is kept so a retryable-overflow wave (which
+            # must commit NOTHING — the host re-runs the same chunk at
+            # grown knobs) can revert its discovery candidates too, the
+            # single-chip engine's abort contract.
             my_gids = (me << u(slot_bits)) | safe_slots
+            disc_prev = disc
             disc, eb, nexts, valid, gen_local, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, my_gids,
                 ebits[safe_slots], disc, allow_two_phase=True,
             )
             generated = jax.lax.psum(gen_local, "shards")
-            new_lo = sc_lo + generated
-            sc_hi = sc_hi + (new_lo < sc_lo).astype(u)
-            sc_lo = new_lo
 
             # Local pre-dedup BEFORE the exchange: one stable sort elects a
             # representative per distinct local key, so only distinct keys
@@ -445,21 +507,28 @@ class ShardedTpuChecker(Checker):
             u_sz = u_hi.shape[0]
             gid_u = my_gids[orig_lane // u(a)]
             eb_u = eb[orig_lane // u(a)]
-            # Accounting: distinct candidates this shard contributes to the
-            # exchange this wave (the all_to_all payload's real occupancy);
-            # 64-bit via a lo/hi pair, like the state counter — this is
-            # the one counter proportional to total candidates.
-            new_cand_lo = cand_lo + jnp.sum(u_valid, dtype=u)
-            cand_hi = cand_hi + (new_cand_lo < cand_lo).astype(u)
-            cand_lo = new_cand_lo
 
+            def any_shard(x):
+                return jax.lax.psum(x.astype(u), "shards") > u(0)
+
+            # Retryable overflows are detected BEFORE any state mutation,
+            # so an overflowing wave can commit NOTHING: validity is
+            # masked off (the insert/store/queue writes become no-ops),
+            # counters and ``disc`` revert, and level_start does not
+            # advance — the host grows the tripped knob (dedup_factor /
+            # bucket_slack) and re-runs the exact same chunk with no
+            # work lost and no table rebuild needed.
+            g_lovf = any_shard(local_overflow)
             if n == 1:
                 # One-shard mesh: every key's owner is self, so the whole
                 # bucket/sort/all_to_all exchange is an identity — elide
                 # it at trace time and reuse the already-computed keys
                 # (this is most of the former 1-device overhead vs the
                 # single-chip engine).
-                rw, rg, reb, rv = rows_u, gid_u, eb_u, u_valid
+                g_bovf = jnp.zeros((), jnp.bool_)
+                commit = ~g_lovf
+                rw, rg, reb = rows_u, gid_u, eb_u
+                rv = u_valid & commit
                 rhi, rlo = u_hi, u_lo
             else:
                 # Bucket the representatives by owner shard; exchange
@@ -475,6 +544,15 @@ class ShardedTpuChecker(Checker):
                 counts = jnp.stack(
                     [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
                 )
+                # BUCKETED exchange: each destination gets a ``bkt``-lane
+                # bucket (a slack-scaled slice of the even share u_sz/n,
+                # wave_loop.exchange_bucket_lanes) instead of the full
+                # u_sz buffer — transmitted bytes shrink ~n× while the
+                # measured occupancies say real candidates fill a few
+                # percent of even the slim bucket.  A destination count
+                # past the bucket raises flag 32; nothing commits.
+                g_bovf = any_shard(jnp.any(counts[:n] > u(bkt)))
+                commit = ~(g_lovf | g_bovf)
                 offsets = jnp.concatenate(
                     [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
                 )
@@ -482,19 +560,21 @@ class ShardedTpuChecker(Checker):
                 dst = jnp.where(key_s < n, key_s, u(n))  # drop invalid
 
                 # Pack the row + its parent gid, ebits, and validity into
-                # one [n, U, W+3] buffer so a SINGLE all_to_all (one
+                # one [n, bkt, W+3] buffer so a SINGLE all_to_all (one
                 # collective launch per chunk, not four) carries the whole
-                # exchange — the docstring's W+3 layout.
+                # exchange.  Lanes past a bucket's width drop out of the
+                # scatter (mode="drop"); on an aborted wave the validity
+                # column is zeroed, so receivers insert nothing.
                 payload = jnp.concatenate(
                     [
                         rows_u,
                         gid_u[:, None],
                         eb_u[:, None],
-                        u_valid.astype(u)[:, None],
+                        (u_valid & commit).astype(u)[:, None],
                     ],
                     axis=1,
                 )
-                send = jnp.zeros((n, u_sz, w + 3), u)
+                send = jnp.zeros((n, bkt, w + 3), u)
                 send = send.at[dst, pos].set(payload[order], mode="drop")
                 recv = jax.lax.all_to_all(
                     send, "shards", split_axis=0, concat_axis=0, tiled=False
@@ -503,16 +583,38 @@ class ShardedTpuChecker(Checker):
                 # Local insert — the owner's insert IS the global dedup;
                 # the compact form keeps the store/parent/queue scatters
                 # proportional to distinct received keys.
-                flatrecv = recv.reshape(n * u_sz, w + 3)
+                flatrecv = recv.reshape(n * bkt, w + 3)
                 rw = flatrecv[:, :w]
                 rg = flatrecv[:, w]
                 reb = flatrecv[:, w + 1]
                 rv = flatrecv[:, w + 2] != u(0)
                 rhi, rlo = fp_of(rw)
+
+            # Commit gating for the global counters (the psums are
+            # shard-invariant, so every shard takes the same branch).
+            generated = jnp.where(commit, generated, u(0))
+            new_lo = sc_lo + generated
+            sc_hi = sc_hi + (new_lo < sc_lo).astype(u)
+            sc_lo = new_lo
+            # Accounting: distinct candidates this shard contributes to
+            # the exchange this wave (the all_to_all payload's real
+            # occupancy); 64-bit via a lo/hi pair, like the state counter
+            # — the one counter proportional to total candidates.
+            new_cand_lo = cand_lo + jnp.sum(u_valid & commit, dtype=u)
+            cand_hi = cand_hi + (new_cand_lo < cand_lo).astype(u)
+            cand_lo = new_cand_lo
+            disc = jnp.where(commit, disc, disc_prev)
+            count = jnp.where(commit, count, u(0))
             # dedup_factor=1: the receive batch is already per-sender
             # deduped, so its distinct-key count can approach the full
             # batch (disjoint keys per shard) — a divided buffer here
             # would spuriously overflow on waves the old code handled.
+            # dd_overflow is structurally False here (dedup_factor=1
+            # gives the insert a buffer covering its whole receive
+            # batch) but stays wired into the FATAL flag 64 below: if
+            # the sizing rule ever changes, dropped received states must
+            # be a loud error, never a silently wrong "verified" result
+            # (the traced loop keeps the same invariant guard).
             (
                 table, r_slot, r_new, r_origin, _r_active, probe_ok,
                 dd_overflow,
@@ -528,9 +630,13 @@ class ShardedTpuChecker(Checker):
             unique_l = unique_l + n_new
             unique_g = unique_g + jax.lax.psum(n_new, "shards")
 
-            # Append new slots at this shard's queue tail.
+            # Append new slots at this shard's queue tail.  The drop
+            # sentinel is the always-out-of-bounds all-ones index, NOT
+            # qcap+f: auto-grow may halve the chunk mid-run, and a
+            # sentinel derived from the CURRENT f would land in bounds
+            # of the larger originally-minted queue buffer.
             qpos = tail + jnp.cumsum(r_new.astype(u)) - 1
-            qidx = jnp.where(r_new, qpos, u(qcap + f))
+            qidx = jnp.where(r_new, qpos, u(0xFFFFFFFF))
             queue = queue.at[qidx].set(r_slot, mode="drop")
             tail = tail + n_new
 
@@ -541,16 +647,20 @@ class ShardedTpuChecker(Checker):
             depth = depth + done_level.astype(u)
             level_end = jnp.where(done_level, tail, level_end)
 
-            def any_shard(x):
-                return jax.lax.psum(x.astype(u), "shards") > u(0)
-
             flags = flags | jnp.where(any_shard(~probe_ok), 1, 0).astype(u)
             flags = flags | jnp.where(
                 any_shard(unique_l * u(2) > u(cap_s)), 1, 0
             ).astype(u)
             flags = flags | jnp.where(any_shard(tail > u(qcap)), 2, 0).astype(u)
+            # The insert's own dedup buffer runs at dedup_factor=1 over
+            # the receive batch, so its overflow is structurally
+            # impossible (the buffer covers the whole batch); flag 4 is
+            # exactly the pre-exchange compaction overflow, which the
+            # host can retry because the aborted wave committed nothing.
+            flags = flags | jnp.where(g_lovf, 4, 0).astype(u)
+            flags = flags | jnp.where(g_bovf, 32, 0).astype(u)
             flags = flags | jnp.where(
-                any_shard(dd_overflow | local_overflow), 4, 0
+                any_shard(dd_overflow), 64, 0
             ).astype(u)
             flags = flags | jnp.where(any_shard(step_flag), 8, 0).astype(u)
 
@@ -676,6 +786,7 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._bucket_slack,  # shapes the exchange buckets
             self._waves_per_call,  # baked into run() as a constant
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
             tuple(p.expectation for p in self._properties),
@@ -841,6 +952,7 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._bucket_slack,  # shapes the exchange buckets
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
             tuple(p.expectation for p in self._properties),
         )
@@ -888,6 +1000,7 @@ class ShardedTpuChecker(Checker):
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
         b = f * a
+        bkt = self._bucket_lanes()  # per-destination exchange bucket
         u = jnp.uint32
         shard = P("shards")
 
@@ -955,9 +1068,13 @@ class ShardedTpuChecker(Checker):
 
         def exchange_shard(u_hi, u_lo, rows_u, gid_u, eb_u, u_valid):
             # Bucket by owner + the single packed all_to_all (the fused
-            # body's exchange block), plus the receiver-side
+            # body's BUCKETED exchange block), plus the receiver-side
             # re-fingerprint of the arrived rows — charged to this phase
-            # because it only exists when an exchange happened.
+            # because it only exists when an exchange happened.  The
+            # per-shard bucket-overflow flag rides back so the host can
+            # abort BEFORE the insert/append phases commit anything and
+            # retry the wave at the next slack rung (the fused loop's
+            # contract, one wave later here because the host drives).
             u_sz = u_hi.shape[0]
             owner = _owner_mix(u_hi, u_lo) % u(n)
             key = jnp.where(u_valid, owner, u(n))
@@ -966,6 +1083,7 @@ class ShardedTpuChecker(Checker):
             counts = jnp.stack(
                 [jnp.sum((key == u(d)).astype(u)) for d in range(n + 1)]
             )
+            bucket_ovf = jnp.any(counts[:n] > u(bkt))
             offsets = jnp.concatenate(
                 [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
             )
@@ -980,17 +1098,17 @@ class ShardedTpuChecker(Checker):
                 ],
                 axis=1,
             )
-            send = jnp.zeros((n, u_sz, w + 3), u)
+            send = jnp.zeros((n, bkt, w + 3), u)
             send = send.at[dst, pos].set(payload[order], mode="drop")
             recv = jax.lax.all_to_all(
                 send, "shards", split_axis=0, concat_axis=0, tiled=False
             )
-            flatrecv = recv.reshape(n * u_sz, w + 3)
+            flatrecv = recv.reshape(n * bkt, w + 3)
             rw = flatrecv[:, :w]
             rhi, rlo = fp_of(rw)
             return (
                 rw, flatrecv[:, w], flatrecv[:, w + 1],
-                flatrecv[:, w + 2], rhi, rlo,
+                flatrecv[:, w + 2], rhi, rlo, bucket_ovf[None],
             )
 
         def insert_shard(key_hi, key_lo, rhi, rlo, rv):
@@ -1016,7 +1134,9 @@ class ShardedTpuChecker(Checker):
             ebits = ebits.at[sslot].set(reb[r_origin], mode="drop")
             n_new = jnp.sum(r_new, dtype=u)
             qpos = tail + jnp.cumsum(r_new.astype(u)) - 1
-            qidx = jnp.where(r_new, qpos, u(qcap + f))
+            # Always-OOB drop sentinel (not qcap+f): growth may halve
+            # the chunk mid-run while the queue keeps its minted length.
+            qidx = jnp.where(r_new, qpos, u(0xFFFFFFFF))
             queue = queue.at[qidx].set(r_slot, mode="drop")
             return store, parent, ebits, queue, n_new[None]
 
@@ -1044,7 +1164,8 @@ class ShardedTpuChecker(Checker):
         f = self._chunk
         b = f * cm.max_actions
         u_sz = unique_buffer_size(b, self._dedup_factor)
-        recv = n * u_sz if n > 1 else u_sz  # post-exchange insert lanes
+        bkt = self._bucket_lanes()
+        recv = n * bkt if n > 1 else u_sz  # post-exchange insert lanes
         step = copy_bytes(f, w) + b * 4 + copy_bytes(u_sz, w)
         if not two_phase:
             step += b * w * 4
@@ -1057,10 +1178,11 @@ class ShardedTpuChecker(Checker):
         )
         exchange = 0
         if n > 1:
-            # send-buffer scatter + the a2a move (in and out) + the
-            # receiver-side re-fingerprint.
+            # send-buffer scatter + the a2a move (in and out) of the
+            # BUCKETED [n, bkt, W+3] payload + the receiver-side
+            # re-fingerprint.
             exchange = (
-                3 * n * u_sz * (w + 3) * 4
+                3 * n * bkt * (w + 3) * 4
                 + recv * fpw * 4 + 2 * recv * 4
             )
         append = copy_bytes(recv, w) + 2 * copy_bytes(recv, 1) + recv * 4
@@ -1095,12 +1217,11 @@ class ShardedTpuChecker(Checker):
             if opts._timeout is not None else None
         )
         from ..obs.trace import WaveTracer
-        from .hashset import unique_buffer_size
         from .wave_common import two_phase_capable
 
         two_phase = two_phase_capable(cm)
 
-        u_sz = unique_buffer_size(f * cm.max_actions, self._dedup_factor)
+        bkt = self._bucket_lanes()
         tracer = WaveTracer(
             self._mesh.devices.flat[0], f"tpu-sharded-{n}"
         )
@@ -1143,6 +1264,7 @@ class ShardedTpuChecker(Checker):
                 shard,
             )
             t0 = _time.perf_counter()
+            disc_before = disc  # restored on a retryable-overflow re-run
             (
                 disc, rows_v, gid_v, eb_v, v_act, local_ovf_d, gen_d,
                 stepflag_d,
@@ -1158,7 +1280,7 @@ class ShardedTpuChecker(Checker):
             jax.block_until_ready(u_valid)
             t3 = _time.perf_counter()
             if n > 1:
-                rw, rg, reb, rv, rhi, rlo = progs["exchange"](
+                rw, rg, reb, rv, rhi, rlo, ovf_d = progs["exchange"](
                     u_hi, u_lo, rows_u, gid_u, eb_u, u_valid
                 )
                 jax.block_until_ready(rlo)
@@ -1168,7 +1290,29 @@ class ShardedTpuChecker(Checker):
                 rw, rg, reb, rv, rhi, rlo = (
                     rows_u, gid_u, eb_u, u_valid, u_hi, u_lo
                 )
+                ovf_d = None
             t4 = _time.perf_counter()
+            # Retryable-overflow gate BEFORE the insert/append phases,
+            # so an overflowing wave commits nothing (the fused loop's
+            # contract): grow the tripped knob in place, rebuild the
+            # phase programs at the new shapes, and re-run this wave —
+            # its inputs (store/queue/level bounds, and ``disc``, which
+            # is restored) are untouched by growth.
+            retry_flags = 0
+            if bool(np.asarray(local_ovf_d).any()):
+                retry_flags |= 4
+            if ovf_d is not None and bool(np.asarray(ovf_d).any()):
+                retry_flags |= 32
+            if retry_flags:
+                if self._grow_knobs(retry_flags) is None:
+                    raise RuntimeError(
+                        self._wl_overflow_message(retry_flags)
+                    )
+                disc = disc_before
+                f = self._chunk  # dedup growth may halve it
+                bkt = self._bucket_lanes()
+                progs = self._traced_programs()
+                continue
             (
                 key_hi, key_lo, r_slot, r_new, r_origin, probe_ok_d,
                 dd_ovf_d, rounds_d,
@@ -1198,10 +1342,10 @@ class ShardedTpuChecker(Checker):
                 flags |= 1
             if ((tails + n_new) > qcap).any():
                 flags |= 2
-            if (
-                bool(np.asarray(dd_ovf_d).any())
-                or bool(np.asarray(local_ovf_d).any())
-            ):
+            # Pre-exchange compaction overflow already retried above;
+            # the insert's own dd=1 buffer covers its whole batch, so
+            # this is a can't-happen invariant guard.
+            if bool(np.asarray(dd_ovf_d).any()):
                 flags |= 4
             if bool(np.asarray(stepflag_d).any()):
                 flags |= 8
@@ -1238,10 +1382,9 @@ class ShardedTpuChecker(Checker):
                 )
             if flags & 4:
                 raise RuntimeError(
-                    "a shard's chunk overflowed its compaction/dedup "
-                    f"buffers; lower dedup_factor (now "
-                    f"{self._dedup_factor}; 1 is always safe) or "
-                    "chunk_size"
+                    "the owner-side insert dedup buffer overflowed — "
+                    "impossible by construction at dedup_factor=1 over "
+                    "the receive batch; please report"
                 )
             if flags & 8:
                 raise RuntimeError(
@@ -1259,10 +1402,11 @@ class ShardedTpuChecker(Checker):
                 "readback": t7 - t6,
             }
             # The MEASURED exchange instrumentation: useful payload
-            # bytes this wave vs the static transmitted buffer.
+            # bytes this wave vs the BUCKETED transmitted buffer
+            # (waves × n² × bkt lanes across the mesh).
             useful = int(n_cand.sum()) * (w + 3) * 4 if n > 1 else 0
             occ_wave = (
-                float(n_cand.sum()) / (n * n * u_sz) if n > 1 else 0.0
+                float(n_cand.sum()) / (n * n * bkt) if n > 1 else 0.0
             )
             enrich = tracer.record_wave(
                 phases, self._traced_wave_bytes(rounds, two_phase),
@@ -1292,20 +1436,12 @@ class ShardedTpuChecker(Checker):
             self._metrics.inc("device_call_sec_total", t7 - t0)
             self._metrics.inc("device_calls", 1)
 
-            if opts._finish_when.matches(
-                frozenset(self._discovery_gids), props
-            ):
-                break
-            if (
-                opts._target_state_count is not None
-                and opts._target_state_count <= self._state_count
-            ):
-                break
-            if deadline is not None and _time.monotonic() >= deadline:
-                break
-            if self._stop_requested.is_set():
-                # Cooperative cancel (serve/scheduler.py): wind down like
-                # a deadline — committed counts stand.
+            # Shared termination tail (wave_loop.py): finish_when /
+            # target_state_count / deadline / cooperative cancel, the
+            # same predicate order as the fused loop by construction.
+            from .wave_loop import loop_should_break
+
+            if loop_should_break(self, remaining, depth, deadline):
                 break
 
         self._accounting = self._build_accounting(
@@ -1327,7 +1463,13 @@ class ShardedTpuChecker(Checker):
         stats_np[:, S_CAND_HI] = (cand_total >> 32).astype(np.uint32)
         stats_np[:, S_DEPTH] = depth
         stats_np[:, S_DISC:] = disc_h.astype(np.uint32)
-        self._carry_dev = {
+        if self._journal:
+            self._journal.append("trace_summary", **tracer.summary())
+        # Final carry / completion checkpoint / engine_done via the
+        # shared core, same as the fused loop.
+        from .wave_loop import finalize_run
+
+        finalize_run(self, {
             "key_hi": key_hi,
             "key_lo": key_lo,
             "store": store,
@@ -1335,25 +1477,7 @@ class ShardedTpuChecker(Checker):
             "ebits": ebits,
             "queue": queue,
             "stats": stats_np,
-        }
-        if self._checkpoint_path is not None:
-            self._write_snapshot(self._checkpoint_path, self._carry_dev)
-            if self._journal:
-                self._journal.append(
-                    "checkpoint",
-                    path=self._checkpoint_path,
-                    unique=self._unique_count,
-                    depth=self._max_depth,
-                    final=True,
-                )
-        if self._journal:
-            self._journal.append("trace_summary", **tracer.summary())
-            self._journal.append(
-                "engine_done",
-                unique=self._unique_count,
-                states=self._state_count,
-                depth=self._max_depth,
-            )
+        })
 
     def _seed_initial(self, shard):
         """Host-side owner routing + the seed program: one upload + one
@@ -1421,7 +1545,6 @@ class ShardedTpuChecker(Checker):
         import jax.numpy as jnp
 
         opts = self._options
-        cm = self._compiled
         props = self._properties
         n = self._n
         deadline = (
@@ -1442,6 +1565,21 @@ class ShardedTpuChecker(Checker):
             # — gids embed the owner shard, so a snapshot cannot move to
             # a different mesh size.
             snap = np.load(self._resume_from, allow_pickle=False)
+            if (
+                "n_shards" in snap.files
+                and int(snap["n_shards"]) != self._n
+            ):
+                # The dedicated LOUD mesh-size error (the generic key
+                # mismatch below also catches it for old snapshots, but
+                # names neither size): gids encode the owner shard, so a
+                # snapshot is bound to the exact mesh width that wrote it.
+                raise ValueError(
+                    f"sharded snapshot was written on a "
+                    f"{int(snap['n_shards'])}-shard mesh and cannot "
+                    f"resume on {self._n} shards: global state ids "
+                    "encode the owner shard; re-run on a mesh of the "
+                    "same size (or restart the check from scratch)"
+                )
             want_key = self._snapshot_key()
             got_key = str(snap["engine_key"])
             if got_key != want_key:
@@ -1453,8 +1591,10 @@ class ShardedTpuChecker(Checker):
             self._cap_s = int(snap["cap_s"])
             self._slot_bits = self._cap_s.bit_length() - 1
             self._chunk = int(snap["chunk"])
-            cap_s = self._cap_s
-            f = self._chunk
+            if "bucket_slack" in snap.files:
+                # Adopt the saved run's discovered bucket rung so a
+                # resume never re-pays the overflow-retry ramp.
+                self._bucket_slack = int(snap["bucket_slack"])
             from .wavefront import _device_owned
 
             def up(x):
@@ -1493,178 +1633,29 @@ class ShardedTpuChecker(Checker):
                     depth=self._max_depth,
                 )
         else:
-            cap_s = self._cap_s
-            f = self._chunk
             key_hi, key_lo, store, parent, ebits, queue, stats = (
                 self._seed_initial(shard)
             )
 
-        waves_per_call = self._waves_per_call
+        # The steady-state loop is the SHARED wave-loop core
+        # (parallel/wave_loop.py) — journal/metrics/checkpoint cadence,
+        # overflow dispatch (grow in place for dedup/bucket overflows,
+        # loud raise otherwise), and termination live there, identical
+        # to the single-chip engine by construction.
+        from .wave_loop import FusedWaveLoop, finalize_run
 
-        run = self._programs()
+        self._run_fn = self._programs()
+        carry = (key_hi, key_lo, store, parent, ebits, queue, stats)
+        carry, waves_total = FusedWaveLoop(self).run(carry, deadline)
+        key_hi, key_lo, store, parent, ebits, queue, stats = carry
+        stats_h = self._last_stats_h.copy()
+        # A keep-partial stop (deadline/cancel during a retryable
+        # overflow) can leave flag bits in the final readback; the
+        # flagged wave committed nothing, so the rest of the vector is
+        # the exact pre-wave state and a resume must start flag-clean.
+        stats_h[:, S_FLAGS] = 0
 
-        waves_total = 0
-        waves_since_ckpt = 0
-        last_ckpt_time = _time.monotonic()
-        while True:
-            t_call = _time.monotonic()
-            (
-                key_hi,
-                key_lo,
-                store,
-                parent,
-                ebits,
-                queue,
-                stats,
-            ) = run(
-                key_hi,
-                key_lo,
-                store,
-                parent,
-                ebits,
-                queue,
-                stats,
-            )
-            stats_h = np.asarray(stats).reshape(n, k_stats).astype(np.int64)
-            call_sec = _time.monotonic() - t_call
-            waves_this_call = waves_per_call - int(
-                stats_h[0, S_WAVES_LEFT].astype(np.int32)
-            )
-            waves_total += waves_this_call
-            waves_since_ckpt += waves_this_call
-            remaining_h = int(
-                (stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START]).sum()
-            )
-            depth_h = int(stats_h[0, S_DEPTH])
-            flags_h = int(stats_h[0, S_FLAGS])
-            disc_h = stats_h[:, S_DISC:]
-            with self._lock:
-                self._state_count = (
-                    int(stats_h[0, S_SC_HI]) << 32
-                ) | int(stats_h[0, S_SC_LO])
-                self._unique_count = int(stats_h[0, S_UNIQUE_G])
-                self._max_depth = depth_h + (1 if remaining_h else 0)
-                for d in range(n):
-                    for p, prop in enumerate(props):
-                        g = int(disc_h[d, p])
-                        if g != NO_GID:
-                            self._discovery_gids.setdefault(prop.name, g)
-            if self._journal:
-                self._journal.append(
-                    "wave",
-                    waves=waves_total,
-                    remaining=remaining_h,
-                    unique=self._unique_count,
-                    states=self._state_count,
-                    depth=depth_h,
-                    flags=flags_h,
-                    call_sec=round(call_sec, 4),
-                    # Binding constraint: the FULLEST shard's table load.
-                    occupancy=round(
-                        float(stats_h[:, S_UNIQUE_L].max()) / cap_s, 6
-                    ),
-                )
-            # Metrics ride the scalars this loop already read back —
-            # never an extra device sync (the trace-off contract).
-            self._metrics.update(
-                waves=waves_total,
-                table_occupancy=round(
-                    float(stats_h[:, S_UNIQUE_L].max()) / cap_s, 6
-                ),
-                last_call_sec=round(call_sec, 6),
-            )
-            self._metrics.inc("device_call_sec_total", call_sec)
-            self._metrics.inc("device_calls", 1)
-            if (
-                self._checkpoint_path is not None
-                and flags_h == 0
-                and (
-                    (
-                        self._ckpt_every_waves is not None
-                        and waves_since_ckpt >= self._ckpt_every_waves
-                    )
-                    or (
-                        self._ckpt_every_sec is not None
-                        and _time.monotonic() - last_ckpt_time
-                        >= self._ckpt_every_sec
-                    )
-                )
-            ):
-                t_ck = _time.monotonic()
-                self._write_snapshot(
-                    self._checkpoint_path,
-                    {
-                        "key_hi": key_hi,
-                        "key_lo": key_lo,
-                        "store": store,
-                        "parent": parent,
-                        "ebits": ebits,
-                        "queue": queue,
-                        "stats": stats_h.astype(np.uint32),
-                    },
-                )
-                waves_since_ckpt = 0
-                last_ckpt_time = _time.monotonic()
-                if self._journal:
-                    self._journal.append(
-                        "checkpoint",
-                        path=self._checkpoint_path,
-                        unique=self._unique_count,
-                        depth=depth_h,
-                        write_sec=round(last_ckpt_time - t_ck, 4),
-                    )
-            if flags_h & 16:
-                raise RuntimeError(
-                    "init-state seeding overflowed the insert buffers; "
-                    "raise capacity or lower dedup_factor"
-                )
-            if flags_h & 1:
-                raise RuntimeError(
-                    f"sharded fingerprint table overfull (per-shard "
-                    f"capacity {cap_s}); raise capacity"
-                )
-            if flags_h & 2:
-                raise RuntimeError(
-                    "a shard's frontier queue overflowed its backstop "
-                    "bound; raise capacity"
-                )
-            if flags_h & 4:
-                raise RuntimeError(
-                    "a shard's chunk had more VALID successor candidates "
-                    "(pre-exchange) or received more distinct states "
-                    "(post-exchange) than its compaction/dedup buffers "
-                    f"hold; lower dedup_factor (now {self._dedup_factor}; "
-                    "1 is always safe) or chunk_size"
-                )
-            if flags_h & 8:
-                raise RuntimeError(
-                    "the model step kernel flagged an encoding-capacity "
-                    "overflow (a successor exceeded the packed layout's "
-                    "bounds); the compiled model's capacity assumptions "
-                    "do not hold for this configuration"
-                )
-            if remaining_h == 0:
-                break
-            if (
-                opts._target_max_depth is not None
-                and depth_h + 1 >= opts._target_max_depth
-            ):
-                break
-            if opts._finish_when.matches(
-                frozenset(self._discovery_gids), props
-            ):
-                break
-            if (
-                opts._target_state_count is not None
-                and opts._target_state_count <= self._state_count
-            ):
-                break
-            if deadline is not None and _time.monotonic() >= deadline:
-                break
-            if self._stop_requested.is_set():
-                break
-
-        # Weak-scaling accounting: lockstep waves, the static all_to_all
+        # Weak-scaling accounting: lockstep waves, the bucketed all_to_all
         # payload, and its measured occupancy/skew (docs/SHARDED_SCALING.md;
         # replaces the former unquantified "statistically balanced" claim).
         cand_h = (
@@ -1679,9 +1670,9 @@ class ShardedTpuChecker(Checker):
         # single-chip engine).
         self._tables_dev = (parent, store)
         # Full run state for save_snapshot (the single-chip engine's
-        # snapshot-ready policy): bounded sharded runs can persist and
-        # resume exactly like single-chip ones.
-        self._carry_dev = {
+        # snapshot-ready policy, via the shared finalize): bounded sharded
+        # runs persist and resume exactly like single-chip ones.
+        finalize_run(self, {
             "key_hi": key_hi,
             "key_lo": key_lo,
             "store": store,
@@ -1689,67 +1680,246 @@ class ShardedTpuChecker(Checker):
             "ebits": ebits,
             "queue": queue,
             "stats": stats_h.astype(np.uint32),
-        }
-        if self._checkpoint_path is not None:
-            # Final checkpoint at stop, like the single-chip engine: the
-            # run directory always ends with a resumable snapshot.
-            self._write_snapshot(self._checkpoint_path, self._carry_dev)
-            if self._journal:
-                self._journal.append(
-                    "checkpoint",
-                    path=self._checkpoint_path,
-                    unique=self._unique_count,
-                    depth=self._max_depth,
-                    final=True,
-                )
-        if self._journal:
-            self._journal.append(
-                "engine_done",
-                unique=self._unique_count,
-                states=self._state_count,
-                depth=self._max_depth,
+        })
+
+    # --- shared wave-loop adapter (parallel/wave_loop.py) --------------------
+
+    def _wl_call(self, carry):
+        return self._run_fn(*carry)
+
+    def _wl_view(self, carry):
+        from .wave_loop import WaveView
+
+        props = self._properties
+        n = self._n
+        stats_h = (
+            np.asarray(carry[6])
+            .reshape(n, S_DISC + len(props))
+            .astype(np.int64)
+        )
+        self._last_stats_h = stats_h
+        remaining = int(
+            (stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START]).sum()
+        )
+        disc = []
+        for d in range(n):
+            for p, prop in enumerate(props):
+                g = int(stats_h[d, S_DISC + p])
+                if g != NO_GID:
+                    disc.append((prop.name, g))
+        return WaveView(
+            waves_this_call=self._waves_per_call
+            - int(np.uint32(stats_h[0, S_WAVES_LEFT]).astype(np.int32)),
+            remaining=remaining,
+            depth=int(stats_h[0, S_DEPTH]),
+            flags=int(stats_h[0, S_FLAGS]),
+            unique=int(stats_h[0, S_UNIQUE_G]),
+            states=(int(stats_h[0, S_SC_HI]) << 32)
+            | int(stats_h[0, S_SC_LO]),
+            # Binding constraint: the FULLEST shard's table load.
+            occupancy=float(stats_h[:, S_UNIQUE_L].max()) / self._cap_s,
+            discoveries=tuple(disc),
+            extra={},
+        )
+
+    def _wl_set_discovery(self, name: str, gid: int) -> None:
+        self._discovery_gids.setdefault(name, gid)
+
+    def _wl_discovered_names(self):
+        return self._discovery_gids
+
+    def _wl_write_checkpoint(self, carry) -> dict:
+        self._write_snapshot(
+            self._checkpoint_path,
+            {
+                "key_hi": carry[0],
+                "key_lo": carry[1],
+                "store": carry[2],
+                "parent": carry[3],
+                "ebits": carry[4],
+                "queue": carry[5],
+                "stats": self._last_stats_h.astype(np.uint32),
+            },
+        )
+        return {}
+
+    def _wl_retryable_flags(self) -> int:
+        # 4 = pre-exchange compaction/dedup overflow, 32 = exchange
+        # bucket overflow: both are detected before any state mutation,
+        # so the aborted wave committed nothing and a grown re-run is
+        # exact.  Table (1) / queue (2) growth would change the gid
+        # encoding that parent links and snapshots bake in, so those
+        # stay loud errors on this engine.
+        return 4 | 32
+
+    def _wl_overflow_message(self, flags: int) -> str:
+        if flags & 16:
+            return (
+                "init-state seeding overflowed the insert buffers; "
+                "raise capacity or lower dedup_factor"
             )
+        if flags & 1:
+            return (
+                f"sharded fingerprint table overfull (per-shard "
+                f"capacity {self._cap_s}); raise capacity"
+            )
+        if flags & 2:
+            return (
+                "a shard's frontier queue overflowed its backstop "
+                "bound; raise capacity"
+            )
+        if flags & 8:
+            return (
+                "the model step kernel flagged an encoding-capacity "
+                "overflow (a successor exceeded the packed layout's "
+                "bounds); the compiled model's capacity assumptions "
+                "do not hold for this configuration"
+            )
+        if flags & 4:
+            return (
+                "a shard's chunk had more VALID successor candidates "
+                "than its compaction/dedup buffers hold even at "
+                f"dedup_factor=1 (now {self._dedup_factor}); lower "
+                "chunk_size"
+            )
+        if flags & 32:
+            return (
+                "the per-destination exchange bucket overflowed at the "
+                f"full-buffer rung (bucket_slack={self._bucket_slack}) — "
+                "this cannot happen by construction; please report"
+            )
+        if flags & 64:
+            return (
+                "the owner-side insert dedup buffer overflowed — "
+                "impossible by construction at dedup_factor=1 over the "
+                "receive batch; please report"
+            )
+        return f"sharded engine overflow flags={flags}"
+
+    def _grow_knobs(self, flags: int):
+        """The knob half of in-place growth, shared by the fused and
+        traced retry paths: relax ``dedup_factor`` straight to 1 (flag 4,
+        the rule shared with wavefront.py via wave_loop) and/or climb
+        the exchange bucket-slack ladder (flag 32).  Both knobs only
+        shape per-wave scratch buffers — never the table, store, queue,
+        or gid encoding — so the re-run at grown shapes is exact.
+        Returns the grow-note string, or None when the tripped knob
+        cannot grow."""
+        from .wave_loop import (
+            log_grow, next_bucket_slack, relax_dedup_geometry,
+        )
+
+        notes = []
+        if flags & 4:
+            from .hashset import unique_buffer_size
+            from .wavefront import max_safe_unique_lanes
+
+            a = self._compiled.max_actions
+            u_cap = max_safe_unique_lanes(self._compiled.state_width + 3)
+            relaxed = relax_dedup_geometry(
+                self._chunk,
+                self._dedup_factor,
+                lambda c, dd: self._n * unique_buffer_size(c * a, dd),
+                u_cap,
+                chunk_label="chunk_size",
+            )
+            if relaxed is None:
+                return None
+            self._dedup_factor, self._chunk, note = relaxed
+            notes.append(note)
+        if flags & 32:
+            nxt = next_bucket_slack(
+                self._u_sz(), self._n, self._bucket_slack
+            )
+            if nxt is None:
+                return None
+            self._bucket_slack = nxt
+            self._bucket_retries += 1
+            notes.append(f"bucket_slack={nxt}")
+        log_grow(
+            self, flags, "; ".join(notes),
+            self._unique_count, self._max_depth,
+        )
+        return "; ".join(notes)
+
+    def _wl_grow(self, flags: int, carry):
+        """In-place growth for the fused loop (the shared wave-loop
+        core's grow hook): grow the knobs, then — because the aborted
+        wave committed nothing, so the stats readback IS the exact
+        pre-wave state — clear the flag bits in the host copy, re-upload
+        it (one small transfer per retry; every other carry is reused
+        as-is), recompile at the new shapes, and hand the loop the
+        patched carry to re-run the same chunk."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._grow_knobs(flags) is None:
+            return None
+        from .wavefront import _device_owned
+
+        stats_np = self._last_stats_h.astype(np.uint32).copy()
+        stats_np[:, S_FLAGS] = 0
+        shard = NamedSharding(self._mesh, P("shards"))
+        stats = _device_owned(
+            jax.device_put(jnp.asarray(stats_np.reshape(-1)), shard)
+        )
+        self._run_fn = self._programs()
+        return carry[:6] + (stats,)
 
     def _build_accounting(self, waves_total: int, cand_h, uniq_h) -> dict:
         """The weak-scaling accounting dict from measured per-shard
         counters (``cand_h``/``uniq_h``: int64[n]); shared by the fused
         and traced host loops so the payload geometry and occupancy
-        definitions cannot drift between them."""
-        from .hashset import unique_buffer_size
+        definitions cannot drift between them.
 
+        The ``all_to_all_bytes_*`` keys derive from the ACTUAL bucket
+        geometry (``_bucket_lanes()``, the same wave_loop function the
+        device program compiled against) — never hand-computed from the
+        static ``u_sz`` buffer shape — so the doc generator and bench
+        read one source of truth.  If the slack rung ramped mid-run, the
+        final (largest) bucket is reported: committed pre-ramp waves
+        shipped smaller buckets, so totals are a slight over- and
+        occupancy a slight under-statement, in the conservative
+        direction."""
         cm = self._compiled
         n = self._n
         f = self._chunk
-        b = f * cm.max_actions
-        u_sz = unique_buffer_size(b, self._dedup_factor)
+        u_sz = self._u_sz()
+        bkt = self._bucket_lanes()
         return {
             "shards": n,
             "waves": waves_total,
             "chunk_size": f,
             "exchange_lanes_per_shard": u_sz,
+            # The bucketed payload shape: each shard ships one
+            # [bkt, W+3] bucket per destination per wave.
+            "exchange_bucket_lanes": 0 if n == 1 else bkt,
+            "bucket_slack": self._bucket_slack,
+            "bucket_retries": self._bucket_retries,
             # On a 1-shard mesh the whole exchange is elided at trace
             # time (owner is always self), so no bytes move at all.
             "exchange_elided": n == 1,
             "all_to_all_bytes_per_wave_per_shard": (
                 0 if n == 1
-                else int(n * u_sz * (cm.state_width + 3) * 4)
+                else int(n * bkt * (cm.state_width + 3) * 4)
             ),
             "all_to_all_bytes_total": (
                 0 if n == 1
                 else int(
-                    waves_total * n * n * u_sz * (cm.state_width + 3) * 4
+                    waves_total * n * n * bkt * (cm.state_width + 3) * 4
                 )
             ),
             "candidates_sent_per_shard": cand_h.tolist(),
             # Fraction of TRANSMITTED lanes carrying a real candidate:
-            # each shard ships [n, u_sz] lanes per wave (one u_sz bucket
-            # per destination), so the denominator is waves * n^2 * u_sz
-            # across the mesh — occupancy * all_to_all_bytes_total =
-            # useful bytes.
+            # each shard ships [n, bkt] lanes per wave (one bkt-wide
+            # bucket per destination), so the denominator is
+            # waves * n^2 * bkt across the mesh — occupancy *
+            # all_to_all_bytes_total = useful bytes.
             # 0.0 when elided: nothing is transmitted, so the identity
             # occupancy × all_to_all_bytes_total = useful bytes holds.
             "exchange_occupancy": (
-                float(cand_h.sum() / (waves_total * n * n * u_sz))
+                float(cand_h.sum() / (waves_total * n * n * bkt))
                 if waves_total and n > 1
                 else 0.0
             ),
@@ -1802,6 +1972,12 @@ class ShardedTpuChecker(Checker):
                 engine_key=self._snapshot_key(),
                 cap_s=self._cap_s,
                 chunk=self._chunk,
+                # Mesh width travels as explicit data too (not just key
+                # material) so a wrong-mesh resume can say WHICH sizes
+                # disagree; bucket_slack rides along so resumes skip the
+                # overflow-retry ramp the saved run already climbed.
+                n_shards=self._n,
+                bucket_slack=self._bucket_slack,
                 **arrays,
             )
         os.replace(tmp, path)
@@ -1815,6 +1991,45 @@ class ShardedTpuChecker(Checker):
         if self._carry_dev is None:
             raise RuntimeError("no run state to snapshot")
         self._write_snapshot(path, self._carry_dev)
+
+    def tuned_kwargs(self) -> dict:
+        """Engine kwargs right-sized to THIS run's final knobs (the
+        single-chip engine's warm-start pattern): a fresh spawn of the
+        same workload on the same mesh starts past the overflow-retry
+        ramp — ``bucket_slack`` in particular is the discovered exchange
+        rung the knob cache persists (runtime/knob_cache.py)."""
+        self.join()
+        return dict(
+            capacity=self._cap_s * self._n,
+            chunk_size=self._chunk,
+            dedup_factor=self._dedup_factor,
+            bucket_slack=self._bucket_slack,
+        )
+
+    def discovered_fingerprints(self):
+        """Sorted uint64 fingerprints of every discovered unique state
+        (fingerprints of the ORIGINAL stored rows), for cross-engine
+        discovery-set comparison against the single-chip engine — the
+        bit-identity pin behind every scale claim
+        (tests/test_tpu_sharded.py).  Pulls the per-shard stores to the
+        host; size it like a path reconstruction, not a hot call."""
+        self.join()
+        if self._carry_dev is None:
+            raise RuntimeError("no run state to fingerprint")
+        from .wave_loop import fingerprints_of_rows
+
+        n, cap_s, w = self._n, self._cap_s, self._compiled.state_width
+        store = np.asarray(self._carry_dev["store"]).reshape(n, cap_s, w)
+        queue = np.asarray(self._carry_dev["queue"]).reshape(n, -1)
+        stats = np.asarray(self._carry_dev["stats"]).reshape(
+            n, S_DISC + len(self._properties)
+        )
+        rows = [
+            store[d, queue[d, : int(stats[d, S_TAIL])]] for d in range(n)
+        ]
+        return fingerprints_of_rows(
+            self._compiled, np.concatenate(rows, axis=0)
+        )
 
     # --- Checker surface -----------------------------------------------------
 
@@ -1848,9 +2063,14 @@ class ShardedTpuChecker(Checker):
             engine="tpu-sharded",
             shards=self._n,
             trace=self._trace,
+            capacity=self._cap_s * self._n,
             capacity_per_shard=self._cap_s,
             chunk_size=self._chunk,
             dedup_factor=self._dedup_factor,
+            bucket_slack=self._bucket_slack,
+            exchange_bucket_lanes=(
+                0 if self._n == 1 else self._bucket_lanes()
+            ),
         )
         out.update(self._metrics.snapshot())
         if self._accounting:

@@ -1,0 +1,319 @@
+"""Shared wave-loop core for the single-chip and sharded wavefront engines.
+
+Both engines run the same host-side loop around their fused device
+program: call the program, read ONE stats vector back, fold counters and
+discoveries, journal/metrics, maybe checkpoint, dispatch overflow flags
+(grow in place or raise loudly), and decide termination.  Before this
+module the two copies had already drifted (the sharded engine raised
+where the single-chip one grew, and only the single-chip loop honored
+the keep-partial-on-deadline rule); :class:`FusedWaveLoop` is the one
+definition both engines drive, so `save_snapshot`/`resume_from`,
+checkpoint cadence, cooperative cancel, and the in-place auto-grow
+contract exist on both engines by construction rather than by copy.
+
+This module also owns the **exchange bucket geometry** — the sharded
+engine's per-destination all_to_all buckets (:func:`exchange_bucket_lanes`)
+— as the single source of truth shared by the device program, the traced
+byte model, and `accounting()`, so the reported payload shape can never
+drift from what the device actually transmits (docs/SHARDED_SCALING.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import NamedTuple, Optional
+
+# Default per-destination bucket slack, in PERCENT of the even share
+# u_sz/n.  The measured per-wave exchange occupancy (docs/SHARDED_SCALING.md:
+# 0.28% of transmitted lanes at 8 shards, <1% even at the peak wave) says
+# real candidates fill a few percent of the even share at most, so HALF the
+# even share still carries >90% headroom — and the overflow-flag +
+# retry-at-next-rung contract makes an undersized bucket a recompile, not
+# a wrong answer.  Warm starts load the discovered rung from the knob
+# cache (runtime/knob_cache.py) and skip the ramp entirely.
+BUCKET_SLACK_DEFAULT = 50
+
+# Doubling rung ladder: 50% of the even share, then 100%, 200%, ... until
+# the bucket reaches the full u_sz buffer (the pre-bucketing shape, which
+# cannot overflow: a shard never has more than u_sz candidates in total).
+BUCKET_SLACK_MAX_RUNGS = 12
+
+
+def exchange_bucket_lanes(u_sz: int, n: int, slack_pct: int) -> int:
+    """Per-destination exchange bucket width in lanes: ``slack_pct`` percent
+    of the even share ``u_sz/n``, rounded up to a 128-lane multiple (TPU
+    lane tile), floored at 8 lanes, capped at ``u_sz`` (the full
+    pre-bucketing buffer — always safe).  ``n == 1`` meshes elide the
+    exchange entirely and keep the full buffer shape."""
+    if n <= 1:
+        return u_sz
+    even = -(-u_sz // n)  # ceil
+    want = -(-even * max(int(slack_pct), 1) // 100)
+    want = max(8, ((want + 127) // 128) * 128 if want > 8 else want)
+    return min(u_sz, want)
+
+
+def next_bucket_slack(u_sz: int, n: int, slack_pct: int) -> Optional[int]:
+    """The next rung of the bucket-slack ladder (doubling), or None when
+    the bucket already spans the full ``u_sz`` buffer — at which point a
+    bucket overflow is impossible by construction."""
+    if exchange_bucket_lanes(u_sz, n, slack_pct) >= u_sz:
+        return None
+    grown = slack_pct * 2
+    for _ in range(BUCKET_SLACK_MAX_RUNGS):
+        if exchange_bucket_lanes(u_sz, n, grown) > exchange_bucket_lanes(
+            u_sz, n, slack_pct
+        ):
+            return grown
+        grown *= 2
+    return None
+
+
+def relax_dedup_geometry(chunk, dedup_factor, lanes_of, lane_cap,
+                         chunk_label: str, chunk_floor: int = 2048):
+    """The shared dedup-overflow growth rule: straight to the always-safe
+    ``dedup_factor=1`` (intermediate stops measured as new worker-crash
+    geometries, wavefront.py's `_grow`), halving the chunk while
+    ``lanes_of(chunk, 1)`` exceeds the device-safe band.  Returns
+    ``(dedup_factor, chunk, note)`` or None when even the floor chunk
+    cannot fit the band (max_actions > 256)."""
+    if dedup_factor <= 1:
+        return None
+    notes = ["dedup_factor=1"]
+    c = chunk
+    while c > chunk_floor and lanes_of(c, 1) > lane_cap:
+        c //= 2
+        notes.append(f"{chunk_label}={c}")
+    if lanes_of(c, 1) > lane_cap:
+        return None
+    return 1, c, "; ".join(notes)
+
+
+class CheckpointCadence:
+    """Mid-run checkpoint pacing shared by every host loop: due every
+    ``every_waves`` waves (counted in whatever quantum the loop reports)
+    or ``every_sec`` seconds, whichever the engine was configured with."""
+
+    def __init__(self, every_waves: Optional[int], every_sec: Optional[float]):
+        self.every_waves = every_waves
+        self.every_sec = every_sec
+        self._waves = 0
+        self._last = time.monotonic()
+
+    def due(self, waves_increment: int) -> bool:
+        self._waves += waves_increment
+        if self.every_waves is not None and self._waves >= self.every_waves:
+            return True
+        return (
+            self.every_sec is not None
+            and time.monotonic() - self._last >= self.every_sec
+        )
+
+    def mark(self) -> None:
+        self._waves = 0
+        self._last = time.monotonic()
+
+
+class WaveView(NamedTuple):
+    """The host-visible summary of one fused program call, decoded from
+    the engine's stats readback — everything the shared loop needs to
+    journal, checkpoint, grow, and decide termination."""
+
+    waves_this_call: int
+    remaining: int  # frontier states left in the current level (global)
+    depth: int
+    flags: int
+    unique: int
+    states: int
+    occupancy: float  # fingerprint-table load (sharded: fullest shard)
+    discoveries: tuple  # ((prop_name, state_id), ...)
+    extra: dict  # engine-specific journal enrichment (e.g. tail)
+
+
+def loop_should_break(eng, view_remaining: int, depth: int, deadline) -> bool:
+    """The shared termination tail (exact predicate order preserved from
+    the pre-extraction loops): level drained / target depth / finish_when
+    / target_state_count / wall deadline / cooperative stop.  Used by the
+    fused driver below AND the engines' traced loops, so a traced run can
+    never outlive (or under-live) a fused one."""
+    opts = eng._options
+    if view_remaining == 0:
+        return True
+    if (
+        opts._target_max_depth is not None
+        and depth + 1 >= opts._target_max_depth
+    ):
+        return True
+    if opts._finish_when.matches(
+        frozenset(eng._wl_discovered_names()), eng._properties
+    ):
+        return True
+    if (
+        opts._target_state_count is not None
+        and opts._target_state_count <= eng._state_count
+    ):
+        return True
+    if deadline is not None and time.monotonic() >= deadline:
+        return True
+    return eng._stop_requested.is_set()
+
+
+class FusedWaveLoop:
+    """The fused host loop, engine-agnostic.  The engine adapter (the
+    checker itself) provides:
+
+    - ``_wl_call(carry) -> carry`` — run the fused device program once;
+    - ``_wl_view(carry) -> WaveView`` — the one stats readback, decoded;
+    - ``_wl_set_discovery(name, id)`` — first-writer-wins discovery fold
+      (called under the engine lock);
+    - ``_wl_write_checkpoint(carry) -> dict`` — persist a mid-run
+      snapshot, returning extra journal fields;
+    - ``_wl_retryable_flags() -> int`` — flag bits the engine can grow
+      in place (everything else raises);
+    - ``_wl_grow(flags, carry) -> carry | None`` — in-place growth (may
+      recompile programs / re-upload a fixed stats vector); None means
+      the tripped knob cannot grow;
+    - ``_wl_overflow_message(flags) -> str`` — the loud error text;
+
+    plus the shared checker attributes (`_options`, `_properties`,
+    `_journal`, `_metrics`, `_lock`, `_stop_requested`, counters, and the
+    checkpoint knobs).  An overflowing wave NEVER commits (both engines'
+    device programs guarantee it), so growth re-runs the same chunk with
+    no work lost and no host-visible side effects.
+    """
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def run(self, carry, deadline=None):
+        eng = self.eng
+        cadence = CheckpointCadence(eng._ckpt_every_waves, eng._ckpt_every_sec)
+        waves_total = 0
+        while True:
+            t_call = time.monotonic()
+            carry = eng._wl_call(carry)
+            view = eng._wl_view(carry)
+            call_sec = time.monotonic() - t_call
+            waves_total += view.waves_this_call
+            with eng._lock:
+                eng._state_count = view.states
+                eng._unique_count = view.unique
+                eng._max_depth = view.depth + (1 if view.remaining else 0)
+                for name, ident in view.discoveries:
+                    eng._wl_set_discovery(name, ident)
+            if eng._journal:
+                eng._journal.append(
+                    "wave",
+                    waves=waves_total,
+                    remaining=view.remaining,
+                    unique=view.unique,
+                    states=view.states,
+                    depth=view.depth,
+                    flags=view.flags,
+                    call_sec=round(call_sec, 4),
+                    occupancy=round(view.occupancy, 6),
+                    **view.extra,
+                )
+            eng._metrics.update(
+                waves=waves_total,
+                table_occupancy=round(view.occupancy, 6),
+                last_call_sec=round(call_sec, 6),
+            )
+            eng._metrics.inc("device_call_sec_total", call_sec)
+            eng._metrics.inc("device_calls", 1)
+            if (
+                eng._checkpoint_path is not None
+                and view.flags == 0
+                and cadence.due(view.waves_this_call)
+            ):
+                t_ck = time.monotonic()
+                ck_extra = eng._wl_write_checkpoint(carry) or {}
+                cadence.mark()
+                if eng._journal:
+                    eng._journal.append(
+                        "checkpoint",
+                        path=eng._checkpoint_path,
+                        unique=view.unique,
+                        depth=view.depth,
+                        write_sec=round(time.monotonic() - t_ck, 4),
+                        **ck_extra,
+                    )
+            if view.flags:
+                fatal = view.flags & ~eng._wl_retryable_flags()
+                if fatal:
+                    raise RuntimeError(eng._wl_overflow_message(fatal))
+                if eng._stop_requested.is_set() or (
+                    deadline is not None and time.monotonic() >= deadline
+                ):
+                    # Growth costs a recompile + re-run; a run already
+                    # past its budget (or asked to stop) keeps its
+                    # partial result instead.
+                    break
+                grown = eng._wl_grow(view.flags, carry)
+                if grown is None:
+                    raise RuntimeError(eng._wl_overflow_message(view.flags))
+                carry = grown
+                continue
+            if loop_should_break(eng, view.remaining, view.depth, deadline):
+                break
+        return carry, waves_total
+
+
+def finalize_run(eng, carry_dict: dict) -> None:
+    """The shared run tail: stash the snapshot-ready carry, write the
+    final completion checkpoint (a run directory always ends with a
+    durable resumable snapshot), and journal ``engine_done``."""
+    eng._carry_dev = carry_dict
+    if eng._checkpoint_path is not None:
+        eng._write_snapshot(eng._checkpoint_path, carry_dict)
+        if eng._journal:
+            eng._journal.append(
+                "checkpoint",
+                path=eng._checkpoint_path,
+                unique=eng._unique_count,
+                depth=eng._max_depth,
+                final=True,
+            )
+    if eng._journal:
+        eng._journal.append(
+            "engine_done",
+            unique=eng._unique_count,
+            states=eng._state_count,
+            depth=eng._max_depth,
+        )
+
+
+def fingerprints_of_rows(cm, rows_np):
+    """Sorted uint64 fingerprints of a batch of packed state rows — the
+    shared implementation behind both engines'
+    ``discovered_fingerprints()``, so cross-engine discovery-set pins
+    compare one definition (the device fingerprint of the ORIGINAL row's
+    leading ``fp_words``, exactly what identifies a state everywhere
+    else in the engines)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.device_fp import device_fp64
+
+    fpw = cm.fp_words or cm.state_width
+    hi, lo = device_fp64(jnp.asarray(rows_np[:, :fpw]))
+    fps = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        lo
+    ).astype(np.uint64)
+    return np.sort(fps)
+
+
+def log_grow(eng, flags: int, grown: str, unique: int, depth: int) -> None:
+    """Shared grow-event surfacing: a warning log line + a journaled
+    ``grow`` record, identical on both engines so supervisors and tests
+    read one schema."""
+    logging.getLogger(eng.__class__.__module__).warning(
+        "auto-tune: overflow flags=%d; growing in place (%s) at "
+        "unique=%d depth=%d",
+        flags, grown, unique, depth,
+    )
+    if eng._journal:
+        eng._journal.append(
+            "grow", flags=flags, grown=grown, unique=unique, depth=depth
+        )

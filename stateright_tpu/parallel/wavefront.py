@@ -844,18 +844,9 @@ class TpuChecker(Checker):
                 if g is None:
                     raise RuntimeError(msgs[bit])
                 grown.append(g)
-        import logging
+        from .wave_loop import log_grow
 
-        logging.getLogger(__name__).warning(
-            "auto-tune: overflow flags=%d; growing in place (%s) at "
-            "unique=%d depth=%d",
-            flags_h, "; ".join(grown), unique_h, depth_h,
-        )
-        if self._journal:
-            self._journal.append(
-                "grow", flags=flags_h, grown="; ".join(grown),
-                unique=unique_h, depth=depth_h,
-            )
+        log_grow(self, flags_h, "; ".join(grown), unique_h, depth_h)
         new_qcap = self._log_capacity
         new_pad = self._block_pad()
         if (new_qcap + new_pad) != (qcap + pad):
@@ -911,54 +902,43 @@ class TpuChecker(Checker):
             return f"log_capacity={self._log_capacity}"
         if flag & 4:
             from .hashset import unique_buffer_size
+            from .wave_loop import relax_dedup_geometry
 
-            if self._dedup_factor <= 1:
-                return None
-            # Straight to the always-safe 1, not stepwise: the intermediate
-            # stop (dd=2 at a doubled frontier) measured as a NEW
-            # worker-crash geometry on the 61.5M-state 2pc run (f=2^14/
-            # dd=2 crashed twice where f=2^13/dd=1 — same U lanes —
-            # completes; the common thread across all observed crashes is
-            # per-call device time: waves_per_call x per-wave cost beyond
-            # ~80s kills the tunneled worker, and halving the frontier
-            # below keeps the validated-safe call cadence).
-            self._dedup_factor = 1
-            grown = [f"dedup_factor={self._dedup_factor}"]
-            # Keep U inside the device-safe band: relaxing dd to 1
-            # widens the buffer up to ×dd (the whole batch), and past the
-            # validated band the worker hard-crashes instead of flagging.
+            # Straight to the always-safe 1, not stepwise (the
+            # intermediate dd=2-at-doubled-frontier stop measured as a
+            # NEW worker-crash geometry on the 61.5M-state 2pc run),
+            # halving the frontier while U exceeds the device-safe band
+            # — the rule lives in wave_loop.relax_dedup_geometry, shared
+            # with the sharded engine's flag-4 retry so the two engines'
+            # growth semantics cannot drift.
             a = self._compiled.max_actions
             u_cap = max_safe_unique_lanes(self._compiled.state_width)
-            while (
-                self._max_frontier > 2048
-                and unique_buffer_size(
-                    self._max_frontier * a, self._dedup_factor
-                ) > u_cap
-            ):
-                self._max_frontier //= 2
-                grown.append(f"max_frontier={self._max_frontier}")
-            if (
-                unique_buffer_size(self._max_frontier * a, self._dedup_factor)
-                > u_cap
-            ):
-                # Even the floor frontier cannot keep the buffer in the
-                # safe band (max_actions > 256): refuse loudly rather
-                # than proceed into the worker-crash band.
+            relaxed = relax_dedup_geometry(
+                self._max_frontier,
+                self._dedup_factor,
+                lambda c, dd: unique_buffer_size(c * a, dd),
+                u_cap,
+                chunk_label="max_frontier",
+            )
+            if relaxed is None:
+                # Already at dd=1, or even the floor frontier cannot
+                # keep the buffer in the safe band (max_actions > 256):
+                # refuse loudly rather than proceed into the
+                # worker-crash band.
                 return None
-            return "; ".join(grown)
+            self._dedup_factor, self._max_frontier, note = relaxed
+            return note
         return None
 
     def _check_once(self, deadline=None) -> None:
         if self._trace:
             return self._check_once_traced(deadline)
-        import time as _time
-
         import jax
         import jax.numpy as jnp
 
-        opts = self._options
         cm = self._compiled
         props = self._properties
+
         def sized(arr_np, n):
             """Pad/trim a 1-D snapshot array to ``n`` (the tail padding
             holds garbage by construction, so resumes may use different
@@ -984,7 +964,6 @@ class TpuChecker(Checker):
             self._capacity = int(snap["capacity"])
             self._log_capacity = int(snap["log_capacity"])
 
-        cap = self._capacity
         f = self._max_frontier
         qcap = self._log_capacity
         pad = self._block_pad()
@@ -1084,183 +1063,119 @@ class TpuChecker(Checker):
                 self._state_count = n_init
                 self._unique_count = int(stats_h[STAT_UNIQUE])
 
-            waves_done = 0  # cumulative, in waves_per_call quanta
-            waves_since_ckpt = 0
-            last_ckpt_time = _time.monotonic()
-            while True:
-                t_call = _time.monotonic()
-                key_hi, key_lo, rows, parent, ebits, stats = run(
-                    key_hi, key_lo, rows, parent, ebits, stats
-                )
-                # ONE small sync per waves_per_call chunks: every scalar
-                # the host reads travels in the stats vector.
-                stats_h = np.asarray(stats)
-                call_sec = _time.monotonic() - t_call
-                waves_done += self._waves_per_call
-                waves_since_ckpt += self._waves_per_call
-                remaining_h = int(stats_h[STAT_LEVEL_END]) - int(
-                    stats_h[STAT_LEVEL_START]
-                )
-                tail_h = int(stats_h[STAT_TAIL])
-                depth_h = int(stats_h[STAT_DEPTH])
-                flags_h = int(stats_h[STAT_FLAGS])
-                unique_h = int(stats_h[STAT_UNIQUE])
-                disc_h = stats_h[STAT_DISC:]
-                with self._lock:
-                    self._state_count = (
-                        int(stats_h[STAT_SC_HI]) << 32
-                    ) | int(stats_h[STAT_SC_LO])
-                    self._unique_count = unique_h
-                    self._max_depth = depth_h + (1 if remaining_h else 0)
-                    for p, prop in enumerate(props):
-                        if int(disc_h[p]) != NO_SLOT_HOST:
-                            self._discovery_slots.setdefault(
-                                prop.name, int(disc_h[p])
-                            )
-                if self._journal:
-                    self._journal.append(
-                        "wave",
-                        waves=waves_done,
-                        remaining=remaining_h,
-                        tail=tail_h,
-                        unique=unique_h,
-                        states=self._state_count,
-                        depth=depth_h,
-                        flags=flags_h,
-                        call_sec=round(call_sec, 4),
-                        occupancy=round(unique_h / cap, 6),
-                    )
-                # Metrics ride the scalars this loop already read back —
-                # never an extra device sync (the trace-off contract).
-                self._metrics.update(
-                    waves=waves_done,
-                    table_occupancy=round(unique_h / cap, 6),
-                    last_call_sec=round(call_sec, 6),
-                )
-                self._metrics.inc("device_call_sec_total", call_sec)
-                self._metrics.inc("device_calls", 1)
-                if (
-                    self._checkpoint_path is not None
-                    and flags_h == 0
-                    and (
-                        (
-                            self._ckpt_every_waves is not None
-                            and waves_since_ckpt >= self._ckpt_every_waves
-                        )
-                        or (
-                            self._ckpt_every_sec is not None
-                            and _time.monotonic() - last_ckpt_time
-                            >= self._ckpt_every_sec
-                        )
-                    )
-                ):
-                    t_ck = _time.monotonic()
-                    self._write_snapshot(
-                        self._checkpoint_path,
-                        self._carry_from(
-                            key_hi, key_lo, rows, parent, ebits, stats_h
-                        ),
-                    )
-                    waves_since_ckpt = 0
-                    last_ckpt_time = _time.monotonic()
-                    if self._journal:
-                        self._journal.append(
-                            "checkpoint",
-                            path=self._checkpoint_path,
-                            unique=unique_h,
-                            depth=depth_h,
-                            tail=tail_h,
-                            write_sec=round(last_ckpt_time - t_ck, 4),
-                        )
-                if flags_h & 8:
-                    raise RuntimeError(
-                        "the model step kernel flagged an encoding-capacity "
-                        "overflow (a successor exceeded the packed layout's "
-                        "bounds); the compiled model's capacity assumptions "
-                        "do not hold for this configuration"
-                    )
-                if flags_h and (
-                    self._stop_requested.is_set()
-                    or (deadline is not None
-                        and _time.monotonic() >= deadline)
-                ):
-                    # Growth costs a recompile + rehash + re-run; a run
-                    # already past its budget (or asked to stop) keeps
-                    # its partial result instead.
-                    break
-                if flags_h:
-                    # The flagged wave did not commit (see wave_body), so
-                    # the carry is the exact pre-wave state: grow the
-                    # tripped buffers IN PLACE, rebuild the table from the
-                    # committed row-log prefix (erasing any keys the
-                    # aborted wave managed to write), and continue from
-                    # the same chunk — no work is redone.
-                    rows, parent, ebits, key_hi, key_lo, qcap, pad = (
-                        self._grow_on_flags(
-                            flags_h, qcap, pad, rows, parent, ebits,
-                            tail_h, unique_h, depth_h,
-                        )
-                    )
-                    cap = self._capacity
-                    seed, run = self._programs()
-                    continue
-                if remaining_h == 0:
-                    break
-                if (
-                    opts._target_max_depth is not None
-                    and depth_h + 1 >= opts._target_max_depth
-                ):
-                    break
-                if opts._finish_when.matches(
-                    frozenset(self._discovery_slots), props
-                ):
-                    break
-                if (
-                    opts._target_state_count is not None
-                    and opts._target_state_count <= self._state_count
-                ):
-                    break
-                if deadline is not None and _time.monotonic() >= deadline:
-                    break
-                if self._stop_requested.is_set():
-                    # Cooperative cancel (serve/scheduler.py): wind down
-                    # exactly like a deadline — committed counts stand.
-                    break
+            # The steady-state loop is the SHARED wave-loop core
+            # (parallel/wave_loop.py) — journal/metrics/checkpoint
+            # cadence, overflow dispatch (in-place auto-grow via
+            # _wl_grow, loud raise otherwise), and termination live
+            # there, identical to the sharded engine by construction.
+            from .wave_loop import FusedWaveLoop, finalize_run
+
+            self._run_fn = run
+            self._loop_qcap, self._loop_pad = qcap, pad
+            carry = (key_hi, key_lo, rows, parent, ebits, stats)
+            carry, _waves = FusedWaveLoop(self).run(carry, deadline)
+            key_hi, key_lo, rows, parent, ebits, stats = carry
+            stats_h = self._last_stats_h
 
             # Keep the device arrays; path reconstruction walks the parent
             # chain ON DEVICE and reads back only the chain (a full-table
             # pull would be GBs through a tunneled device's ~18 MB/s link).
             self._tables_dev = (parent, rows)
-            # Full run state, for snapshotting: the reference cannot persist
-            # a run's visited set at all (SURVEY §5); here the whole checker
-            # state is a handful of dense arrays.  Scalars come from the
-            # last stats readback (same npz keys as before).
-            self._carry_dev = self._carry_from(
+            # Full run state, for snapshotting (via the shared finalize):
+            # the reference cannot persist a run's visited set at all
+            # (SURVEY §5); here the whole checker state is a handful of
+            # dense arrays.  Scalars come from the last stats readback
+            # (same npz keys as before).
+            finalize_run(self, self._carry_from(
                 key_hi, key_lo, rows, parent, ebits, stats_h
+            ))
+
+    # --- shared wave-loop adapter (parallel/wave_loop.py) --------------------
+
+    def _wl_call(self, carry):
+        return self._run_fn(*carry)
+
+    def _wl_view(self, carry):
+        from .wave_loop import WaveView
+
+        # ONE small sync per waves_per_call chunks: every scalar the
+        # host reads travels in the stats vector.
+        stats_h = np.asarray(carry[5])
+        self._last_stats_h = stats_h
+        remaining = int(stats_h[STAT_LEVEL_END]) - int(
+            stats_h[STAT_LEVEL_START]
+        )
+        disc = []
+        for p, prop in enumerate(self._properties):
+            s = int(stats_h[STAT_DISC + p])
+            if s != NO_SLOT_HOST:
+                disc.append((prop.name, s))
+        unique_h = int(stats_h[STAT_UNIQUE])
+        return WaveView(
+            waves_this_call=self._waves_per_call,
+            remaining=remaining,
+            depth=int(stats_h[STAT_DEPTH]),
+            flags=int(stats_h[STAT_FLAGS]),
+            unique=unique_h,
+            states=(int(stats_h[STAT_SC_HI]) << 32)
+            | int(stats_h[STAT_SC_LO]),
+            occupancy=unique_h / self._capacity,
+            discoveries=tuple(disc),
+            extra={"tail": int(stats_h[STAT_TAIL])},
+        )
+
+    def _wl_set_discovery(self, name: str, slot: int) -> None:
+        self._discovery_slots.setdefault(name, slot)
+
+    def _wl_discovered_names(self):
+        return self._discovery_slots
+
+    def _wl_write_checkpoint(self, carry) -> dict:
+        stats_h = self._last_stats_h
+        self._write_snapshot(
+            self._checkpoint_path,
+            self._carry_from(
+                carry[0], carry[1], carry[2], carry[3], carry[4], stats_h
+            ),
+        )
+        return {"tail": int(stats_h[STAT_TAIL])}
+
+    def _wl_retryable_flags(self) -> int:
+        # 1 = table overfull, 2 = row log full, 4 = dedup-buffer
+        # overflow: all grow in place (auto_tune off raises the loud
+        # per-knob message from _grow_on_flags instead).  8 (encoding
+        # overflow) is never retryable.
+        return 1 | 2 | 4
+
+    def _wl_overflow_message(self, flags: int) -> str:
+        if flags & 8:
+            return (
+                "the model step kernel flagged an encoding-capacity "
+                "overflow (a successor exceeded the packed layout's "
+                "bounds); the compiled model's capacity assumptions "
+                "do not hold for this configuration"
             )
-            if self._checkpoint_path is not None:
-                # Final checkpoint at stop: the run directory always ends
-                # with a durable, resumable snapshot of the last state —
-                # resuming a completed run is an immediate no-op finish,
-                # and a bounded (timeout/target) supervised run leaves its
-                # partial progress on disk without a separate
-                # save_snapshot call.
-                self._write_snapshot(self._checkpoint_path, self._carry_dev)
-                if self._journal:
-                    self._journal.append(
-                        "checkpoint",
-                        path=self._checkpoint_path,
-                        unique=self._unique_count,
-                        depth=self._max_depth,
-                        final=True,
-                    )
-            if self._journal:
-                self._journal.append(
-                    "engine_done",
-                    unique=self._unique_count,
-                    states=self._state_count,
-                    depth=self._max_depth,
-                )
+        return f"wavefront engine overflow flags={flags}"
+
+    def _wl_grow(self, flags: int, carry):
+        """In-place auto-tune growth for the fused loop (the shared
+        core's grow hook): the flagged wave did not commit (see
+        wave_body), so the carry is the exact pre-wave state — grow the
+        tripped buffers, rebuild the table from the committed row-log
+        prefix (erasing any keys the aborted wave managed to write),
+        recompile, and re-run the same chunk with no work redone."""
+        stats_h = self._last_stats_h
+        rows, parent, ebits, key_hi, key_lo, qcap, pad = (
+            self._grow_on_flags(
+                flags, self._loop_qcap, self._loop_pad,
+                carry[2], carry[3], carry[4],
+                int(stats_h[STAT_TAIL]), int(stats_h[STAT_UNIQUE]),
+                int(stats_h[STAT_DEPTH]),
+            )
+        )
+        self._loop_qcap, self._loop_pad = qcap, pad
+        _seed, self._run_fn = self._programs()
+        return (key_hi, key_lo, rows, parent, ebits, carry[5])
 
     # --- traced (phase-timed) mode -------------------------------------------
 
@@ -1646,18 +1561,11 @@ class TpuChecker(Checker):
                 self._metrics.inc("device_call_sec_total", t5 - t0)
                 self._metrics.inc("device_calls", 1)
 
-                if opts._finish_when.matches(
-                    frozenset(self._discovery_slots), props
-                ):
-                    break
-                if (
-                    opts._target_state_count is not None
-                    and opts._target_state_count <= self._state_count
-                ):
-                    break
-                if deadline is not None and _time.monotonic() >= deadline:
-                    break
-                if self._stop_requested.is_set():
+                # Shared termination tail (wave_loop.py): the same
+                # predicate order as the fused loop by construction.
+                from .wave_loop import loop_should_break
+
+                if loop_should_break(self, remaining, depth, deadline):
                     break
 
             # Same snapshot-ready tail as the fused loop: device tables
@@ -1680,27 +1588,13 @@ class TpuChecker(Checker):
                 ),
                 disc_h.astype(np.uint32),
             ])
-            self._carry_dev = self._carry_from(
-                key_hi, key_lo, rows, parent, ebits, stats_final
-            )
-            if self._checkpoint_path is not None:
-                self._write_snapshot(self._checkpoint_path, self._carry_dev)
-                if self._journal:
-                    self._journal.append(
-                        "checkpoint",
-                        path=self._checkpoint_path,
-                        unique=self._unique_count,
-                        depth=self._max_depth,
-                        final=True,
-                    )
             if self._journal:
                 self._journal.append("trace_summary", **tracer.summary())
-                self._journal.append(
-                    "engine_done",
-                    unique=self._unique_count,
-                    states=self._state_count,
-                    depth=self._max_depth,
-                )
+            from .wave_loop import finalize_run
+
+            finalize_run(self, self._carry_from(
+                key_hi, key_lo, rows, parent, ebits, stats_final
+            ))
 
     def _carry_from(self, key_hi, key_lo, rows, parent, ebits, stats_h):
         """Full run state as one dict — the ``save_snapshot`` npz layout
@@ -1822,6 +1716,26 @@ class TpuChecker(Checker):
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
         )
+
+    def discovered_fingerprints(self):
+        """Sorted uint64 fingerprints of every discovered unique state
+        (fingerprints of the ORIGINAL logged rows), for cross-engine
+        discovery-set comparison — the sharded engine must reproduce
+        this set bit-identically on every mesh size
+        (tests/test_tpu_sharded.py).  Pulls the committed row-log prefix
+        to the host; size it like a path reconstruction, not a hot
+        call."""
+        self.join()
+        if self._carry_dev is None:
+            raise RuntimeError("no run state to fingerprint")
+        from .wave_loop import fingerprints_of_rows
+
+        w = self._compiled.state_width
+        tail = int(self._carry_dev["tail"])
+        rows = np.asarray(self._carry_dev["rows"])[: tail * w].reshape(
+            tail, w
+        )
+        return fingerprints_of_rows(self._compiled, rows)
 
     # --- Checker surface -----------------------------------------------------
 

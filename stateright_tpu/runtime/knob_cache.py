@@ -33,6 +33,15 @@ from typing import Optional
 
 KNOBS_FILE = "knobs.json"
 
+# Engine/protocol tags for knob_key(): bump when a default-geometry or
+# knob-semantics change makes old entries misleading.  The sharded tag
+# changed when the exchange went bucketed — its entries now carry the
+# discovered ``bucket_slack`` rung (parallel/wave_loop.py), so a warm
+# start skips the bucket overflow-retry ramp as well as auto-tune;
+# pre-bucketing entries have no rung and must not shadow that.
+SINGLE_CHIP_ENGINE = "tpu-wavefront-v1"
+SHARDED_ENGINE = "tpu-sharded-bucketed-v1"
+
 # Serializes read-merge-write cycles within this process (two service
 # jobs storing knobs for different workloads must both survive).
 _LOCK = threading.Lock()
@@ -42,7 +51,7 @@ def _path(cache_dir: str) -> str:
     return os.path.join(cache_dir, KNOBS_FILE)
 
 
-def knob_key(label: str, engine: str = "tpu-wavefront-v1") -> str:
+def knob_key(label: str, engine: str = SINGLE_CHIP_ENGINE) -> str:
     """The canonical cache key: workload label + device identity +
     engine/protocol version (geometry defaults change what discovery
     finds).  One definition shared by bench.py and the checking service

@@ -311,14 +311,23 @@ class Scheduler:
         engine_kwargs = dict(cli.tpu_kwargs) if spec.engine == "tpu" else {}
         cache_key = None
         cache_hit = False
+        # Both device engines warm-start from the knob cache; sharded
+        # entries live under their own engine tag (their knob set —
+        # chunk_size/bucket_slack — is disjoint from the single-chip
+        # one, and the discovered bucket rung is exactly what lets a
+        # repeat skip the overflow-retry ramp).
+        device_engine = spec.engine in ("tpu", "sharded")
         if (
-            spec.engine == "tpu"
+            device_engine
             and spec.use_knob_cache
             and self.knob_cache_dir is not None
         ):
-            cache_key = knob_key(workload_label(
-                spec.workload, n, spec.network, spec.symmetry
-            ))
+            cache_key = knob_key(
+                workload_label(
+                    spec.workload, n, spec.network, spec.symmetry
+                ),
+                engine=self._knob_engine_tag(spec.engine),
+            )
             cached = None if _retry else load_knobs(
                 self.knob_cache_dir, cache_key
             )
@@ -359,7 +368,7 @@ class Scheduler:
         if (
             cache_key is not None
             and not cache_hit
-            and spec.engine == "tpu"
+            and device_engine
             and not spec.engine_kwargs  # explicit knobs aren't "tuned"
         ):
             # Persist the run's FINAL geometry (post any auto-tune
@@ -378,12 +387,30 @@ class Scheduler:
         return summary
 
     @staticmethod
+    def _knob_engine_tag(engine: str) -> str:
+        """The knob_key engine tag for a job's engine: sharded entries
+        live under SHARDED_ENGINE (their knob set is disjoint from the
+        single-chip one); everything else uses the single-chip default
+        (simulation winners only ever land under the portfolio-only
+        label, so the tag is inert for them)."""
+        from ..runtime.knob_cache import SHARDED_ENGINE, SINGLE_CHIP_ENGINE
+
+        return SHARDED_ENGINE if engine == "sharded" else SINGLE_CHIP_ENGINE
+
+    @staticmethod
     def _final_geometry(checker) -> dict:
+        # The keys are exactly the engines' spawn kwargs: single-chip
+        # exposes capacity/log_capacity/max_frontier/dedup_factor, the
+        # sharded engine capacity/chunk_size/dedup_factor/bucket_slack
+        # (the discovered exchange-bucket rung — persisting it is what
+        # lets a warm repeat skip the bucket overflow-retry ramp, not
+        # just the auto-tune growth).  Both engines' metrics() emit
+        # their own subset; the `in m` filter picks the right one.
         m = checker.metrics()
         return {
             k: int(m[k])
             for k in ("capacity", "log_capacity", "max_frontier",
-                      "dedup_factor")
+                      "chunk_size", "dedup_factor", "bucket_slack")
             if k in m
         }
 
@@ -511,7 +538,7 @@ class Scheduler:
         label = workload_label(
             spec.workload, n, spec.network, member.symmetry
         )
-        if member.engine == "tpu" and checker is not None:
+        if member.engine in ("tpu", "sharded") and checker is not None:
             knobs = self._final_geometry(checker) or member.engine_kwargs
         else:
             # A simulation winner's "config" is its seed/bounds, which
@@ -519,7 +546,7 @@ class Scheduler:
             # label so plain jobs never load it as engine geometry.
             label += ":portfolio-winner"
             knobs = member.engine_kwargs or {"seed": member.seed}
-        key = knob_key(label)
+        key = knob_key(label, engine=self._knob_engine_tag(member.engine))
         store_knobs(
             self.knob_cache_dir, key, knobs,
             portfolio_winner=True, member=member.index,

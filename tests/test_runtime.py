@@ -350,6 +350,99 @@ def test_supervised_kill_mid_run_resumes_identical(tmp_path, monkeypatch):
         assert json.load(fh) == result
 
 
+def test_sharded_supervised_kill_mid_run_resumes_identical(
+    tmp_path, monkeypatch
+):
+    """The sharded mirror of the kill-mid-run acceptance test, enabled
+    by the shared wave-loop core (parallel/wave_loop.py): a supervised
+    SHARDED child on the virtual mesh dies the moment its first
+    checkpoint lands, auto-resumes from it, and reports the same
+    totals and discovery set as an uninterrupted run."""
+    model = TwoPhaseSys(rm_count=4)
+    straight = (
+        model.checker()
+        .spawn_tpu_sharded(
+            capacity=1 << 14, chunk_size=1 << 6, waves_per_call=2,
+        )
+        .join()
+    )
+
+    monkeypatch.setenv(
+        "STATERIGHT_RUNTIME_FAULT_EXIT_AFTER_CHECKPOINT", "137"
+    )
+    run_dir = str(tmp_path / "run")
+    spec = CheckSpec(
+        model_factory=TwoPhaseSys,
+        factory_kwargs={"rm_count": 4},
+        engine="sharded",
+        engine_kwargs={
+            "capacity": 1 << 14,
+            "chunk_size": 1 << 6,
+            "waves_per_call": 2,
+        },
+    )
+    sup = RunSupervisor(
+        SupervisorConfig(
+            run_dir=run_dir,
+            checkpoint_every_waves=2,
+            checkpoint_every_sec=None,
+            call_deadline_sec=240.0,
+            poll_interval_sec=0.05,
+            max_restarts=2,
+        ),
+        spec=spec,
+    )
+    result = sup.run()
+
+    assert result["completed"]
+    assert result["unique_state_count"] == straight.unique_state_count()
+    assert result["state_count"] == straight.state_count()
+    assert result["max_depth"] == straight.max_depth()
+    assert result["discoveries"] == sorted(straight.discoveries())
+
+    events = journal_events(run_dir)
+    kinds = [e["event"] for e in events]
+    assert "checkpoint" in kinds
+    assert "crash" in kinds
+    assert "resume" in kinds
+    assert kinds.count("run_start") == 2
+    resume = next(e for e in events if e["event"] == "resume")
+    assert resume["unique"] > 0
+
+
+def test_sharded_resume_wrong_mesh_size_is_loud(tmp_path):
+    """A sharded snapshot is bound to the mesh width that wrote it
+    (gids encode the owner shard); resuming on a different width must
+    fail with an error that NAMES both sizes, not a generic key
+    mismatch."""
+    import jax
+    import numpy as np
+
+    model = TwoPhaseSys(rm_count=3)
+    bounded = (
+        model.checker()
+        .target_state_count(300)
+        .spawn_tpu_sharded(
+            mesh=jax.sharding.Mesh(
+                np.array(jax.devices("cpu")[:4]), ("shards",)
+            ),
+            capacity=1 << 13, chunk_size=1 << 6,
+        )
+        .join()
+    )
+    snap = str(tmp_path / "mesh4.npz")
+    bounded.save_snapshot(snap)
+    with pytest.raises(
+        ValueError, match=r"4-shard mesh and cannot resume on 2 shards"
+    ):
+        model.checker().spawn_tpu_sharded(
+            mesh=jax.sharding.Mesh(
+                np.array(jax.devices("cpu")[:2]), ("shards",)
+            ),
+            capacity=1 << 13, chunk_size=1 << 6, resume_from=snap,
+        ).join()
+
+
 def test_supervisor_deterministic_child_error_is_fatal(tmp_path):
     """A child that fails with a clean non-transient Python error (here:
     a model factory that raises) must NOT be retried into a crash loop;

@@ -160,6 +160,146 @@ def test_one_shard_mesh_elides_exchange_and_matches_host():
     assert acc["exchange_occupancy"] == 0.0
 
 
+def test_bucketed_exchange_fingerprint_pin_vs_single_chip():
+    """The bucketed exchange must not change WHAT is discovered, only
+    the buffers it rides in: the sharded discovery SET (sorted state
+    fingerprints) is bit-identical to the fused single-chip engine's at
+    2 and 4 virtual shards, and the accounting's byte totals derive from
+    the actual bucket geometry (occupancy × transmitted = useful)."""
+    model = TwoPhaseSys(rm_count=3)
+    single = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 13, max_frontier=1 << 6)
+        .join()
+    )
+    fps = single.discovered_fingerprints()
+    assert len(fps) == single.unique_state_count() == 288
+    for n in (2, 4):
+        sh = (
+            TwoPhaseSys(rm_count=3).checker()
+            .spawn_tpu_sharded(
+                mesh=_mesh(n), capacity=1 << 13, chunk_size=1 << 6
+            )
+            .join()
+        )
+        assert np.array_equal(sh.discovered_fingerprints(), fps)
+        acc = sh.accounting()
+        from stateright_tpu.parallel.compiled import compiled_model_for
+
+        w = compiled_model_for(model).state_width
+        assert acc["all_to_all_bytes_per_wave_per_shard"] == (
+            n * acc["exchange_bucket_lanes"] * (w + 3) * 4
+        )
+        assert acc["all_to_all_bytes_total"] == (
+            acc["waves"] * n * acc["all_to_all_bytes_per_wave_per_shard"]
+        )
+        # occupancy × transmitted = useful bytes (the accounting's own
+        # stated identity, now over the bucketed denominator).
+        assert acc["exchange_occupancy"] * acc["all_to_all_bytes_total"] \
+            == pytest.approx(acc["exchange_payload_bytes_total"], rel=1e-9)
+
+
+def test_bucket_overflow_retry_path_forced(tmp_path):
+    """A deliberately tiny bucket slack forces the overflow-flag +
+    retry-at-next-rung path: the run journals a ``grow`` event with
+    flag 32, climbs the slack ladder, and still lands the exact
+    single-chip discovery set — on the fused AND the traced loop."""
+    from stateright_tpu.runtime.journal import read_journal
+
+    model = TwoPhaseSys(rm_count=4)
+    single = (
+        model.checker()
+        .spawn_tpu(capacity=1 << 14, max_frontier=1 << 7)
+        .join()
+    )
+    fps = single.discovered_fingerprints()
+    journal = str(tmp_path / "bucket_retry.jsonl")
+    sh = (
+        TwoPhaseSys(rm_count=4).checker()
+        .spawn_tpu_sharded(
+            mesh=_mesh(4), capacity=1 << 14, chunk_size=1 << 7,
+            bucket_slack=1, journal=journal,
+        )
+        .join()
+    )
+    assert sh.unique_state_count() == 1568
+    assert np.array_equal(sh.discovered_fingerprints(), fps)
+    acc = sh.accounting()
+    assert acc["bucket_retries"] >= 1
+    assert acc["bucket_slack"] > 1  # the ladder actually climbed
+    grows = [e for e in read_journal(journal) if e["event"] == "grow"]
+    assert grows and any(e["flags"] & 32 for e in grows)
+
+    traced = (
+        TwoPhaseSys(rm_count=4).checker()
+        .spawn_tpu_sharded(
+            mesh=_mesh(4), capacity=1 << 14, chunk_size=1 << 7,
+            bucket_slack=1, trace=True,
+        )
+        .join()
+    )
+    assert traced.unique_state_count() == 1568
+    assert np.array_equal(traced.discovered_fingerprints(), fps)
+    assert traced.accounting()["bucket_retries"] >= 1
+
+
+@pytest.mark.slow
+def test_bucketed_paxos_golden_all_mesh_sizes():
+    """The ISSUE-8 acceptance pin: paxos c=2 (reference golden 16,668)
+    through the bucketed sharded engine at 1/2/4/8 virtual shards is
+    discovery-set bit-identical to the fused single-chip engine, and at
+    8 shards the transmitted all_to_all total is ≤ 250 MB (vs 1,233 MB
+    with the fixed [n, U] buffers) with measured lane occupancy ≥ 2%.
+    An extra 8-shard run with a deliberately tiny slack factor forces
+    the bucket-overflow retry path and must land the same set."""
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.paxos import PaxosModelCfg
+
+    def paxos2():
+        return PaxosModelCfg(
+            client_count=2,
+            server_count=3,
+            network=Network.new_unordered_nonduplicating(),
+        ).into_model()
+
+    single = (
+        paxos2().checker()
+        .spawn_tpu(capacity=1 << 16, max_frontier=1 << 9)
+        .join()
+    )
+    assert single.unique_state_count() == 16_668
+    fps = single.discovered_fingerprints()
+    for n in (1, 2, 4, 8):
+        sh = (
+            paxos2().checker()
+            .spawn_tpu_sharded(
+                mesh=_mesh(n), capacity=1 << 16, chunk_size=1 << 9
+            )
+            .join()
+        )
+        assert sh.unique_state_count() == 16_668
+        assert np.array_equal(sh.discovered_fingerprints(), fps)
+        if n == 8:
+            acc = sh.accounting()
+            assert acc["all_to_all_bytes_total"] <= 250_000_000
+            assert acc["exchange_occupancy"] >= 0.02
+    # Forced overflow-retry: same golden, same set.  The 2-shard mesh
+    # is the forcing one — its per-destination candidate peaks (~450 per
+    # wave) overflow the minimum 128-lane bucket, where the 8-shard
+    # split (~80 per destination) fits even the tiny-slack bucket.
+    forced = (
+        paxos2().checker()
+        .spawn_tpu_sharded(
+            mesh=_mesh(2), capacity=1 << 16, chunk_size=1 << 9,
+            bucket_slack=1,
+        )
+        .join()
+    )
+    assert forced.unique_state_count() == 16_668
+    assert np.array_equal(forced.discovered_fingerprints(), fps)
+    assert forced.accounting()["bucket_retries"] >= 1
+
+
 def test_owner_mix_host_matches_device():
     """Seeding routes init states by the HOST owner mix while the run
     loop's exchange routes by the DEVICE mix — a divergence would seed

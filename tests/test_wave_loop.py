@@ -1,0 +1,185 @@
+"""The shared wave-loop core (parallel/wave_loop.py): exchange bucket
+geometry units, the dedup-relax rule, checkpoint cadence, and — the
+ISSUE-8 acceptance matrix — snapshot/resume + in-place auto-grow running
+through the SAME extracted loop on BOTH wavefront engines."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from stateright_tpu.parallel.wave_loop import (  # noqa: E402
+    BUCKET_SLACK_DEFAULT,
+    CheckpointCadence,
+    exchange_bucket_lanes,
+    next_bucket_slack,
+    relax_dedup_geometry,
+)
+
+
+# --- bucket geometry ---------------------------------------------------------
+
+
+def test_exchange_bucket_lanes_basics():
+    # n=1 meshes elide the exchange and keep the full buffer shape.
+    assert exchange_bucket_lanes(8192, 1, BUCKET_SLACK_DEFAULT) == 8192
+    # 50% of the even share, 128-lane aligned: the doc workload's shape.
+    assert exchange_bucket_lanes(8192, 8, 50) == 512
+    assert exchange_bucket_lanes(8192, 2, 50) == 2048
+    # Never exceeds the full buffer (which cannot overflow)...
+    assert exchange_bucket_lanes(8192, 2, 10_000) == 8192
+    # ...and never collapses below the tiny-mesh floor.
+    assert exchange_bucket_lanes(64, 8, 1) >= 8
+
+
+def test_exchange_bucket_lanes_monotone_in_slack():
+    for u_sz in (96, 8192, 16384):
+        for n in (2, 4, 8):
+            prev = 0
+            for slack in (1, 2, 25, 50, 100, 200, 400, 100_000):
+                b = exchange_bucket_lanes(u_sz, n, slack)
+                assert b >= prev
+                assert b <= u_sz
+                prev = b
+
+
+def test_next_bucket_slack_ladder_terminates():
+    """Doubling from any rung reaches the full-buffer cap (where
+    overflow is impossible and the ladder reports None) in finitely many
+    strictly-growing steps."""
+    for u_sz in (96, 8192, 16384):
+        for n in (2, 8):
+            slack = 1
+            seen = 0
+            while True:
+                nxt = next_bucket_slack(u_sz, n, slack)
+                if nxt is None:
+                    assert exchange_bucket_lanes(u_sz, n, slack) == u_sz
+                    break
+                assert exchange_bucket_lanes(u_sz, n, nxt) > \
+                    exchange_bucket_lanes(u_sz, n, slack)
+                slack = nxt
+                seen += 1
+                assert seen < 32, "bucket ladder failed to terminate"
+
+
+# --- shared growth rule ------------------------------------------------------
+
+
+def test_relax_dedup_geometry_rule():
+    lanes = lambda c, dd: max(min(c * 4, 1 << 14), c * 4 // dd)  # noqa: E731
+    # Relax lands at dd=1 with the chunk kept when it fits the band.
+    assert relax_dedup_geometry(4096, 8, lanes, 1 << 20, "chunk_size") == (
+        1, 4096, "dedup_factor=1"
+    )
+    # Over the band: halve the chunk until it fits, noting each step.
+    dd, c, note = relax_dedup_geometry(
+        1 << 14, 8, lanes, 1 << 14, "chunk_size"
+    )
+    assert dd == 1 and c == 4096
+    assert "chunk_size=4096" in note
+    # Already at dd=1: nothing to relax.
+    assert relax_dedup_geometry(4096, 1, lanes, 1 << 20, "x") is None
+    # Even the floor chunk cannot fit: refuse.
+    assert relax_dedup_geometry(4096, 8, lanes, 16, "x") is None
+
+
+def test_checkpoint_cadence():
+    c = CheckpointCadence(every_waves=4, every_sec=None)
+    assert not c.due(2)
+    assert c.due(2)
+    c.mark()
+    assert not c.due(3)
+    assert c.due(1)
+    # Time-based cadence.
+    t = CheckpointCadence(every_waves=None, every_sec=0.0)
+    assert t.due(1)
+    n = CheckpointCadence(every_waves=None, every_sec=None)
+    assert not n.due(1000)
+
+
+# --- the cross-engine matrix: snapshot/resume + in-place auto-grow -----------
+
+
+def _spawn(engine, model, tmp_path, **kwargs):
+    b = model.checker()
+    for k, v in kwargs.pop("builder", {}).items():
+        b = getattr(b, k)(v)
+    if engine == "tpu":
+        return b.spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 6,
+            device=jax.devices("cpu")[0], **kwargs,
+        )
+    mesh = jax.sharding.Mesh(np.array(jax.devices("cpu")[:4]), ("shards",))
+    return b.spawn_tpu_sharded(
+        mesh=mesh, capacity=1 << 14, chunk_size=1 << 6, **kwargs,
+    )
+
+
+@pytest.mark.parametrize("engine", ["tpu", "sharded"])
+def test_snapshot_resume_matrix_both_engines(engine, tmp_path):
+    """One matrix, two engines, one extracted loop: a bounded run
+    snapshots mid-search, the resume completes to the uninterrupted
+    run's exact totals and discovery set."""
+    model = TwoPhaseSys(rm_count=4)
+    full = _spawn(engine, model, tmp_path).join()
+    assert full.unique_state_count() == 1568
+
+    bounded = _spawn(
+        engine, TwoPhaseSys(rm_count=4), tmp_path,
+        builder={"target_state_count": 400},
+    ).join()
+    assert bounded.unique_state_count() < 1568
+    snap = str(tmp_path / f"{engine}.npz")
+    bounded.save_snapshot(snap)
+
+    resumed = _spawn(
+        engine, TwoPhaseSys(rm_count=4), tmp_path, resume_from=snap,
+    ).join()
+    assert resumed.unique_state_count() == 1568
+    assert resumed.state_count() == full.state_count()
+    assert resumed.max_depth() == full.max_depth()
+    assert sorted(resumed.discoveries()) == sorted(full.discoveries())
+    assert np.array_equal(
+        resumed.discovered_fingerprints(), full.discovered_fingerprints()
+    )
+
+
+@pytest.mark.parametrize("engine", ["tpu", "sharded"])
+def test_auto_grow_in_place_matrix_both_engines(engine, tmp_path):
+    """One matrix, two engines, one extracted loop: a run spawned with a
+    deliberately undersized retryable knob grows IN PLACE (journaled
+    ``grow`` event, no restart, no lost work) and still lands the exact
+    full-run counts.  The forced knob is engine-appropriate — an
+    undersized table for the single-chip engine (flag 1), an undersized
+    exchange bucket for the sharded one (flag 32) — but the abort/grow/
+    re-run contract they exercise is the one shared FusedWaveLoop."""
+    from stateright_tpu.runtime.journal import read_journal
+
+    journal = str(tmp_path / f"{engine}_grow.jsonl")
+    model = TwoPhaseSys(rm_count=4)
+    if engine == "tpu":
+        ck = model.checker().spawn_tpu(
+            capacity=1 << 10,  # 1568 uniques exceed 50% load -> flag 1
+            max_frontier=1 << 6,
+            device=jax.devices("cpu")[0],
+            journal=journal,
+        ).join()
+        grown_flag = 1
+    else:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices("cpu")[:4]), ("shards",)
+        )
+        ck = model.checker().spawn_tpu_sharded(
+            mesh=mesh, capacity=1 << 14, chunk_size=1 << 7,
+            bucket_slack=1,  # tiny buckets -> flag 32
+            journal=journal,
+        ).join()
+        grown_flag = 32
+    assert ck.unique_state_count() == 1568
+    grows = [e for e in read_journal(journal) if e["event"] == "grow"]
+    assert grows, "no in-place grow event journaled"
+    assert any(e["flags"] & grown_flag for e in grows)
+    done = [e for e in read_journal(journal) if e["event"] == "engine_done"]
+    assert done and done[-1]["unique"] == 1568
