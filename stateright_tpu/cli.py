@@ -41,7 +41,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
     if spec.tpu:
         lines.append(f"  check-tpu [{n_meta}]{net}"
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
-                     " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]")
+                     " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]"
+                     " [--tiered] [--memory-budget-mb MB]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
         "  serve [ADDRESS] [--journal PATH] [--knob-cache DIR]"
@@ -107,16 +108,21 @@ def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
     ``(positional_args, supervise, checkpoint_dir, resume, trace,
-    sharded, bucket_slack)`` — ``sharded`` is None (single-chip), 0
-    (mesh over every visible device), or a mesh width; ``bucket_slack``
-    is the sharded engine's exchange-bucket rung in percent — or raises
-    ``ValueError`` on a malformed flag."""
+    sharded, bucket_slack, tiered, memory_budget_mb)`` — ``sharded`` is
+    None (single-chip), 0 (mesh over every visible device), or a mesh
+    width; ``bucket_slack`` is the sharded engine's exchange-bucket rung
+    in percent; ``tiered``/``memory_budget_mb`` select the out-of-core
+    engine under an HBM budget (docs/TIERED.md; the budget flag alone
+    implies ``--tiered``) — or raises ``ValueError`` on a malformed
+    flag."""
     supervise = False
     resume = False
     trace = False
     ckpt_dir = None
     sharded = None
     bucket_slack = None
+    tiered = False
+    memory_budget_mb = None
     out = []
     i = 0
     while i < len(args):
@@ -127,6 +133,34 @@ def _extract_runtime_flags(args):
             resume = True
         elif a == "--trace":
             trace = True
+        elif a == "--tiered":
+            tiered = True
+        elif a == "--memory-budget-mb" or a.startswith("--memory-budget-mb="):
+            if a == "--memory-budget-mb":
+                i += 1
+                if i >= len(args):
+                    raise ValueError(
+                        "--memory-budget-mb requires a size in MB"
+                    )
+                val = args[i]
+            else:
+                val = a.split("=", 1)[1]
+            try:
+                memory_budget_mb = float(val)
+            except ValueError:
+                raise ValueError(
+                    "--memory-budget-mb requires a number of MB "
+                    "(fractions allowed)"
+                ) from None
+            import math
+
+            if not math.isfinite(memory_budget_mb) or memory_budget_mb <= 0:
+                # float() parses "nan"/"inf" happily; they must die here
+                # as a usage error, not as a traceback deep in spawn.
+                raise ValueError(
+                    "--memory-budget-mb must be positive and finite"
+                )
+            tiered = True
         elif a == "--sharded":
             sharded = 0  # all visible devices
         elif a.startswith("--sharded="):
@@ -171,7 +205,10 @@ def _extract_runtime_flags(args):
         else:
             out.append(a)
         i += 1
-    return out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack
+    return (
+        out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
+        tiered, memory_budget_mb,
+    )
 
 
 def _parse_chaos_flags(args):
@@ -305,11 +342,14 @@ def _checkpointed_tpu_kwargs(ckpt_dir: str, resume: bool) -> dict:
 
 
 def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
-                    resume: bool) -> int:
+                    resume: bool, tiered: bool = False,
+                    memory_budget_mb=None) -> int:
     """Parent mode for ``check-tpu --supervise``: re-invoke this model
     module's own CLI as the supervised child (with ``--checkpoint-dir``/
     ``--resume``), watch its journal for death and hangs, and restart it
-    from the latest checkpoint until the check completes."""
+    from the latest checkpoint until the check completes.  Tiered flags
+    are forwarded verbatim so the restarted child resumes the same
+    out-of-core run (its checkpoint embeds the cold tier)."""
     from .runtime.supervisor import (
         RunSupervisor, SupervisorConfig, SupervisorError,
     )
@@ -327,6 +367,10 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
     child = [sys.executable, "-m", module, "check-tpu", str(n)]
     if network is not None:
         child.append(network.kind)
+    if tiered:
+        child.append("--tiered")
+    if memory_budget_mb is not None:
+        child.append(f"--memory-budget-mb={memory_budget_mb}")
     child += ["--checkpoint-dir", run_dir, "--resume"]
     sup = RunSupervisor(
         SupervisorConfig(
@@ -334,6 +378,7 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
             resume=resume,
             inherit_output=True,
             call_deadline_sec=600.0,
+            engine="tiered" if tiered else "tpu",
         ),
         child_argv=child,
         # Seed the geometry backoff with the child's ACTUAL engine knobs:
@@ -572,6 +617,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
     try:
         (
             args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
+            tiered, memory_budget_mb,
         ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
@@ -579,6 +625,28 @@ def example_main(spec: CliSpec, argv=None) -> int:
     if (sharded is not None or bucket_slack is not None) and sub != "check-tpu":
         print(
             "--sharded/--bucket-slack require the check-tpu subcommand",
+            file=sys.stderr,
+        )
+        return 2
+    if tiered and sub != "check-tpu":
+        print(
+            "--tiered/--memory-budget-mb require the check-tpu "
+            "subcommand (the tiered engine is the out-of-core wavefront; "
+            "docs/TIERED.md)",
+            file=sys.stderr,
+        )
+        return 2
+    if tiered and sharded is not None:
+        print(
+            "--tiered does not combine with --sharded (the cold tier is "
+            "single-chip; shard OR tier the table, not both)",
+            file=sys.stderr,
+        )
+        return 2
+    if tiered and trace:
+        print(
+            "--tiered does not combine with --trace (the tiered loop is "
+            "already host-driven per wave; trace the in-HBM engine)",
             file=sys.stderr,
         )
         return 2
@@ -651,7 +719,10 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 print(f"{spec.name} has no compiled TPU form",
                       file=sys.stderr)
                 return 2
-            return _run_supervised(spec, n, network, ckpt_dir, resume)
+            return _run_supervised(
+                spec, n, network, ckpt_dir, resume,
+                tiered=tiered, memory_budget_mb=memory_budget_mb,
+            )
         model = _build(spec, n, network)
         print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
               + (f", network={network.kind}" if network is not None else ""))
@@ -721,6 +792,13 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 checker = builder.spawn_tpu_sharded(
                     mesh=mesh, **tpu_kwargs
                 )
+            elif tiered:
+                # Out-of-core run under an HBM budget (docs/TIERED.md).
+                # The budget is authoritative in the engine itself: it
+                # overrides any spec-tuned capacity hint riding along.
+                if memory_budget_mb is not None:
+                    tpu_kwargs["memory_budget_mb"] = memory_budget_mb
+                checker = builder.spawn_tpu_tiered(**tpu_kwargs)
             else:
                 checker = builder.spawn_tpu(**tpu_kwargs)
         else:

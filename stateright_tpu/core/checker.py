@@ -139,6 +139,24 @@ class CheckerBuilder:
 
         return TpuSimulationChecker(self, seed, **kwargs)
 
+    def spawn_tpu_tiered(self, **kwargs) -> "Checker":
+        """Spawn the tiered out-of-core wavefront checker: the same
+        wavefront BFS as ``spawn_tpu`` under a fixed HBM budget
+        (``memory_budget_mb``) — the device hash set is the hot tier,
+        evicted fingerprint partitions live in host RAM (optionally
+        disk, ``cold_dir=``) as sorted immutable runs, and candidate
+        waves are merge-joined against the cold runs on device before
+        commit, so the discovery set is bit-identical to an
+        unconstrained run (docs/TIERED.md).  Use for state spaces whose
+        fingerprint set exceeds one chip's HBM, or whenever the table
+        footprint must be capped; resumable mid-run like ``spawn_tpu``."""
+        self._require(
+            "stateright_tpu.tiered.engine", "tiered TPU checker"
+        )
+        from ..tiered.engine import TieredTpuChecker
+
+        return TieredTpuChecker(self, **kwargs)
+
     def spawn_tpu_sharded(self, **kwargs) -> "Checker":
         """Spawn the multi-chip wavefront checker: frontier and visited set
         sharded over a ``jax.sharding.Mesh`` by fingerprint ownership, with

@@ -2072,7 +2072,13 @@ class ShardedTpuChecker(Checker):
                 0 if self._n == 1 else self._bucket_lanes()
             ),
         )
-        out.update(self._metrics.snapshot())
+        snap = self._metrics.snapshot()
+        # Fullest shard's table load (= unique_max/cap_s here: every
+        # sharded table entry is one unique state); same key as the
+        # single-chip and tiered engines so /.metrics readers see one
+        # name everywhere (docs/OBSERVABILITY.md).
+        out["table_load_factor"] = snap.get("table_occupancy", 0.0)
+        out.update(snap)
         if self._accounting:
             out["accounting"] = dict(self._accounting)
         if self._tracer is not None:

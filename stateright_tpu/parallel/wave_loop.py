@@ -175,6 +175,12 @@ class FusedWaveLoop:
       recompile programs / re-upload a fixed stats vector); None means
       the tripped knob cannot grow;
     - ``_wl_overflow_message(flags) -> str`` — the loud error text;
+    - ``_wl_after_commit(carry, view) -> carry | None`` (OPTIONAL) — the
+      spill/refill dispatch: called after every committed (flags == 0)
+      wave, before the checkpoint cadence, so an engine with a tiered
+      store (tiered/engine.py) can evict hot-tier partitions at its
+      budget threshold and have the very next checkpoint persist the
+      post-spill state.  Returning None keeps the carry;
 
     plus the shared checker attributes (`_options`, `_properties`,
     `_journal`, `_metrics`, `_lock`, `_stop_requested`, counters, and the
@@ -222,6 +228,13 @@ class FusedWaveLoop:
             )
             eng._metrics.inc("device_call_sec_total", call_sec)
             eng._metrics.inc("device_calls", 1)
+            if view.flags == 0:
+                # Spill/refill rung (tiered engines only): evict AT the
+                # committed boundary so the cadence block below persists
+                # the post-spill tier state in the same pass.
+                after_commit = getattr(eng, "_wl_after_commit", None)
+                if after_commit is not None:
+                    carry = after_commit(carry, view) or carry
             if (
                 eng._checkpoint_path is not None
                 and view.flags == 0
@@ -248,7 +261,18 @@ class FusedWaveLoop:
                 ):
                     # Growth costs a recompile + re-run; a run already
                     # past its budget (or asked to stop) keeps its
-                    # partial result instead.
+                    # partial result instead.  But the break lands on a
+                    # FLAGGED wave, whose aborted insert may have
+                    # scribbled keys into the fingerprint table: engines
+                    # whose aborted waves mutate the table must erase
+                    # them before the carry is persisted, or a resumed
+                    # run would treat the wave's states as already
+                    # visited and silently lose their subtrees (the
+                    # sharded engine zeroes validity pre-insert, so it
+                    # needs no hook).
+                    cleanup = getattr(eng, "_wl_abort_cleanup", None)
+                    if cleanup is not None:
+                        carry = cleanup(carry) or carry
                     break
                 grown = eng._wl_grow(view.flags, carry)
                 if grown is None:

@@ -367,6 +367,7 @@ class TpuChecker(Checker):
         ):
             self._ckpt_every_sec = 30.0
         self._carry_dev: Optional[dict] = None  # full run state at stop
+        self._final_load_factor: Optional[float] = None  # metrics() cache
         self._discoveries_cache: Optional[Dict[str, Path]] = None
         self._tables_dev: Optional[tuple] = None  # (parent, rows) on device
 
@@ -1157,6 +1158,17 @@ class TpuChecker(Checker):
             )
         return f"wavefront engine overflow flags={flags}"
 
+    def _wl_abort_cleanup(self, carry):
+        """Erase an aborted wave's fingerprint-table writes before a
+        keep-partial (stop/deadline) break persists the carry: the
+        growth path's rehash-from-committed-prefix, minus the growth.
+        Without it a resume would find the aborted wave's keys already
+        present, mark its states as duplicates, and silently drop
+        their entire subtrees."""
+        stats_h = self._last_stats_h
+        key_hi, key_lo = self._rehash(carry[2], int(stats_h[STAT_TAIL]))
+        return (key_hi, key_lo) + tuple(carry[2:])
+
     def _wl_grow(self, flags: int, carry):
         """In-place auto-tune growth for the fused loop (the shared
         core's grow hook): the flagged wave did not commit (see
@@ -1421,11 +1433,12 @@ class TpuChecker(Checker):
                     break
                 count = min(level_end - level_start, f)
                 t0 = _time.perf_counter()
+                disc_prev = disc  # t_step does not donate it
                 (
                     disc, eb, states, cand_rows, cand_src, cand_act,
                     n_valid_d, v_ovf_d, gen_d, stepflag_d,
                 ) = progs["step"](
-                    rows, ebits, disc,
+                    rows, ebits, disc_prev,
                     jnp.uint32(level_start), jnp.uint32(level_end),
                 )
                 jax.block_until_ready(cand_rows)
@@ -1481,7 +1494,19 @@ class TpuChecker(Checker):
                 ):
                     # Growth costs a rehash + re-run; a run already past
                     # its budget (or asked to stop) keeps its partial
-                    # result instead (the fused loop's policy).
+                    # result instead (the fused loop's policy).  The
+                    # aborted wave's discoveries still REVERT (same rule
+                    # as the growth branch below): the final snapshot
+                    # must not persist a discovery from a wave that
+                    # never committed, or a resume would run with its
+                    # awaiting mask pruned and diverge from an
+                    # uninterrupted run.  Its table writes are erased
+                    # the same way (the fused loop's _wl_abort_cleanup):
+                    # persisted aborted keys would make a resume drop
+                    # the wave's states as duplicates.
+                    disc = disc_prev
+                    disc_h = np.asarray(disc_prev)
+                    key_hi, key_lo = self._rehash(rows, tail)
                     break
                 if flags:
                     # Same IN-PLACE auto-tune growth as the fused loop
@@ -1490,11 +1515,14 @@ class TpuChecker(Checker):
                     # committed (both are gated below on flags == 0),
                     # and the rehash erases any keys the aborted insert
                     # wrote — the chunk simply re-runs at the grown
-                    # geometry.  ``disc`` keeps the aborted wave's
-                    # candidates: the re-run sees identical inputs
-                    # (rows/ebits/level bounds are untouched by growth),
-                    # so it recomputes exactly the same candidates —
-                    # equivalent to the fused loop's disc revert.
+                    # geometry.  ``disc`` REVERTS to its pre-wave value,
+                    # mirroring the fused loop's on-device
+                    # `where(commit, disc, disc_prev)`: a kept discovery
+                    # would change the re-run's awaiting mask (wave_eval
+                    # prunes expansion once a property is discovered)
+                    # and generate different successors than a committed
+                    # execution of the same wave.
+                    disc = disc_prev
                     rows, parent, ebits, key_hi, key_lo, qcap, pad = (
                         self._grow_on_flags(
                             flags, qcap, pad, rows, parent, ebits,
@@ -1616,6 +1644,12 @@ class TpuChecker(Checker):
             "disc": stats_h[STAT_DISC:].copy(),
         }
 
+    def _snapshot_extra(self) -> dict:
+        """Extra npz fields an engine subclass persists beside the
+        carry (the tiered engine's cold-tier state rides here) — so the
+        atomic-write body and the base field set exist exactly once."""
+        return {}
+
     def _write_snapshot(self, path: str, carry: dict) -> None:
         """Persist a carry dict atomically (write + rename), so a kill
         mid-checkpoint can never leave a torn snapshot where a resume
@@ -1633,6 +1667,7 @@ class TpuChecker(Checker):
                 # spawn args).
                 capacity=self._capacity,
                 log_capacity=self._log_capacity,
+                **self._snapshot_extra(),
                 **arrays,
             )
         os.replace(tmp, path)
@@ -1751,7 +1786,9 @@ class TpuChecker(Checker):
     def metrics(self) -> dict:
         """Live observability snapshot (names: docs/OBSERVABILITY.md).
         Safe to call mid-run — it reads the registry the host loop
-        updates from scalars it already synced, never the device.  The
+        updates from scalars it already synced, never the device.  (A
+        FINISHED checker's first call additionally reads the key
+        planes' true load factor back once and caches it.)  The
         Explorer's ``GET /.metrics`` serves exactly this."""
         out = super().metrics()
         out.update(
@@ -1763,7 +1800,30 @@ class TpuChecker(Checker):
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
         )
-        out.update(self._metrics.snapshot())
+        snap = self._metrics.snapshot()
+        # Table load factor: mid-run it is the loop's already-synced
+        # occupancy (metrics() never touches the device); a finished
+        # checker reports the key planes' actual occupied fraction via
+        # ONE cached HashSet.load_factor readback — ground truth even
+        # for engines whose tables hold more than unique states, and
+        # immutable once the run is done, so repeated /.metrics polls
+        # never re-reduce the key planes.
+        out["table_load_factor"] = snap.get("table_occupancy", 0.0)
+        if self._done.is_set() and self._carry_dev is not None:
+            if self._final_load_factor is None:
+                from .hashset import HashSet
+
+                try:
+                    self._final_load_factor = round(HashSet(
+                        self._carry_dev["key_hi"],
+                        self._carry_dev["key_lo"],
+                    ).load_factor(), 6)
+                except Exception:
+                    # Snapshot arrays already freed mid-teardown: keep
+                    # the loop's occupancy (and stop retrying).
+                    self._final_load_factor = out["table_load_factor"]
+            out["table_load_factor"] = self._final_load_factor
+        out.update(snap)
         if self._tracer is not None:
             out["trace_summary"] = self._tracer.summary()
         return out
@@ -1823,12 +1883,16 @@ class TpuChecker(Checker):
             _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
         )
 
-    def _rehash(self, rows, tail_h: int):
+    def _rehash(self, rows, tail_h: int, start_h: int = 0):
         """Rebuild the fingerprint table (sized to the CURRENT
-        ``self._capacity``) from the committed row-log prefix.  The OK
-        accumulator stays on device so chunk dispatches pipeline without
-        a per-chunk host round trip (the tunneled link makes each sync
-        milliseconds; at bench scale that is thousands of chunks)."""
+        ``self._capacity``) from the committed row-log positions
+        ``[start_h, tail_h)`` — the whole prefix for the auto-tune
+        growth path, a suffix segment for the tiered engine (whose hot
+        tier only ever holds states committed since the last spill;
+        tiered/engine.py).  The OK accumulator stays on device so chunk
+        dispatches pipeline without a per-chunk host round trip (the
+        tunneled link makes each sync milliseconds; at bench scale that
+        is thousands of chunks)."""
         import jax.numpy as jnp
 
         from .hashset import make_hashset
@@ -1838,7 +1902,7 @@ class TpuChecker(Checker):
         kh, kl = t.key_hi, t.key_lo
         ok = jnp.asarray(True)
         r = self._max_frontier
-        for start in range(0, tail_h, r):
+        for start in range(start_h, tail_h, r):
             kh, kl, ok = prog(
                 kh,
                 kl,

@@ -103,6 +103,8 @@ def run_child(run_dir: str) -> int:
             builder = builder.timeout(spec.timeout)
         if spec.engine == "sharded":
             checker = builder.spawn_tpu_sharded(**engine_kwargs)
+        elif spec.engine == "tiered":
+            checker = builder.spawn_tpu_tiered(**engine_kwargs)
         else:
             checker = builder.spawn_tpu(**engine_kwargs)
 

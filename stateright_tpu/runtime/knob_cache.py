@@ -41,6 +41,12 @@ KNOBS_FILE = "knobs.json"
 # pre-bucketing entries have no rung and must not shadow that.
 SINGLE_CHIP_ENGINE = "tpu-wavefront-v1"
 SHARDED_ENGINE = "tpu-sharded-bucketed-v1"
+# Tiered entries persist the budget-derived capacity (tiered/engine.py
+# pins it — the in-HBM right-sizing rule would silently un-tier a
+# warm-started repeat), so they must never shadow single-chip entries;
+# the serve scheduler additionally keys their LABEL by the job's
+# memory_budget_mb so entries never shadow each other across budgets.
+TIERED_ENGINE = "tpu-tiered-v1"
 
 # Serializes read-merge-write cycles within this process (two service
 # jobs storing knobs for different workloads must both survive).

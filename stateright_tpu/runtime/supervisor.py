@@ -110,6 +110,9 @@ _DEFAULTS = {
     "sharded": {"dedup_factor": 4, "frontier_key": "chunk_size",
                 "frontier": 1 << 11},
 }
+# The tiered engine is the single-chip engine plus a cold tier — same
+# knob names, same crash-relevant geometry axes.
+_DEFAULTS["tiered"] = _DEFAULTS["tpu"]
 FRONTIER_FLOOR = 2048
 WAVES_PER_CALL_FLOOR = 8
 
@@ -276,7 +279,7 @@ class CheckSpec:
     model_factory: Callable
     factory_args: tuple = ()
     factory_kwargs: dict = field(default_factory=dict)
-    engine: str = "tpu"  # "tpu" | "sharded"
+    engine: str = "tpu"  # "tpu" | "sharded" | "tiered"
     engine_kwargs: dict = field(default_factory=dict)
     target_state_count: Optional[int] = None
     target_max_depth: Optional[int] = None

@@ -34,7 +34,10 @@ DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
 
-_ENGINES = ("tpu", "sharded", "bfs", "dfs", "simulation", "tpu_simulation")
+_ENGINES = (
+    "tpu", "tiered", "sharded", "bfs", "dfs", "simulation",
+    "tpu_simulation",
+)
 _FINISH_WHEN = ("all", "any", "any_failures", "all_failures")
 
 

@@ -246,7 +246,7 @@ class Scheduler:
         builder = model.checker().threads(
             spec.threads or (os.cpu_count() or 1)
         )
-        device = engine in ("tpu", "sharded", "tpu_simulation")
+        device = engine in ("tpu", "tiered", "sharded", "tpu_simulation")
         depth = spec.target_max_depth
         if depth is None:
             depth = (
@@ -271,6 +271,8 @@ class Scheduler:
                engine_kwargs: dict, seed: int):
         if engine == "tpu":
             return builder.spawn_tpu(**engine_kwargs)
+        if engine == "tiered":
+            return builder.spawn_tpu_tiered(**engine_kwargs)
         if engine == "sharded":
             return builder.spawn_tpu_sharded(**engine_kwargs)
         if engine == "bfs":
@@ -308,25 +310,38 @@ class Scheduler:
         # warm start: the first job's auto-tune discovery is persisted,
         # so the second identical job spawns right-sized and skips the
         # growth pauses entirely (asserted by tests/test_serve.py).
-        engine_kwargs = dict(cli.tpu_kwargs) if spec.engine == "tpu" else {}
+        engine_kwargs = (
+            dict(cli.tpu_kwargs)
+            if spec.engine in ("tpu", "tiered")
+            else {}
+        )
         cache_key = None
         cache_hit = False
-        # Both device engines warm-start from the knob cache; sharded
-        # entries live under their own engine tag (their knob set —
-        # chunk_size/bucket_slack — is disjoint from the single-chip
-        # one, and the discovered bucket rung is exactly what lets a
-        # repeat skip the overflow-retry ramp).
-        device_engine = spec.engine in ("tpu", "sharded")
+        # Every device engine warm-starts from the knob cache; sharded
+        # and tiered entries live under their own engine tags (the
+        # sharded knob set — chunk_size/bucket_slack — is disjoint from
+        # the single-chip one, and tiered entries pin the budget-derived
+        # capacity, which must never shadow the in-HBM right-sizing).
+        device_engine = spec.engine in ("tpu", "tiered", "sharded")
         if (
             device_engine
             and spec.use_knob_cache
             and self.knob_cache_dir is not None
         ):
+            label = workload_label(
+                spec.workload, n, spec.network, spec.symmetry
+            )
+            if spec.engine == "tiered":
+                # Tiered entries pin a budget-DERIVED capacity (and a
+                # possibly budget-shrunk frontier), so the budget is
+                # part of the entry's identity: without it, one
+                # budget's tiny pinned table would silently warm-start
+                # the same workload at a different (or no) budget.
+                label += ":mb={}".format(
+                    spec.engine_kwargs.get("memory_budget_mb")
+                )
             cache_key = knob_key(
-                workload_label(
-                    spec.workload, n, spec.network, spec.symmetry
-                ),
-                engine=self._knob_engine_tag(spec.engine),
+                label, engine=self._knob_engine_tag(spec.engine),
             )
             cached = None if _retry else load_knobs(
                 self.knob_cache_dir, cache_key
@@ -365,11 +380,19 @@ class Scheduler:
         summary["engine"] = spec.engine
         summary["n"] = n
         summary["knob_cache_hit"] = cache_hit
+        # Explicit knobs aren't "tuned" and are never persisted — EXCEPT
+        # memory_budget_mb, which is a budget, not a geometry: it is the
+        # normal way a tiered job arrives, the engine re-derives capacity
+        # from it deterministically, and withholding the store would make
+        # the TIERED_ENGINE warm start unreachable for exactly the jobs
+        # it exists for (the discovered log_capacity/max_frontier are
+        # what the repeat would otherwise re-pay auto-tune for).
+        hand_tuned = set(spec.engine_kwargs) - {"memory_budget_mb"}
         if (
             cache_key is not None
             and not cache_hit
             and device_engine
-            and not spec.engine_kwargs  # explicit knobs aren't "tuned"
+            and not hand_tuned
         ):
             # Persist the run's FINAL geometry (post any auto-tune
             # growth), not the shrunk tuned_kwargs: an identical repeat
@@ -388,24 +411,33 @@ class Scheduler:
 
     @staticmethod
     def _knob_engine_tag(engine: str) -> str:
-        """The knob_key engine tag for a job's engine: sharded entries
-        live under SHARDED_ENGINE (their knob set is disjoint from the
-        single-chip one); everything else uses the single-chip default
-        (simulation winners only ever land under the portfolio-only
-        label, so the tag is inert for them)."""
-        from ..runtime.knob_cache import SHARDED_ENGINE, SINGLE_CHIP_ENGINE
+        """The knob_key engine tag for a job's engine: sharded and
+        tiered entries live under their own tags (their knob sets and
+        sizing rules differ from the single-chip engine's); everything
+        else uses the single-chip default (simulation winners only ever
+        land under the portfolio-only label, so the tag is inert for
+        them)."""
+        from ..runtime.knob_cache import (
+            SHARDED_ENGINE, SINGLE_CHIP_ENGINE, TIERED_ENGINE,
+        )
 
-        return SHARDED_ENGINE if engine == "sharded" else SINGLE_CHIP_ENGINE
+        if engine == "sharded":
+            return SHARDED_ENGINE
+        if engine == "tiered":
+            return TIERED_ENGINE
+        return SINGLE_CHIP_ENGINE
 
     @staticmethod
     def _final_geometry(checker) -> dict:
         # The keys are exactly the engines' spawn kwargs: single-chip
-        # exposes capacity/log_capacity/max_frontier/dedup_factor, the
-        # sharded engine capacity/chunk_size/dedup_factor/bucket_slack
-        # (the discovered exchange-bucket rung — persisting it is what
-        # lets a warm repeat skip the bucket overflow-retry ramp, not
-        # just the auto-tune growth).  Both engines' metrics() emit
-        # their own subset; the `in m` filter picks the right one.
+        # (and tiered, whose budget-derived capacity lands here as the
+        # capacity it pinned) exposes capacity/log_capacity/
+        # max_frontier/dedup_factor, the sharded engine capacity/
+        # chunk_size/dedup_factor/bucket_slack (the discovered
+        # exchange-bucket rung — persisting it is what lets a warm
+        # repeat skip the bucket overflow-retry ramp, not just the
+        # auto-tune growth).  Each engine's metrics() emits its own
+        # subset; the `in m` filter picks the right one.
         m = checker.metrics()
         return {
             k: int(m[k])

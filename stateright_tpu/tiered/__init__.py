@@ -1,0 +1,16 @@
+"""Tiered out-of-core checking: HBM hot tier, host-RAM/disk cold tier.
+
+Breaks the single-chip HBM ceiling on the fingerprint set (ROADMAP open
+item #2, VERDICT missing #3): the device hash table holds the hot
+working set under a fixed ``memory_budget_mb``, evicted partitions live
+as sorted immutable runs in the host :class:`ColdStore`, and each wave's
+hot-tier-new candidates are merge-joined against the overlapping run
+windows on device before commit — same discovery set as an unconstrained
+run, bit-identical (``discovered_fingerprints()`` pins).  docs/TIERED.md
+has the full design.
+"""
+
+from .cold_store import ColdStore
+from .engine import TieredTpuChecker, capacity_for_budget
+
+__all__ = ["ColdStore", "TieredTpuChecker", "capacity_for_budget"]

@@ -1,0 +1,157 @@
+"""Host-side cold tier: evicted fingerprint partitions as sorted runs.
+
+The TLC lineage (Yu–Manolios–Lamport, PAPERS.md) keeps the fingerprint
+set on disk as sorted immutable runs and merge-joins candidate batches
+against them; this is the same structure one level up the hierarchy —
+host RAM first, disk optionally under it — holding the partitions the
+device engine evicts when its HBM hash table crosses the memory budget
+(tiered/engine.py).
+
+Each spill adds one immutable run: a sorted ``uint64`` fingerprint array
+(8 bytes/state — 10⁸ states ≈ 800 MB of host RAM, far under a typical
+host's memory next to a 16 GB chip).  Runs may overlap (the hot tier
+caches cold-duplicate keys, and those ride along on the next spill);
+membership is "present in ANY run", so overlap costs probe passes, never
+correctness.  When the run count passes ``max_runs`` the store compacts
+every run into one deduplicated array — the classic LSM merge, amortized
+O(total) per spill epoch.
+
+With ``spill_dir`` set, runs live on disk as ``.npy`` files opened back
+memory-mapped, so the host RSS holds only the pages the merge-join
+windows actually touch — the optional disk tier.  The engine's snapshot
+embeds the whole store in its checkpoint.npz (``save_snapshot`` format,
+docs/TIERED.md) so a killed run resumes with its tiers intact.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ColdStore:
+    """Sorted immutable uint64 fingerprint runs with LSM-style merging."""
+
+    def __init__(self, spill_dir: Optional[str] = None, max_runs: int = 8):
+        if max_runs < 1:
+            raise ValueError("max_runs must be >= 1")
+        self._runs: List[np.ndarray] = []
+        self._paths: List[Optional[str]] = []  # disk backing, when spilled
+        self.spill_dir = spill_dir
+        self.max_runs = max_runs
+        self._seq = 0  # monotonic file-name counter (never reused)
+
+    # -- read surface ---------------------------------------------------------
+
+    @property
+    def runs(self) -> List[np.ndarray]:
+        return list(self._runs)
+
+    @property
+    def run_count(self) -> int:
+        return len(self._runs)
+
+    @property
+    def entries(self) -> int:
+        """Total stored fingerprints, overlap included."""
+        return int(sum(r.shape[0] for r in self._runs))
+
+    @property
+    def nbytes(self) -> int:
+        return self.entries * 8
+
+    def contains(self, fps) -> np.ndarray:
+        """Host-side membership of a uint64 fingerprint batch — the
+        reference implementation the device merge-join is pinned
+        against (tests/test_tiered.py), and small enough callers'
+        diagnostics can afford."""
+        fps = np.asarray(fps, dtype=np.uint64)
+        hit = np.zeros(fps.shape, dtype=bool)
+        for run in self._runs:
+            idx = np.searchsorted(run, fps)
+            in_range = idx < run.shape[0]
+            safe = np.minimum(idx, max(run.shape[0] - 1, 0))
+            if run.shape[0]:
+                hit |= in_range & (np.asarray(run)[safe] == fps)
+        return hit
+
+    # -- write surface --------------------------------------------------------
+
+    def add_run(self, fps: np.ndarray) -> None:
+        """Add one spill's fingerprints as a new immutable run (sorted
+        here; the caller's segment readback arrives in row-log order).
+        Empty spills are dropped.  Past ``max_runs`` the store merges
+        everything into one deduplicated run."""
+        fps = np.sort(np.asarray(fps, dtype=np.uint64))
+        if fps.shape[0] == 0:
+            return
+        self._append(fps)
+        if len(self._runs) > self.max_runs:
+            self.merge()
+
+    def merge(self) -> None:
+        """Compact every run into one sorted, deduplicated run."""
+        if not self._runs:
+            return
+        merged = np.unique(
+            np.concatenate([np.asarray(r) for r in self._runs])
+        )
+        self._drop_files()
+        self._runs = []
+        self._paths = []
+        self._append(merged)
+
+    def _append(self, fps: np.ndarray) -> None:
+        if self.spill_dir is not None:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._seq += 1
+            path = os.path.join(self.spill_dir, f"cold_run_{self._seq}.npy")
+            np.save(path, fps)
+            # Reopen memory-mapped: the RAM copy is released and probe
+            # windows fault in only the pages they touch.
+            self._runs.append(np.load(path, mmap_mode="r"))
+            self._paths.append(path)
+        else:
+            self._runs.append(fps)
+            self._paths.append(None)
+
+    def _drop_files(self) -> None:
+        # Unlinking while a memory map still references the file is fine
+        # on POSIX (the map keeps the inode alive); best effort elsewhere.
+        for path in self._paths:
+            if path is None:
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- snapshot round trip (the checkpoint.npz container) -------------------
+
+    def to_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """``(concatenated_fps, run_lengths)`` for embedding in the
+        engine's snapshot npz — runs stay distinct so a resume restores
+        the exact tier shape (and probe-pass accounting) it left."""
+        if not self._runs:
+            return (
+                np.zeros((0,), np.uint64), np.zeros((0,), np.int64),
+            )
+        return (
+            np.concatenate([np.asarray(r) for r in self._runs]),
+            np.asarray([r.shape[0] for r in self._runs], np.int64),
+        )
+
+    @classmethod
+    def from_arrays(
+        cls, fps: np.ndarray, lens: np.ndarray,
+        spill_dir: Optional[str] = None, max_runs: int = 8,
+    ) -> "ColdStore":
+        store = cls(spill_dir=spill_dir, max_runs=max_runs)
+        off = 0
+        for n in np.asarray(lens, np.int64):
+            n = int(n)
+            store._append(np.asarray(fps[off:off + n], np.uint64))
+            off += n
+        return store

@@ -1,0 +1,193 @@
+"""Direct unit tests for the hash set's geometry helpers
+(parallel/hashset.py): ``unique_buffer_size`` is THE compaction-buffer
+width every overflow criterion and byte model derives from, and
+``prededup`` / ``compact_valid`` / ``compact_valid_indices`` are the
+device stages the tiered engine's eviction-threshold math builds on —
+edge cases at ``dedup_factor=1`` and at full buffers were previously
+only covered through whole-engine goldens."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.parallel.hashset import (  # noqa: E402
+    compact_valid,
+    compact_valid_indices,
+    insert_batch,
+    make_hashset,
+    prededup,
+    unique_buffer_size,
+)
+
+
+# --- unique_buffer_size: the single width definition -------------------------
+
+
+def test_unique_buffer_size_dedup_factor_one_covers_whole_batch():
+    # dd=1 is the always-safe geometry: the buffer spans every lane, so
+    # the overflow criterion (n > size) can never fire.
+    for b in (1, 7, 1 << 10, 1 << 14, 1 << 17):
+        assert unique_buffer_size(b, 1) == b
+
+
+def test_unique_buffer_size_floor_and_division():
+    # Small batches: the min(B, 16K) floor wins over B/dd.
+    assert unique_buffer_size(1 << 10, 4) == 1 << 10
+    assert unique_buffer_size(1 << 14, 8) == 1 << 14
+    # Past the 16K floor the division takes over.
+    assert unique_buffer_size(1 << 17, 4) == 1 << 15
+    assert unique_buffer_size(1 << 17, 8) == 1 << 14
+    # Integer division truncates, never rounds up.
+    assert unique_buffer_size(100_000, 3) == 100_000 // 3
+
+
+def test_unique_buffer_size_monotone_in_dedup_factor():
+    b = 1 << 17
+    prev = b + 1
+    for dd in (1, 2, 4, 8, 16):
+        u = unique_buffer_size(b, dd)
+        assert u <= prev
+        prev = u
+
+
+# --- prededup ----------------------------------------------------------------
+
+
+def _keys(vals):
+    """uint64 test keys split into (hi, lo) planes."""
+    vals = np.asarray(vals, np.uint64)
+    return (
+        jnp.asarray((vals >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray(vals.astype(np.uint32)),
+    )
+
+
+def test_prededup_elects_lowest_lane_in_sorted_key_order():
+    hi, lo = _keys([30, 10, 30, 20, 10, 10, 40, 20])
+    active = jnp.ones((8,), jnp.bool_)
+    u_hi, u_lo, u_origin, u_active, overflow = prededup(hi, lo, active, 1)
+    n = int(jnp.sum(u_active))
+    assert n == 4 and not bool(overflow)
+    keys = (
+        np.asarray(u_hi[:n]).astype(np.uint64) << np.uint64(32)
+    ) | np.asarray(u_lo[:n]).astype(np.uint64)
+    assert keys.tolist() == [10, 20, 30, 40]  # sorted key order
+    # The representative is the LOWEST original lane of each run — the
+    # first-inserter ebits semantics depend on it.
+    assert np.asarray(u_origin[:n]).tolist() == [1, 3, 0, 6]
+
+
+def test_prededup_full_buffer_all_distinct_dd1_no_overflow():
+    # dd=1, every lane active and distinct: the buffer is exactly full —
+    # the boundary the overflow comparison (> not >=) must not trip.
+    b = 64
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    u_hi, u_lo, u_origin, u_active, overflow = prededup(
+        hi, lo, jnp.ones((b,), jnp.bool_), 1
+    )
+    assert not bool(overflow)
+    assert int(jnp.sum(u_active)) == b
+    assert np.asarray(u_origin).tolist() == list(range(b))
+
+
+def test_prededup_overflow_fires_past_buffer():
+    # More distinct keys than the dd-shrunk buffer holds: loud flag.
+    # (The buffer floors at min(B, 16K), so B must exceed 16K lanes.)
+    b = 1 << 15
+    dd = 4
+    u = unique_buffer_size(b, dd)
+    assert u < b
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    *_rest, overflow = prededup(hi, lo, jnp.ones((b,), jnp.bool_), dd)
+    assert bool(overflow)
+
+
+def test_prededup_inactive_lanes_do_not_count():
+    hi, lo = _keys([5, 6, 7, 8])
+    active = jnp.asarray([True, False, True, False])
+    u_hi, u_lo, u_origin, u_active, overflow = prededup(hi, lo, active, 1)
+    assert int(jnp.sum(u_active)) == 2
+    assert not bool(overflow)
+
+
+# --- compact_valid / compact_valid_indices -----------------------------------
+
+
+def test_compact_valid_identity_at_dd1_full_valid():
+    # Every lane valid at dd=1: compaction is the identity permutation
+    # and the VALID-lane overflow criterion sits exactly at the boundary.
+    b = 128
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    valid = jnp.ones((b,), jnp.bool_)
+    v_hi, v_lo, v_orig, v_act, overflow = compact_valid(hi, lo, valid, 1)
+    assert not bool(overflow)
+    assert int(jnp.sum(v_act)) == b
+    assert np.asarray(v_orig).tolist() == list(range(b))
+    assert np.array_equal(np.asarray(v_hi), np.asarray(hi))
+
+
+def test_compact_valid_overflow_on_valid_count():
+    # The criterion counts VALID lanes (stricter than distinct keys): a
+    # duplicate-heavy batch must still trip it when valid > buffer.
+    b = 1 << 15
+    dd = 4
+    vals = np.ones((b,), np.uint64)  # ONE distinct key, all lanes valid
+    hi, lo = _keys(vals)
+    *_rest, overflow = compact_valid(hi, lo, jnp.ones((b,), jnp.bool_), dd)
+    assert bool(overflow)
+
+
+def test_compact_valid_indices_matches_compact_valid():
+    # The index-only variant (two-phase engines) must pick the same
+    # lanes in the same order as the key-compacting one.
+    rng = np.random.default_rng(7)
+    b = 256
+    vals = rng.integers(1, 1 << 40, size=b, dtype=np.uint64)
+    valid_np = rng.random(b) < 0.3
+    hi, lo = _keys(vals)
+    valid = jnp.asarray(valid_np)
+    v_hi, v_lo, v_orig, v_act, ovf = compact_valid(hi, lo, valid, 4)
+    i_orig, i_act, n_valid, i_ovf = compact_valid_indices(valid, 4)
+    assert bool(ovf) == bool(i_ovf) is False
+    assert int(n_valid) == int(valid_np.sum())
+    n = int(n_valid)
+    assert np.array_equal(np.asarray(v_orig)[:n], np.asarray(i_orig)[:n])
+    assert np.array_equal(np.asarray(v_act), np.asarray(i_act))
+    # And the gathered keys really are the valid lanes' keys, in order.
+    assert np.asarray(v_hi)[:n].tolist() == [
+        int(v >> np.uint64(32)) for v in vals[valid_np]
+    ]
+
+
+def test_compact_valid_zero_valid_lanes():
+    b = 64
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    v_hi, v_lo, v_orig, v_act, overflow = compact_valid(
+        hi, lo, jnp.zeros((b,), jnp.bool_), 1
+    )
+    assert not bool(overflow)
+    assert int(jnp.sum(v_act)) == 0
+
+
+# --- load_factor: the cheap occupancy readback -------------------------------
+
+
+def test_load_factor_readback():
+    t = make_hashset(1 << 10)
+    assert t.load_factor() == 0.0
+    vals = np.arange(1, 129, dtype=np.uint64)
+    hi, lo = _keys(vals)
+    t, _slot, is_new, probe_ok, _ovf = insert_batch(
+        t, hi, lo, jnp.ones((128,), jnp.bool_), dedup_factor=1
+    )
+    assert bool(probe_ok)
+    assert int(jnp.sum(is_new)) == 128
+    assert t.load_factor() == pytest.approx(128 / 1024)
+    # Re-inserting the same keys adds no occupancy.
+    t, _slot, is_new, probe_ok, _ovf = insert_batch(
+        t, hi, lo, jnp.ones((128,), jnp.bool_), dedup_factor=1
+    )
+    assert int(jnp.sum(is_new)) == 0
+    assert t.load_factor() == pytest.approx(128 / 1024)

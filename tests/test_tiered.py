@@ -1,0 +1,467 @@
+"""The tiered out-of-core engine (stateright_tpu/tiered/): ISSUE-9's
+acceptance matrix — a workload exceeding the hot tier's capacity (forced
+via a small budget) completes exactly, ``discovered_fingerprints()``
+bit-identical to the in-HBM engine, including after a kill-mid-run
+supervised resume; plus the cold store, the budget→capacity mapping, the
+device merge-join, and the serve/CLI wiring."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from stateright_tpu.runtime.journal import read_journal  # noqa: E402
+from stateright_tpu.tiered import (  # noqa: E402
+    ColdStore,
+    capacity_for_budget,
+)
+
+
+def _tiered(model, **kwargs):
+    kwargs.setdefault("capacity", 512)
+    kwargs.setdefault("max_frontier", 1 << 6)
+    return model.checker().spawn_tpu_tiered(**kwargs)
+
+
+def _plain(model, **kwargs):
+    kwargs.setdefault("capacity", 1 << 14)
+    kwargs.setdefault("max_frontier", 1 << 6)
+    return model.checker().spawn_tpu(**kwargs)
+
+
+# --- cold store --------------------------------------------------------------
+
+
+def test_cold_store_runs_merge_and_membership(tmp_path):
+    s = ColdStore(max_runs=2)
+    s.add_run(np.asarray([5, 1, 9], np.uint64))
+    s.add_run(np.asarray([2, 9], np.uint64))  # overlap allowed
+    assert s.run_count == 2
+    assert s.entries == 5
+    assert s.contains([1, 2, 3, 9]).tolist() == [True, True, False, True]
+    # A third run crosses max_runs and triggers the LSM merge: one
+    # deduplicated sorted run, same membership.
+    s.add_run(np.asarray([3], np.uint64))
+    assert s.run_count == 1
+    assert s.entries == 5  # 1 2 3 5 9
+    assert s.contains([1, 2, 3, 4, 5, 9]).tolist() == [
+        True, True, True, False, True, True,
+    ]
+    # Empty spills are dropped.
+    s.add_run(np.zeros((0,), np.uint64))
+    assert s.run_count == 1
+
+    # Snapshot round trip preserves the run structure.
+    fps, lens = s.to_arrays()
+    back = ColdStore.from_arrays(fps, lens)
+    assert back.run_count == s.run_count and back.entries == s.entries
+    assert back.contains([3, 4]).tolist() == [True, False]
+
+
+def test_cold_store_disk_tier(tmp_path):
+    d = str(tmp_path / "cold")
+    s = ColdStore(spill_dir=d, max_runs=2)
+    s.add_run(np.asarray([4, 2], np.uint64))
+    s.add_run(np.asarray([8, 6], np.uint64))
+    files = sorted(os.listdir(d))
+    assert len(files) == 2 and all(f.endswith(".npy") for f in files)
+    # Runs come back memory-mapped, sorted, and queryable.
+    assert isinstance(s.runs[0], np.memmap)
+    assert s.contains([2, 4, 6, 8, 10]).tolist() == [
+        True, True, True, True, False,
+    ]
+    s.add_run(np.asarray([1], np.uint64))  # merge rewrites the disk set
+    assert s.run_count == 1
+    assert s.contains([1, 2, 4, 6, 8]).all()
+
+
+def test_capacity_for_budget():
+    # 12 B/slot (key planes + transient claim plane), power of two.
+    assert capacity_for_budget(1.0) == 1 << 16
+    assert capacity_for_budget(16) == 1 << 20
+    assert capacity_for_budget(0.005) == 256  # the CI forcing budget
+    for bad in (0, -1, 1e-9, float("nan"), float("inf")):
+        # Sub-floor budgets refuse loudly (a silent round-up to the
+        # minimum table would exceed the documented hard cap).
+        with pytest.raises(ValueError):
+            capacity_for_budget(bad)
+
+
+# --- the acceptance pin: budget-constrained == unconstrained -----------------
+
+
+def test_tiered_bit_identical_with_forced_evictions(tmp_path):
+    """2pc(4)'s 1568 uniques against a 512-slot hot tier: multiple
+    forced evictions, cold probes on device, and a discovery set
+    bit-identical to the in-HBM engine."""
+    journal = str(tmp_path / "tiered.jsonl")
+    ref = _plain(TwoPhaseSys(rm_count=4)).join()
+    t = _tiered(TwoPhaseSys(rm_count=4), journal=journal).join()
+
+    assert t.unique_state_count() == ref.unique_state_count() == 1568
+    assert t.state_count() == ref.state_count()
+    assert t.max_depth() == ref.max_depth()
+    assert sorted(t.discoveries()) == sorted(ref.discoveries())
+    assert np.array_equal(
+        t.discovered_fingerprints(), ref.discovered_fingerprints()
+    )
+
+    events = read_journal(journal)
+    spills = [e for e in events if e["event"] == "spill"]
+    probes = [e for e in events if e["event"] == "cold_probe"]
+    assert len(spills) >= 2, "the budget did not force evictions"
+    assert all(
+        e["entries"] >= 0 and e["bytes"] == e["entries"] * 8
+        for e in spills
+    )
+    assert probes, "no cold passes were journaled"
+    assert all(e["passes"] >= 1 and e["bytes"] > 0 for e in probes)
+    # The cold tier really answered duplicates (hits), and every spill's
+    # watermark advanced monotonically.
+    assert sum(e["hits"] for e in probes) > 0
+    ends = [e["end"] for e in spills]
+    assert ends == sorted(ends)
+
+    m = t.metrics()
+    assert m["engine"] == "tpu-tiered"
+    assert m["spills"] == len(spills)
+    assert m["cold_entries"] > 0 and m["cold_runs"] >= 1
+    assert m["cold_probe_bytes_total"] == sum(e["bytes"] for e in probes)
+    assert 0.0 <= m["table_load_factor"] <= 0.5
+
+
+def test_memory_budget_knob_derives_capacity():
+    """The user-facing knob: a small budget derives a tiny hot table,
+    forces evictions, and still lands the golden."""
+    t = TwoPhaseSys(rm_count=3).checker().spawn_tpu_tiered(
+        memory_budget_mb=0.005, max_frontier=1 << 6,
+    ).join()
+    assert t.unique_state_count() == 288
+    m = t.metrics()
+    assert m["capacity"] == capacity_for_budget(0.005) == 256
+    assert m["memory_budget_mb"] == 0.005
+    assert m["spills"] >= 1
+    # The budget is AUTHORITATIVE: a capacity riding along in merged
+    # kwargs (workload-spec defaults, warm-started cache entries) must
+    # not silently un-tier a budgeted run.
+    t2 = TwoPhaseSys(rm_count=3).checker().spawn_tpu_tiered(
+        memory_budget_mb=64, capacity=512, max_frontier=1 << 6,
+    ).join()
+    assert t2.metrics()["capacity"] == capacity_for_budget(64)
+
+
+def test_tiered_ebits_and_violations_match():
+    """A violating workload (trap counter: always- and sometimes-
+    properties) discovered identically through the tiers."""
+    from stateright_tpu.models.fixtures import TrapCounter
+
+    ref = TrapCounter(50).checker().spawn_tpu(capacity=1 << 12).join()
+    # capacity 64: the ~50-state chain spills at the 0.45 threshold.
+    t = TrapCounter(50).checker().spawn_tpu_tiered(
+        capacity=64, max_frontier=1 << 6
+    ).join()
+    assert sorted(t.discoveries()) == sorted(ref.discoveries())
+    for name, path in ref.discoveries().items():
+        assert t.discoveries()[name].into_actions() == path.into_actions()
+    assert t.metrics()["spills"] >= 1
+
+
+def test_tiered_symmetry_canonical_keys_through_tiers():
+    """Symmetry reduction dedups on canonical fingerprints; spills must
+    evict the same canonical keys (2pc rm=4 orbit golden 166)."""
+    ref = (
+        TwoPhaseSys(rm_count=4).checker().symmetry()
+        .spawn_tpu(capacity=1 << 14, max_frontier=1 << 6).join()
+    )
+    t = (
+        TwoPhaseSys(rm_count=4).checker().symmetry()
+        .spawn_tpu_tiered(capacity=256, max_frontier=1 << 6).join()
+    )
+    assert t.unique_state_count() == ref.unique_state_count() == 166
+    assert t.metrics()["spills"] >= 1
+    assert np.array_equal(
+        t.discovered_fingerprints(), ref.discovered_fingerprints()
+    )
+
+
+# --- snapshot / resume -------------------------------------------------------
+
+
+def test_tiered_snapshot_resume_mid_search(tmp_path):
+    full = _tiered(TwoPhaseSys(rm_count=4)).join()
+    bounded = (
+        TwoPhaseSys(rm_count=4).checker().target_state_count(900)
+        .spawn_tpu_tiered(capacity=512, max_frontier=1 << 6).join()
+    )
+    assert 0 < bounded.unique_state_count() < 1568
+    assert bounded.metrics()["cold_runs"] >= 1, (
+        "the bounded run should already have spilled"
+    )
+    snap = str(tmp_path / "tiered.npz")
+    bounded.save_snapshot(snap)
+
+    resumed = _tiered(
+        TwoPhaseSys(rm_count=4), resume_from=snap,
+    ).join()
+    assert resumed.unique_state_count() == 1568
+    assert resumed.state_count() == full.state_count()
+    assert resumed.max_depth() == full.max_depth()
+    assert sorted(resumed.discoveries()) == sorted(full.discoveries())
+    assert np.array_equal(
+        resumed.discovered_fingerprints(), full.discovered_fingerprints()
+    )
+
+    # Resuming a COMPLETED run's snapshot (the supervisor's
+    # kill-after-final-checkpoint window) is a no-op: in particular the
+    # drained level must not roll and inflate max_depth.
+    done_snap = str(tmp_path / "done.npz")
+    resumed.save_snapshot(done_snap)
+    again = _tiered(
+        TwoPhaseSys(rm_count=4), resume_from=done_snap,
+    ).join()
+    assert again.unique_state_count() == 1568
+    assert again.max_depth() == full.max_depth()
+    assert again.state_count() == full.state_count()
+
+
+def test_tiered_and_plain_snapshots_do_not_cross(tmp_path):
+    t = _tiered(TwoPhaseSys(rm_count=3), capacity=256).join()
+    snap_t = str(tmp_path / "t.npz")
+    t.save_snapshot(snap_t)
+    with pytest.raises(ValueError):
+        _plain(TwoPhaseSys(rm_count=3), resume_from=snap_t).join()
+
+    p = _plain(TwoPhaseSys(rm_count=3)).join()
+    snap_p = str(tmp_path / "p.npz")
+    p.save_snapshot(snap_p)
+    with pytest.raises(ValueError, match="not written by the tiered"):
+        _tiered(TwoPhaseSys(rm_count=3), resume_from=snap_p).join()
+
+    # A resume whose budget disagrees with the snapshot's table must be
+    # loud: the budget promise and adopt-the-snapshot-geometry rule can
+    # only both hold when they agree.
+    with pytest.raises(ValueError, match="memory_budget_mb"):
+        TwoPhaseSys(rm_count=3).checker().spawn_tpu_tiered(
+            memory_budget_mb=64, max_frontier=1 << 6, resume_from=snap_t,
+        ).join()
+
+
+def test_tiered_supervised_kill_mid_run_resumes_identical(
+    tmp_path, monkeypatch
+):
+    """The acceptance criterion's resilience half: a supervised tiered
+    child dies the moment its first checkpoint (cold tier embedded)
+    lands, auto-resumes, and the final fingerprint set matches the
+    in-HBM engine's."""
+    from stateright_tpu.runtime import (
+        CheckSpec, RunSupervisor, SupervisorConfig,
+    )
+    from stateright_tpu.runtime.supervisor import journal_events
+
+    ref = _plain(TwoPhaseSys(rm_count=4)).join()
+    monkeypatch.setenv(
+        "STATERIGHT_RUNTIME_FAULT_EXIT_AFTER_CHECKPOINT", "137"
+    )
+    run_dir = str(tmp_path / "run")
+    result = RunSupervisor(
+        SupervisorConfig(
+            run_dir=run_dir,
+            checkpoint_every_waves=4,
+            checkpoint_every_sec=None,
+            call_deadline_sec=240.0,
+            poll_interval_sec=0.05,
+            max_restarts=2,
+        ),
+        spec=CheckSpec(
+            model_factory=TwoPhaseSys,
+            factory_kwargs={"rm_count": 4},
+            engine="tiered",
+            engine_kwargs={"capacity": 512, "max_frontier": 1 << 6},
+        ),
+    ).run()
+    monkeypatch.delenv("STATERIGHT_RUNTIME_FAULT_EXIT_AFTER_CHECKPOINT")
+
+    assert result["completed"]
+    assert result["unique_state_count"] == ref.unique_state_count()
+    assert result["state_count"] == ref.state_count()
+    assert result["max_depth"] == ref.max_depth()
+    assert result["discoveries"] == sorted(ref.discoveries())
+    kinds = [e["event"] for e in journal_events(run_dir)]
+    assert "crash" in kinds and "resume" in kinds
+    assert "spill" in kinds, "no eviction before/after the kill"
+    # The resumed child restored the cold tier, not just the hot table.
+    resume = next(
+        e for e in journal_events(run_dir) if e["event"] == "resume"
+    )
+    assert resume["unique"] > 0
+
+    # And the resumed run's final snapshot still matches the in-HBM
+    # engine bit for bit.
+    final = _tiered(
+        TwoPhaseSys(rm_count=4),
+        resume_from=os.path.join(run_dir, "checkpoint.npz"),
+    ).join()
+    assert np.array_equal(
+        final.discovered_fingerprints(), ref.discovered_fingerprints()
+    )
+
+
+def test_abort_cleanup_erases_uncommitted_table_keys():
+    """A keep-partial (stop/deadline) break landing on a flagged wave
+    must not persist the aborted insert's table keys — a resume would
+    treat that wave's states as already visited and drop their
+    subtrees.  The cleanup hook rebuilds the table from the committed
+    log segment, erasing anything else."""
+    from stateright_tpu.parallel.hashset import HashSet, insert_batch
+
+    ck = _tiered(TwoPhaseSys(rm_count=3), capacity=256).join()
+    cd = ck._carry_dev
+    kh = jnp.asarray(np.asarray(cd["key_hi"]))
+    kl = jnp.asarray(np.asarray(cd["key_lo"]))
+    # Scribble a bogus (uncommitted) key, as an aborted insert would.
+    t2, _slot, is_new, ok, _ovf = insert_batch(
+        HashSet(kh, kl),
+        jnp.asarray(np.array([0xDEAD], np.uint32)),
+        jnp.asarray(np.array([0xBEEF], np.uint32)),
+        jnp.ones((1,), jnp.bool_),
+        dedup_factor=1,
+    )
+    assert bool(ok) and bool(np.asarray(is_new).any())
+    polluted = t2.load_factor()
+    carry = (
+        t2.key_hi, t2.key_lo,
+        jnp.asarray(np.asarray(cd["rows"])),
+        jnp.asarray(np.asarray(cd["parent"])),
+        jnp.asarray(np.asarray(cd["ebits"])),
+    )
+    cleaned = ck._wl_abort_cleanup(carry)
+    lf = HashSet(cleaned[0], cleaned[1]).load_factor()
+    assert lf < polluted
+    # Exactly the committed-segment population, nothing else.
+    assert lf == (ck._t_tail - ck._spill_tail) / ck._capacity
+
+
+# --- device merge-join unit --------------------------------------------------
+
+
+def test_cold_probe_binary_search_matches_host(tmp_path):
+    """The vmapped lower-bound search (the cold filter's core) pinned
+    against numpy membership on adversarial data: duplicates, all-miss,
+    all-hit, boundary keys, and sentinel padding."""
+    t = _tiered(TwoPhaseSys(rm_count=3), capacity=256).join()
+    tp = t._tiered_programs()
+    chunk = t._cold_chunk
+    rng = np.random.default_rng(3)
+    run = np.unique(rng.integers(1, 1 << 48, size=chunk, dtype=np.uint64))
+    seg = np.concatenate([
+        run,
+        np.full(chunk - run.shape[0], np.uint64(0xFFFFFFFFFFFFFFFF)),
+    ])
+    queries = np.concatenate([
+        rng.choice(run, 40),  # guaranteed hits
+        rng.integers(1, 1 << 48, size=50, dtype=np.uint64),  # mostly miss
+        run[:1], run[-1:],  # exact boundaries
+        np.asarray([0xFFFFFFFFFFFFFFFE], np.uint64),  # near-sentinel
+    ]).astype(np.uint64)
+    q = np.zeros(1 << 14, np.uint64)  # pad to a plausible U width
+    q[: queries.shape[0]] = queries
+    found = tp["probe"](
+        jnp.zeros(q.shape, jnp.bool_),
+        jnp.asarray((q >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray(q.astype(np.uint32)),
+        jnp.asarray((seg >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray(seg.astype(np.uint32)),
+    )
+    want = np.isin(q, run)
+    assert np.array_equal(np.asarray(found), want)
+
+
+# --- spawn validation & serve wiring -----------------------------------------
+
+
+def test_tiered_spawn_validation():
+    m = TwoPhaseSys(rm_count=3)
+    with pytest.raises(ValueError, match="trace"):
+        m.checker().spawn_tpu_tiered(capacity=256, trace=True)
+    with pytest.raises(ValueError, match="visitor"):
+        m.checker().visitor(lambda *a: True).spawn_tpu_tiered(capacity=256)
+    with pytest.raises(ValueError, match="spill_threshold"):
+        m.checker().spawn_tpu_tiered(capacity=256, spill_threshold=0.9)
+    with pytest.raises(ValueError, match="cold_chunk"):
+        m.checker().spawn_tpu_tiered(capacity=256, cold_chunk=100)
+    with pytest.raises(ValueError, match="memory_budget_mb"):
+        m.checker().spawn_tpu_tiered(memory_budget_mb=-1)
+
+
+def test_tiered_cli_flags(capsys):
+    """`check-tpu --tiered --memory-budget-mb` end to end in-process,
+    plus the flag-combination refusals."""
+    from stateright_tpu.cli import example_main
+    from stateright_tpu.models.twophase import cli_spec
+
+    rc = example_main(
+        cli_spec(),
+        ["check-tpu", "3", "--tiered", "--memory-budget-mb", "0.005"],
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unique=288" in out
+    for bad in (
+        ["check-tpu", "3", "--tiered", "--sharded"],
+        ["check-tpu", "3", "--tiered", "--trace"],
+        ["check", "3", "--tiered"],
+        ["check-tpu", "3", "--memory-budget-mb", "nope"],
+        ["check-tpu", "3", "--memory-budget-mb", "-2"],
+        ["check-tpu", "3", "--memory-budget-mb", "nan"],
+        ["check-tpu", "3", "--memory-budget-mb", "inf"],
+    ):
+        assert example_main(cli_spec(), bad) == 2, bad
+
+
+def test_tiered_serve_job_and_knob_cache(tmp_path):
+    """A tiered service job completes, reports its engine, and persists
+    its budget-pinned geometry under the TIERED_ENGINE tag so a repeat
+    warm-starts without shadowing in-HBM entries."""
+    from stateright_tpu.runtime.knob_cache import (
+        TIERED_ENGINE, knob_key, load_knobs,
+    )
+    from stateright_tpu.serve import CheckService
+    from stateright_tpu.serve.workloads import workload_label
+
+    knobs = str(tmp_path / "knobs")
+    svc = CheckService(journal=None, knob_cache_dir=knobs)
+    try:
+        # The normal tiered job shape: a budget in engine_kwargs.  The
+        # budget must NOT count as hand-tuned geometry, or the cache
+        # store (and with it the warm start) would be unreachable for
+        # exactly the jobs the TIERED_ENGINE tag exists for.
+        spec = {
+            "workload": "twophase", "n": 3, "engine": "tiered",
+            "engine_kwargs": {"memory_budget_mb": 0.005},
+        }
+        job = svc.submit(dict(spec))
+        assert job.wait(timeout=240)
+        assert job.state == "done", (job.state, job.error)
+        assert job.result["unique_state_count"] == 288
+        assert job.result["engine"] == "tiered"
+        # The tiered label is budget-keyed: one budget's pinned table
+        # must never warm-start the same workload at another budget.
+        key = knob_key(
+            workload_label("twophase", 3, None, False) + ":mb=0.005",
+            engine=TIERED_ENGINE,
+        )
+        stored = load_knobs(knobs, key)
+        assert stored is not None and "capacity" in stored
+        assert stored["capacity"] == capacity_for_budget(0.005)
+
+        warm = svc.submit(dict(spec))
+        assert warm.wait(timeout=240)
+        assert warm.state == "done", (warm.state, warm.error)
+        assert warm.result["knob_cache_hit"]
+        assert warm.result["unique_state_count"] == 288
+    finally:
+        svc.scheduler.shutdown()
