@@ -795,12 +795,9 @@ def phase_trace(record: dict, tuned: dict) -> None:
     # The bottleneck names a DEVICE phase: the host-side readback is the
     # trace instrumentation's own documented cost, not an engine phase,
     # and on a tunneled device it can dominate the per-wave wall time.
-    from stateright_tpu.obs.trace import HOST_PHASES
-
-    device_phases = {
-        k: v for k, v in s["wave_breakdown"].items() if k not in HOST_PHASES
-    }
-    record["bottleneck_phase"] = max(device_phases, key=device_phases.get)
+    # The tracer computes it (one definition shared with the CLI's
+    # `trace:` line, obs/trace.py).
+    record["bottleneck_phase"] = s["bottleneck_phase"]
     log(
         f"trace: paxos3 breakdown {s['wave_breakdown_frac']} "
         f"hbm_util={s['hbm_util_frac']} "
@@ -1168,12 +1165,43 @@ def phase_headline(record: dict, threads: int) -> dict:
     return tuned
 
 
+def phase_trajectory(record: dict) -> None:
+    """Cross-round trajectory: render the BENCH_r*.json history (the
+    rounds the driver has committed so far) into
+    docs/BENCH_TRAJECTORY.md via obs/report.py — closing the "perf
+    trajectory lives in seven disconnected artifacts" gap — and fold
+    the regression verdict into this round's record.  Host-only and
+    milliseconds; a flagged regression is a loud record key, not a
+    failure (the HEADLINE golden gates correctness; this gauges
+    trend)."""
+    import glob as _glob
+
+    from stateright_tpu.obs.report import (
+        bench_trajectory, render_trajectory_markdown,
+    )
+
+    rounds = sorted(_glob.glob(str(_REPO / "BENCH_r*.json")))
+    if not rounds:
+        record["trajectory_skipped"] = "no BENCH_r*.json rounds present"
+        return
+    traj = bench_trajectory(rounds)
+    out = _REPO / "docs" / "BENCH_TRAJECTORY.md"
+    out.write_text(render_trajectory_markdown(traj), encoding="utf-8")
+    record["trajectory_rounds"] = len(traj["rounds"])
+    record["trajectory_regressions"] = traj["regressions"]
+    log(
+        f"trajectory: {len(traj['rounds'])} rounds -> {out}; "
+        f"{len(traj['regressions'])} regression(s) flagged"
+    )
+
+
 # Every optional phase, in run order.  Named up front so ANY early exit
 # can mark the not-yet-run tail as skipped in the artifact — a partial
 # BENCH json must say what is missing, not just stop (the r02/r04 rc=1
 # and r05 rc=124 modes all produced artifacts that undercounted what
 # was skipped).
 OPTIONAL_PHASES = (
+    "trajectory",
     "denominator_native",
     "serving",
     "tiered",
@@ -1236,8 +1264,9 @@ def main() -> None:
     # although each now runs in its own subprocess, keeping the parent's
     # device use front-loaded is free insurance.
     impls = {
-        # denominator_native is host-only C++ (no device risk) and cheap
-        # at its gate size; trace reuses the headline's tuned sizes.
+        # trajectory and denominator_native are host-only (no device
+        # risk) and cheap; trace reuses the headline's tuned sizes.
+        "trajectory": phase_trajectory,
         "denominator_native": phase_denominator_native,
         "serving": phase_serving,
         "tiered": phase_tiered,

@@ -45,8 +45,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--tiered] [--memory-budget-mb MB]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
-        "  serve [ADDRESS] [--journal PATH] [--knob-cache DIR]"
-        " [--workers N]"
+        "  serve [ADDRESS] [--journal PATH] [--journal-max-mb MB]"
+        " [--knob-cache DIR] [--workers N]"
     )
     lines.append(
         f"  submit [{n_meta}]{net} [--address ADDR] [--engine ENGINE]"
@@ -54,6 +54,10 @@ def _usage(name: str, spec: "CliSpec") -> str:
         " [--no-wait]"
     )
     lines.append("  status [JOB_ID] [--address ADDR]")
+    lines.append(
+        "  report <journal.jsonl | BENCH-glob | dir> [--json]"
+        " [--out FILE] [--threshold FRAC]"
+    )
     if spec.spawn is not None:
         lines.append(
             "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
@@ -918,6 +922,14 @@ def example_main(spec: CliSpec, argv=None) -> int:
 
     if sub == "status":
         return _run_status(spec, args)
+
+    if sub == "report":
+        # Journal analytics / bench trajectory (obs/report.py,
+        # docs/OBSERVABILITY.md "Run reports"): model-agnostic, rides on
+        # every model CLI like `serve` does.
+        from .obs.report import report_main
+
+        return report_main(args)
 
     print(_usage(spec.name, spec))
     return 2

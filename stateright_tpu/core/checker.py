@@ -244,16 +244,32 @@ class Checker:
     def metrics(self) -> dict:
         """Live observability snapshot — counts every engine has; the
         device engines extend it with their registry (wave cadence,
-        table occupancy, device-call time) and, under ``trace=True``,
-        the roofline trace summary.  Served by the Explorer's
-        ``GET /.metrics`` (docs/OBSERVABILITY.md names the fields);
-        never blocks on a still-running checker."""
+        table occupancy, device-call time, always-on vitals histograms)
+        and, under ``trace=True``, the roofline trace summary.  Served
+        by the Explorer's ``GET /.metrics`` (docs/OBSERVABILITY.md names
+        the fields); never blocks on a still-running checker.
+
+        The keys emitted HERE are the guaranteed cross-engine schema
+        (pinned by tests/test_metrics_schema.py): every engine — host
+        graph, simulation, and all device engines — reports them with
+        these types.  ``table_load_factor`` is 0.0 for engines with no
+        device fingerprint table; the program-cache counters are the
+        process-global compiled-program cache
+        (parallel/wave_common.cached_program), included everywhere so
+        one scrape answers "is this process reusing compiles"."""
+        from ..obs.metrics import GLOBAL
+
         return {
             "engine": type(self).__name__,
             "done": self.is_done(),
             "state_count": self.state_count(),
             "unique_state_count": self.unique_state_count(),
             "max_depth": self.max_depth(),
+            "table_load_factor": 0.0,
+            "program_cache_hits": int(GLOBAL.get("program_cache_hits", 0)),
+            "program_cache_misses": int(
+                GLOBAL.get("program_cache_misses", 0)
+            ),
         }
 
     # --- shared functionality -----------------------------------------------

@@ -227,7 +227,7 @@ def serve_checker(checker, address, block: bool = True, snapshot=None):
             self._send(200, json.dumps(obj).encode(), "application/json")
 
         def do_GET(self) -> None:
-            url = self.path
+            url, _, querystr = self.path.partition("?")
             if url == "/":
                 url = "/index.htm"
             if url in ("/index.htm", "/app.js", "/app.css"):
@@ -247,10 +247,29 @@ def serve_checker(checker, address, block: bool = True, snapshot=None):
                 # The live observability surface beside /.status: the
                 # checker's metrics() snapshot (counts for every engine;
                 # the device engines add wave cadence, table occupancy,
-                # device-call totals, and — traced — the roofline
-                # summary).  Names: docs/OBSERVABILITY.md.
+                # device-call totals, the always-on vitals histograms,
+                # and — traced — the roofline summary).  JSON by
+                # default; ``?format=prometheus`` (or a scraper's
+                # Accept header) selects the standard text exposition
+                # (obs/prometheus.py).  Names: docs/OBSERVABILITY.md.
+                from urllib.parse import parse_qsl
+
+                from ..obs.prometheus import (
+                    CONTENT_TYPE, render_prometheus, wants_prometheus,
+                )
+
                 try:
-                    self._send_json(checker.metrics())
+                    query = dict(parse_qsl(querystr))
+                    m = checker.metrics()
+                    if wants_prometheus(
+                        query, self.headers.get("Accept")
+                    ):
+                        self._send(
+                            200, render_prometheus(m).encode(),
+                            CONTENT_TYPE,
+                        )
+                    else:
+                        self._send_json(m)
                 except Exception as e:
                     self._send(500, str(e).encode(), "text/plain")
             elif url.startswith("/.states"):

@@ -6,8 +6,16 @@ runtime journal, the Explorer's ``GET /.metrics`` endpoint, the CLI's
 ``check-tpu --trace``, and ``bench.py``:
 
 - :mod:`.metrics` — a thread-safe name->value registry every checker
-  carries; counters and gauges the host loop updates from the scalars it
+  carries; counters, gauges, and fixed-boundary histograms (with
+  p50/p95/p99 readback) the host loop updates from the scalars it
   already reads back (no extra device syncs with ``trace=False``).
+- :mod:`.prometheus` — the standard text exposition of any metrics
+  dict (``GET /.metrics?format=prometheus`` on the Explorer and the
+  checking service) plus a minimal validating parser for CI.
+- :mod:`.report` — journal-derived run/service reports (phase
+  breakdown, bottleneck_phase, throughput curve, restart timeline, job
+  spans) and the cross-round ``BENCH_r*.json`` trajectory with
+  regression flagging; backs the ``report`` CLI verb.
 - :mod:`.trace` — per-wave phase-timed trace spans: with ``trace=True``
   the engines run the wave loop in separately-dispatched phase programs
   (step kernel / canon+fingerprint / dedup-sort+probe / exchange /
@@ -19,7 +27,9 @@ runtime journal, the Explorer's ``GET /.metrics`` endpoint, the CLI's
 Schema and methodology: docs/OBSERVABILITY.md.
 """
 
-from .metrics import MetricsRegistry
+from .metrics import Histogram, MetricsRegistry
+from .prometheus import parse_prometheus, render_prometheus
+from .report import analyze_journal, bench_trajectory, render_markdown
 from .roofline import (
     DEVICE_PEAKS,
     hbm_util_frac,
@@ -31,10 +41,16 @@ from .trace import WaveTracer
 
 __all__ = [
     "DEVICE_PEAKS",
+    "Histogram",
     "MetricsRegistry",
     "WaveTracer",
+    "analyze_journal",
+    "bench_trajectory",
     "hbm_util_frac",
+    "parse_prometheus",
     "peaks_for_device",
     "probe_bytes",
+    "render_markdown",
+    "render_prometheus",
     "sort_bytes",
 ]

@@ -1,4 +1,4 @@
-"""A small thread-safe metrics registry.
+"""A small thread-safe metrics registry, with histograms.
 
 Every checker carries one (the engine host loop writes, the Explorer's
 ``GET /.metrics`` endpoint and ``Checker.metrics()`` read).  Deliberately
@@ -7,14 +7,94 @@ sits on the engine host loop: a wave record is a handful of dict stores,
 never a device sync.  Metric names are part of the observable surface and
 documented in docs/OBSERVABILITY.md; changing one is a breaking change to
 anything scraping ``/.metrics``.
+
+Histograms are fixed-boundary (Prometheus classic style: cumulative
+``le`` buckets plus ``sum``/``count``) so an observation is one bisect
+and one integer increment — cheap enough for the always-on fused-loop
+vitals — and the snapshot carries a p50/p95/p99 readback estimated by
+linear interpolation inside the owning bucket.  The snapshot shape
+(``boundaries``/``counts``/``sum``/``count``/``p50``/``p95``/``p99``)
+is what obs/prometheus.py renders as ``_bucket``/``_sum``/``_count``
+series.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Union
+from bisect import bisect_left
+from typing import Dict, Optional, Sequence, Union
 
 Number = Union[int, float]
+
+# Shared boundary ladders (seconds / counts).  Latency buckets span the
+# observed range of one fused device call — sub-millisecond on a local
+# CPU backend up to tens of seconds for a tunneled-device quantum.
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+# Waves-between-growth-events ladder (powers of two, like the geometry).
+COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class Histogram:
+    """Fixed-boundary cumulative histogram with quantile readback.
+
+    ``boundaries`` are the bucket upper bounds (ascending); one implicit
+    ``+Inf`` bucket catches the tail.  Not self-locking: the owning
+    :class:`MetricsRegistry` serializes access under its lock.
+    """
+
+    def __init__(self, boundaries: Sequence[float]):
+        b = tuple(float(x) for x in boundaries)
+        if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+            raise ValueError(
+                "histogram boundaries must be strictly ascending"
+            )
+        self.boundaries = b
+        self.counts = [0] * (len(b) + 1)  # last = +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: Number, count: int = 1) -> None:
+        """Fold ``count`` observations of ``value`` (the weighted form
+        lets the wave loop record one quantum as waves_per_call equal
+        per-wave latencies with a single call)."""
+        self.counts[bisect_left(self.boundaries, float(value))] += count
+        self.sum += float(value) * count
+        self.count += count
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0..1): find the bucket holding the
+        rank, interpolate linearly inside it (Prometheus
+        ``histogram_quantile`` semantics; the +Inf bucket reports its
+        lower bound)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        acc = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if acc + c >= rank:
+                lo = self.boundaries[i - 1] if i > 0 else 0.0
+                if i >= len(self.boundaries):
+                    return lo  # +Inf bucket: report its lower bound
+                hi = self.boundaries[i]
+                return lo + (hi - lo) * max(0.0, rank - acc) / c
+            acc += c
+        return self.boundaries[-1]
+
+    def snapshot(self) -> dict:
+        return {
+            "boundaries": list(self.boundaries),
+            "counts": list(self.counts),
+            "sum": round(self.sum, 6),
+            "count": self.count,
+            "p50": round(self.quantile(0.50), 6),
+            "p95": round(self.quantile(0.95), 6),
+            "p99": round(self.quantile(0.99), 6),
+        }
 
 
 class MetricsRegistry:
@@ -28,6 +108,7 @@ class MetricsRegistry:
     def __init__(self, **initial: Number):
         self._lock = threading.Lock()
         self._values: Dict[str, Number] = dict(initial)
+        self._hists: Dict[str, Histogram] = {}
 
     def inc(self, name: str, delta: Number = 1) -> None:
         with self._lock:
@@ -47,9 +128,37 @@ class MetricsRegistry:
         with self._lock:
             return self._values.get(name, default)
 
+    def observe(
+        self,
+        name: str,
+        value: Number,
+        count: int = 1,
+        boundaries: Sequence[float] = LATENCY_BUCKETS,
+    ) -> None:
+        """Record ``value`` into the named histogram, creating it with
+        ``boundaries`` on first use (later calls keep the original
+        boundaries — one ladder per name for the life of the
+        registry)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(boundaries)
+            h.observe(value, count)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
     def snapshot(self) -> Dict[str, Number]:
         with self._lock:
             return dict(self._values)
+
+    def snapshot_histograms(self) -> Dict[str, dict]:
+        """Plain-dict copies of every histogram (the ``histograms`` key
+        of ``Checker.metrics()``; obs/prometheus.py renders them as
+        ``_bucket``/``_sum``/``_count`` series)."""
+        with self._lock:
+            return {n: h.snapshot() for n, h in self._hists.items()}
 
 
 # Process-global registry for counters that outlive any one checker —

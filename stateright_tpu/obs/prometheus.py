@@ -1,0 +1,244 @@
+"""Prometheus text exposition (and a minimal validating parser).
+
+``render_prometheus`` turns a ``Checker.metrics()`` / service
+``metrics()`` dict into the classic text exposition format
+(version 0.0.4) so the Explorer and the checking service plug into
+standard scrapers — ``GET /.metrics?format=prometheus`` on both HTTP
+surfaces (explorer/server.py, serve/server.py).  Mapping rules, applied
+to each top-level key:
+
+- numeric (or bool) value -> one ``gauge`` sample, unless the name is a
+  known counter (the :data:`COUNTER_NAMES` set, or any ``*_total``
+  name) -> ``counter``;
+- string value -> a label on the single ``<prefix>_info`` gauge (value
+  1), the idiomatic place for build/engine identity;
+- histogram-shaped dict (the ``histograms`` key of ``metrics()``;
+  shape from ``obs.metrics.Histogram.snapshot``) -> a ``histogram``
+  family with cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+  ``_count`` (the estimated ``p50/p95/p99`` readbacks are dropped —
+  scrapers derive quantiles from the buckets);
+- flat all-numeric dict (e.g. the service's ``jobs`` state counts) ->
+  one gauge family with a ``key`` label per entry;
+- anything deeper (``trace_summary``, ``accounting``) is skipped: those
+  stay on the JSON surface, which remains the default.
+
+``parse_prometheus`` is the matching minimal parser — enough to
+*validate* an exposition (CI's serve smoke and tests/test_report.py use
+it; no external client library): it checks ``# TYPE`` declarations,
+parses every sample line, and verifies histogram families carry
+consistent cumulative ``_bucket``/``_sum``/``_count`` series.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+PREFIX = "stateright"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Monotone-over-a-run names that don't carry the _total suffix (most
+# were named before the exposition existed; renames would break the
+# documented JSON surface).
+COUNTER_NAMES = frozenset({
+    "waves", "device_calls", "grows", "overflow_retries", "spills",
+    "cold_hits_total", "bucket_retries", "state_count",
+    "unique_state_count", "program_cache_hits", "program_cache_misses",
+    "knob_cache_hits", "knob_cache_misses", "jobs_submitted",
+    "jobs_completed", "jobs_failed", "jobs_cancelled", "portfolio_wins",
+    "violations_found", "unique_states_total",
+})
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _sanitize(name: str) -> str:
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    if not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt(value) -> str:
+    v = float(value)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _is_histogram_snapshot(value) -> bool:
+    return (
+        isinstance(value, dict)
+        and {"boundaries", "counts", "sum", "count"} <= set(value)
+    )
+
+
+def _render_histogram(lines: List[str], name: str, snap: dict) -> None:
+    lines.append(f"# HELP {name} {name.rsplit('_', 1)[0]} distribution")
+    lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for bound, c in zip(snap["boundaries"], snap["counts"]):
+        cum += int(c)
+        lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {int(snap["count"])}')
+    lines.append(f"{name}_sum {_fmt(snap['sum'])}")
+    lines.append(f"{name}_count {int(snap['count'])}")
+
+
+def render_prometheus(metrics: dict, prefix: str = PREFIX) -> str:
+    """Render a metrics dict (see module docstring for the mapping) as
+    Prometheus exposition text.  Deterministic: keys render in sorted
+    order, so tests can pin the output."""
+    lines: List[str] = []
+    info: List[Tuple[str, str]] = []
+    for key in sorted(metrics):
+        value = metrics[key]
+        name = f"{prefix}_{_sanitize(key)}"
+        if key == "histograms" and isinstance(value, dict):
+            for hname in sorted(value):
+                if _is_histogram_snapshot(value[hname]):
+                    _render_histogram(
+                        lines, f"{prefix}_{_sanitize(hname)}", value[hname]
+                    )
+            continue
+        if isinstance(value, bool):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {int(value)}")
+        elif isinstance(value, (int, float)):
+            kind = (
+                "counter"
+                if key in COUNTER_NAMES or key.endswith("_total")
+                else "gauge"
+            )
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {_fmt(value)}")
+        elif isinstance(value, str):
+            info.append((_sanitize(key), value))
+        elif _is_histogram_snapshot(value):
+            _render_histogram(lines, name, value)
+        elif isinstance(value, dict) and value and all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in value.values()
+        ):
+            lines.append(f"# TYPE {name} gauge")
+            for k in sorted(value):
+                lines.append(
+                    f'{name}{{key="{_escape_label(k)}"}} {_fmt(value[k])}'
+                )
+        # deeper structures (trace_summary, accounting, ...) stay JSON-only
+    if info:
+        labels = ",".join(f'{k}="{_escape_label(v)}"' for k, v in info)
+        lines.append(f"# TYPE {prefix}_info gauge")
+        lines.append(f"{prefix}_info{{{labels}}} 1")
+    return "\n".join(lines) + "\n"
+
+
+def wants_prometheus(query: dict, accept: Optional[str]) -> bool:
+    """Content negotiation for ``GET /.metrics``: the explicit
+    ``?format=prometheus`` query wins; otherwise the Accept header's
+    media ranges are scanned IN PREFERENCE ORDER and the first
+    recognized one decides — a scraper's
+    ``application/openmetrics-text, text/plain;…`` selects the text
+    exposition, while a JSON client's common default
+    ``application/json, text/plain, */*`` keeps JSON even though
+    text/plain appears as a fallback.  JSON stays the default for
+    everything else."""
+    fmt = (query.get("format") or "").lower()
+    if fmt:
+        return fmt in ("prometheus", "openmetrics", "text")
+    for part in (accept or "").lower().split(","):
+        mt = part.split(";", 1)[0].strip()
+        if mt in ("application/openmetrics-text", "text/plain"):
+            return True
+        if mt in ("application/json", "*/*"):
+            return False
+    return False
+
+
+# --- minimal validating parser (CI smoke / tests; no new deps) ---------------
+
+
+class ExpositionError(ValueError):
+    pass
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Parse exposition text into ``{family: {"type": t, "samples":
+    [(name, labels, value), ...]}}``, validating as it goes: unknown
+    ``# TYPE``s, malformed sample lines, non-float values, and
+    inconsistent histogram families (non-cumulative buckets, missing
+    ``_sum``/``_count``, +Inf bucket != count) all raise
+    :class:`ExpositionError`."""
+    families: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                t = parts[3] if len(parts) > 3 else ""
+                if t not in ("counter", "gauge", "histogram", "summary",
+                             "untyped"):
+                    raise ExpositionError(f"unknown TYPE {t!r}: {line}")
+                types[parts[2]] = t
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ExpositionError(f"malformed sample line: {line!r}")
+        name, labelstr, valstr = m.groups()
+        labels = dict(_LABEL.findall(labelstr)) if labelstr else {}
+        try:
+            value = float(valstr.replace("+Inf", "inf"))
+        except ValueError:
+            raise ExpositionError(
+                f"non-numeric sample value in {line!r}"
+            ) from None
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base is not None and types.get(base) == "histogram":
+                family = base
+                break
+        fam = families.setdefault(
+            family, {"type": types.get(family, "untyped"), "samples": []}
+        )
+        fam["samples"].append((name, labels, value))
+    for family, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        buckets = [
+            (labels.get("le"), v)
+            for n, labels, v in fam["samples"] if n.endswith("_bucket")
+        ]
+        sums = [v for n, _, v in fam["samples"] if n.endswith("_sum")]
+        counts = [v for n, _, v in fam["samples"] if n.endswith("_count")]
+        if not buckets or len(sums) != 1 or len(counts) != 1:
+            raise ExpositionError(
+                f"histogram {family} missing _bucket/_sum/_count series"
+            )
+        values = [v for _, v in buckets]
+        if values != sorted(values):
+            raise ExpositionError(
+                f"histogram {family} buckets are not cumulative"
+            )
+        if buckets[-1][0] != "+Inf" or buckets[-1][1] != counts[0]:
+            raise ExpositionError(
+                f"histogram {family} +Inf bucket must equal _count"
+            )
+    return families

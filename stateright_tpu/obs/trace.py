@@ -152,6 +152,18 @@ class WaveTracer:
             "hbm_peak_estimated": self.peaks["estimated"],
             "device_kind": self.peaks["device_kind"],
         }
+        # The roofline verdict, named: the dominant DEVICE phase (host
+        # readback is the trace instrumentation's own cost, excluded
+        # like the HBM denominator above).  Part of the `trace:` line
+        # check-tpu --trace prints, so supervised children surface it
+        # without journal digging.
+        device_phases = {
+            n: s for n, s in phase_sec.items() if n not in _HOST_PHASES
+        }
+        if device_phases:
+            out["bottleneck_phase"] = max(
+                device_phases, key=device_phases.get
+            )
         out.update({
             k: round(v, 4) if isinstance(v, float) else v
             for k, v in extra.items()

@@ -1251,6 +1251,13 @@ class ShardedTpuChecker(Checker):
         )
         disc_h = np.asarray(disc).reshape(n, len(props))
         waves = 0
+        # Always-on vitals (latency histogram, uniq/s EMA, grow
+        # counters) — same registry keys as the fused loop's.
+        from .wave_loop import LoopVitals
+
+        vitals = LoopVitals(
+            self._metrics, initial_unique=self._unique_count
+        )
 
         while int((level_end - level_start).sum()) > 0:
             if target_depth and depth >= target_depth - 1:
@@ -1312,6 +1319,7 @@ class ShardedTpuChecker(Checker):
                 f = self._chunk  # dedup growth may halve it
                 bkt = self._bucket_lanes()
                 progs = self._traced_programs()
+                vitals.record_overflow_recovery()
                 continue
             (
                 key_hi, key_lo, r_slot, r_new, r_origin, probe_ok_d,
@@ -1435,6 +1443,10 @@ class ShardedTpuChecker(Checker):
             )
             self._metrics.inc("device_call_sec_total", t7 - t0)
             self._metrics.inc("device_calls", 1)
+            vitals.record_quantum(
+                t7 - t0, 1, self._unique_count, committed=True
+            )
+            vitals.record_host(phases["readback"])
 
             # Shared termination tail (wave_loop.py): finish_when /
             # target_state_count / deadline / cooperative cancel, the
@@ -2079,6 +2091,9 @@ class ShardedTpuChecker(Checker):
         # name everywhere (docs/OBSERVABILITY.md).
         out["table_load_factor"] = snap.get("table_occupancy", 0.0)
         out.update(snap)
+        hists = self._metrics.snapshot_histograms()
+        if hists:
+            out["histograms"] = hists
         if self._accounting:
             out["accounting"] = dict(self._accounting)
         if self._tracer is not None:

@@ -115,6 +115,121 @@ class CheckpointCadence:
         self._last = time.monotonic()
 
 
+class LoopVitals:
+    """Always-on fused-loop vitals (docs/OBSERVABILITY.md "Always-on
+    vitals"): cheap per-quantum counters and histograms recorded at the
+    host-side call boundary every engine loop already crosses — never an
+    extra device sync, so the trace=False device program stays
+    byte-for-byte pinned.  One instance per engine run; writes land in
+    the engine's :class:`~stateright_tpu.obs.metrics.MetricsRegistry`:
+
+    - ``wave_latency_sec`` histogram — per-wave wall latency (a fused
+      quantum of ``waves_per_call`` waves records its mean latency with
+      weight waves_per_call; traced loops record each wave exactly);
+    - ``waves_per_grow`` histogram — committed waves between
+      overflow-triggered recoveries (how long a geometry survived
+      before overflowing);
+    - ``uniq_per_sec_ema`` / ``waves_per_sec_ema`` gauges — exponential
+      moving averages over committed quanta (alpha 0.3: a few quanta of
+      memory, mid-run readable from ``/.metrics``);
+    - ``host_sec_total`` counter — host-side loop time: the fused loop
+      accounts the between-calls gap (journal/metrics/checkpoint/grow
+      dispatch); the traced loops report their measured ``readback``
+      phase via :meth:`record_host` instead.  Either way, the
+      time-in-host complement of the device time;
+    - ``overflow_retries`` counter — every overflow-flagged wave that
+      was recovered and re-run.  The separate ``grows`` counter
+      (:func:`log_grow`) counts only ACTUAL geometry changes: a
+      recovery that re-runs without growing (the tiered engine's
+      spill-instead-of-grow) moves ``overflow_retries`` but not
+      ``grows``.
+    """
+
+    EMA_ALPHA = 0.3
+
+    def __init__(self, registry, initial_unique: Optional[int] = None):
+        from ..obs.metrics import COUNT_BUCKETS, LATENCY_BUCKETS
+
+        self._reg = registry
+        self._latency_buckets = LATENCY_BUCKETS
+        self._count_buckets = COUNT_BUCKETS
+        self._uniq_ema: Optional[float] = None
+        self._wave_ema: Optional[float] = None
+        # Baseline for the first quantum's uniq/s delta: the unique
+        # count already committed before the loop starts (init seeding,
+        # or a resumed snapshot's count — which must not read as "found
+        # this call").  None = unknown; the first quantum then only
+        # primes the baseline.
+        self._last_unique = initial_unique
+        self._waves_since_grow = 0
+        self._host_mark: Optional[float] = None
+        self._reg.inc("host_sec_total", 0.0)  # key exists from wave 0
+
+    def call_started(self, now: float) -> None:
+        """Account the host-side gap since the previous call ended
+        (journal/metrics/checkpoint/grow work) as host time; the first
+        call has no gap yet."""
+        if self._host_mark is not None:
+            self._reg.inc(
+                "host_sec_total", max(0.0, now - self._host_mark)
+            )
+
+    def call_ended(self, now: float) -> None:
+        self._host_mark = now
+
+    def record_host(self, sec: float) -> None:
+        """Directly account host-side seconds — the traced loops' path:
+        their per-wave timers already isolate the host ``readback``
+        phase inside the wave, so they report it here instead of the
+        fused loop's between-calls gap."""
+        self._reg.inc("host_sec_total", max(0.0, sec))
+
+    def record_quantum(
+        self, call_sec: float, waves: int, unique: int, committed: bool,
+    ) -> None:
+        """Fold one device-call quantum into the vitals.  Aborted
+        (flagged) quanta count latency but not rates: their unique delta
+        is zero by construction and would drag the EMA to the floor."""
+        waves = max(1, int(waves))
+        self._reg.observe(
+            "wave_latency_sec", call_sec / waves, count=waves,
+            boundaries=self._latency_buckets,
+        )
+        if not committed:
+            return
+        self._waves_since_grow += waves
+        if call_sec > 0:
+            wave_rate = waves / call_sec
+            if self._last_unique is not None:
+                uniq_rate = max(0, unique - self._last_unique) / call_sec
+                self._uniq_ema = (
+                    uniq_rate if self._uniq_ema is None
+                    else self._uniq_ema
+                    + self.EMA_ALPHA * (uniq_rate - self._uniq_ema)
+                )
+            self._wave_ema = (
+                wave_rate if self._wave_ema is None
+                else self._wave_ema
+                + self.EMA_ALPHA * (wave_rate - self._wave_ema)
+            )
+            self._reg.update(
+                waves_per_sec_ema=round(self._wave_ema, 4),
+                **(
+                    {"uniq_per_sec_ema": round(self._uniq_ema, 2)}
+                    if self._uniq_ema is not None else {}
+                ),
+            )
+        self._last_unique = unique
+
+    def record_overflow_recovery(self) -> None:
+        self._reg.inc("overflow_retries", 1)
+        self._reg.observe(
+            "waves_per_grow", max(1, self._waves_since_grow),
+            boundaries=self._count_buckets,
+        )
+        self._waves_since_grow = 0
+
+
 class WaveView(NamedTuple):
     """The host-visible summary of one fused program call, decoded from
     the engine's stats readback — everything the shared loop needs to
@@ -195,12 +310,22 @@ class FusedWaveLoop:
     def run(self, carry, deadline=None):
         eng = self.eng
         cadence = CheckpointCadence(eng._ckpt_every_waves, eng._ckpt_every_sec)
+        vitals = LoopVitals(
+            eng._metrics, initial_unique=getattr(eng, "_unique_count", None)
+        )
         waves_total = 0
         while True:
             t_call = time.monotonic()
+            vitals.call_started(t_call)
             carry = eng._wl_call(carry)
             view = eng._wl_view(carry)
-            call_sec = time.monotonic() - t_call
+            t_done = time.monotonic()
+            call_sec = t_done - t_call
+            vitals.call_ended(t_done)
+            vitals.record_quantum(
+                call_sec, view.waves_this_call, view.unique,
+                committed=view.flags == 0,
+            )
             waves_total += view.waves_this_call
             with eng._lock:
                 eng._state_count = view.states
@@ -277,6 +402,7 @@ class FusedWaveLoop:
                 grown = eng._wl_grow(view.flags, carry)
                 if grown is None:
                     raise RuntimeError(eng._wl_overflow_message(view.flags))
+                vitals.record_overflow_recovery()
                 carry = grown
                 continue
             if loop_should_break(eng, view.remaining, view.depth, deadline):
@@ -329,9 +455,13 @@ def fingerprints_of_rows(cm, rows_np):
 
 
 def log_grow(eng, flags: int, grown: str, unique: int, depth: int) -> None:
-    """Shared grow-event surfacing: a warning log line + a journaled
-    ``grow`` record, identical on both engines so supervisors and tests
-    read one schema."""
+    """Shared grow-event surfacing: a warning log line, a journaled
+    ``grow`` record, and the ``grows`` metric — identical on both
+    engines so supervisors, scrapers, and tests read one schema.  Only
+    ACTUAL geometry changes come through here; overflow recoveries that
+    re-run without growing (the tiered engine's spill-instead-of-grow)
+    count in ``overflow_retries`` alone (:class:`LoopVitals`)."""
+    eng._metrics.inc("grows", 1)
     logging.getLogger(eng.__class__.__module__).warning(
         "auto-tune: overflow flags=%d; growing in place (%s) at "
         "unique=%d depth=%d",

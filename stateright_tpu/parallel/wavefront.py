@@ -1424,6 +1424,14 @@ class TpuChecker(Checker):
                 self._state_count = n_init
                 self._unique_count = int(stats_h[STAT_UNIQUE])
 
+            # Always-on vitals (latency histogram, uniq/s EMA, grow
+            # counters) — same registry keys as the fused loop's, so
+            # /.metrics readers see one schema in either mode.
+            from .wave_loop import LoopVitals
+
+            vitals = LoopVitals(
+                self._metrics, initial_unique=self._unique_count
+            )
             wave_idx = 0
             while level_start < level_end:
                 if target_depth and depth >= target_depth - 1:
@@ -1532,6 +1540,7 @@ class TpuChecker(Checker):
                     cap = self._capacity
                     f = self._max_frontier  # dd growth may halve it
                     progs = self._traced_programs()
+                    vitals.record_overflow_recovery()
                     continue
                 rows, parent, ebits = progs["append"](
                     rows, parent, ebits, cand_rows, cand_src, eb, u_new,
@@ -1588,6 +1597,10 @@ class TpuChecker(Checker):
                 )
                 self._metrics.inc("device_call_sec_total", t5 - t0)
                 self._metrics.inc("device_calls", 1)
+                vitals.record_quantum(
+                    t5 - t0, 1, self._unique_count, committed=True
+                )
+                vitals.record_host(phases["readback"])
 
                 # Shared termination tail (wave_loop.py): the same
                 # predicate order as the fused loop by construction.
@@ -1824,6 +1837,12 @@ class TpuChecker(Checker):
                     self._final_load_factor = out["table_load_factor"]
             out["table_load_factor"] = self._final_load_factor
         out.update(snap)
+        # Always-on vitals histograms (wave_latency_sec, waves_per_grow;
+        # obs/metrics.py documents the snapshot shape) — one nested key
+        # so flat scrapers keep a numbers-only top level.
+        hists = self._metrics.snapshot_histograms()
+        if hists:
+            out["histograms"] = hists
         if self._tracer is not None:
             out["trace_summary"] = self._tracer.summary()
         return out

@@ -22,7 +22,9 @@ the wave-trace stream (docs/OBSERVABILITY.md).  Child events: ``run_start``, ``r
 ``supervisor_done``.  Chaos-runtime events (``runtime/chaos.py``, see
 docs/ACTORS.md): ``chaos_start``, ``chaos_drop``, ``chaos_duplicate``,
 ``chaos_reorder``, ``chaos_delay``, ``chaos_partition``, ``orl_give_up``,
-``audit``.
+``audit``.  Service events (``serve/``, see docs/SERVING.md):
+``service_start``/``service_stop``, the ``job_*`` lifecycle family, and
+``job_span`` per-job duration spans.
 """
 
 from __future__ import annotations
@@ -48,15 +50,51 @@ class Journal:
     at the true end of file — a buffered ``TextIOWrapper`` could split
     one line across several syscalls and interleave torn halves from
     two writers (pinned by tests/test_runtime.py's interleaved-writer
-    test)."""
+    test).
 
-    def __init__(self, path: str):
+    Rotation (``max_bytes``): a persistent service daemon
+    (serve/server.py) journals every job forever, so an unrotated file
+    grows without bound.  With ``max_bytes`` set, an append that would
+    push the current segment past the cap first rolls the file over:
+    ``journal.jsonl`` -> ``journal.jsonl.1`` (older segments shift to
+    ``.2..max_segments``; the oldest falls off), each shift one atomic
+    ``os.rename``, all under the instance lock, and the append then
+    lands in a fresh segment — a record is never split across segments.
+    :func:`read_journal` merges segments oldest-first, so readers see
+    one continuous event stream.  Rotation is per-instance: run
+    directories where the child, supervisor, and engine share one path
+    through separate instances keep the default ``max_bytes=None``
+    (no rotation, exactly the old behavior)."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 max_segments: int = 8):
         self.path = str(path)
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError("max_bytes must be positive (or None)")
+        self.max_bytes = max_bytes
+        self.max_segments = max(1, int(max_segments))
         self._fd: Optional[int] = None
         self._lock = threading.Lock()
+
+    def _rollover(self) -> None:
+        """Shift segments up and move the live file to ``.1`` (caller
+        holds the lock; the live fd is closed first so the next append
+        reopens a fresh segment at the canonical path)."""
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        oldest = f"{self.path}.{self.max_segments}"
+        if os.path.exists(oldest):
+            os.unlink(oldest)
+        for k in range(self.max_segments - 1, 0, -1):
+            seg = f"{self.path}.{k}"
+            if os.path.exists(seg):
+                os.rename(seg, f"{self.path}.{k + 1}")
+        if os.path.exists(self.path):
+            os.rename(self.path, f"{self.path}.1")
 
     def append(self, event: str, **fields) -> dict:
         record = {"t": time.time(), "event": event}
@@ -69,6 +107,14 @@ class Journal:
                 self._fd = os.open(
                     self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
                 )
+            if self.max_bytes is not None:
+                size = os.fstat(self._fd).st_size
+                if size > 0 and size + len(line) > self.max_bytes:
+                    self._rollover()
+                    self._fd = os.open(
+                        self.path,
+                        os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644,
+                    )
             os.write(self._fd, line)
         return record
 
@@ -91,22 +137,49 @@ def as_journal(journal) -> Optional[Journal]:
     return Journal(str(journal))
 
 
+def _segment_paths(path: str) -> List[str]:
+    """Rotated segments oldest-first (``.N`` .. ``.1``), then the live
+    file — one continuous stream for readers."""
+    segs = []
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        segs.append(f"{path}.{k}")
+        k += 1
+    segs.reverse()
+    segs.append(path)
+    return segs
+
+
 def read_journal(path: str) -> List[Dict]:
-    """Parse a journal file into a list of event dicts.  Tolerates a
-    torn trailing line (a writer killed mid-``write``)."""
+    """Parse a journal file into a list of event dicts, merging rotated
+    segments (oldest first) when present.  Tolerates a torn trailing
+    line (a writer killed mid-``write``).
+
+    A rollover landing BETWEEN the segment listing and the reads would
+    silently skip the segment whose name shifted, so the read is
+    re-attempted until the segment list is stable across it (bounded;
+    one pass on a quiet journal — rotation happens at most once per
+    ``max_bytes`` of appends, so two consecutive passes racing distinct
+    rollovers is already pathological)."""
     events: List[Dict] = []
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    events.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # torn tail from a killed writer
-    except FileNotFoundError:
-        pass
+    for _ in range(3):
+        segs = _segment_paths(str(path))
+        events = []
+        for seg in segs:
+            try:
+                with open(seg, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            events.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue  # torn tail from a killed writer
+            except FileNotFoundError:
+                continue  # racing a rollover; the re-check below catches it
+        if _segment_paths(str(path)) == segs:
+            break
     return events
 
 

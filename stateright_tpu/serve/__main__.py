@@ -1,7 +1,13 @@
 """Checking-service daemon entry point::
 
     python -m stateright_tpu.serve [HOST:PORT]
-        [--journal PATH] [--knob-cache DIR] [--workers N]
+        [--journal PATH] [--journal-max-mb MB] [--knob-cache DIR]
+        [--workers N]
+
+``--journal-max-mb`` size-caps the journal into rotated segments
+(``journal.jsonl.1..N``, runtime/journal.py) so a long-lived daemon
+cannot grow one unbounded file; readers (``report``, read_journal)
+merge segments transparently.
 
 Serves until interrupted.  docs/SERVING.md documents the endpoints,
 the job lifecycle, and the journal layout.
@@ -21,6 +27,7 @@ def main(argv=None) -> int:
         return 0
     address = DEFAULT_ADDRESS
     journal = None
+    journal_max_mb = None
     knob_cache = None
     workers = 1
     positional = []
@@ -33,6 +40,17 @@ def main(argv=None) -> int:
                 print("--journal requires a path", file=sys.stderr)
                 return 2
             journal = args[i]
+        elif a == "--journal-max-mb":
+            i += 1
+            try:
+                journal_max_mb = float(args[i])
+            except (IndexError, ValueError):
+                print("--journal-max-mb requires a number of MB",
+                      file=sys.stderr)
+                return 2
+            if journal_max_mb <= 0:
+                print("--journal-max-mb must be positive", file=sys.stderr)
+                return 2
         elif a == "--knob-cache":
             i += 1
             if i >= len(args):
@@ -60,6 +78,22 @@ def main(argv=None) -> int:
 
     from .server import serve
     from .workloads import workload_names
+
+    if journal_max_mb is not None:
+        if journal is None:
+            # Silently journaling nothing is the opposite of what the
+            # size cap asks for; fail loudly at the CLI boundary.
+            print(
+                "--journal-max-mb requires --journal PATH (it size-caps "
+                "that journal into rotated segments)",
+                file=sys.stderr,
+            )
+            return 2
+        from ..runtime.journal import Journal
+
+        journal = Journal(
+            journal, max_bytes=int(journal_max_mb * 1024 * 1024)
+        )
 
     print(
         f"Checking service on http://{host}:{port} "

@@ -110,7 +110,38 @@ class Scheduler:
         # cooperative stop — never both terminal transitions.
         if self.store.try_cancel_queued(job):
             self.metrics.inc("jobs_cancelled")
+            self._finish_spans(job)
         return True
+
+    # -- per-job spans / SLO aggregation --------------------------------------
+
+    def _span(self, job: Job, span: str, sec: float) -> None:
+        """One lifecycle span: a ``job_span`` journal event (the durable
+        per-job trace, docs/SERVING.md) plus the matching SLO histogram
+        (``job_<span>_sec``) the aggregated ``/.metrics`` serves —
+        queue p95 and end-to-end latency distributions come from
+        exactly these."""
+        from ..obs.metrics import LATENCY_BUCKETS
+
+        sec = max(0.0, sec)
+        self.metrics.observe(
+            f"job_{span}_sec", sec, boundaries=LATENCY_BUCKETS
+        )
+        if self.journal is not None:
+            self.journal.append(
+                "job_span", job=job.id, span=span,
+                sec=round(sec, 6), state=job.state,
+            )
+
+    def _finish_spans(self, job: Job) -> None:
+        """Terminal-state spans: ``run`` (running -> terminal; absent
+        for a job cancelled while still queued) and ``total``
+        (submit -> terminal, the end-to-end latency a client saw)."""
+        if job.finished_at is None:
+            return
+        if job.started_at is not None:
+            self._span(job, "run", job.finished_at - job.started_at)
+        self._span(job, "total", job.finished_at - job.submitted_at)
 
     def shutdown(self, wait: bool = True) -> None:
         """Stop the workers: cancel any RUNNING job (the poll loop
@@ -157,6 +188,7 @@ class Scheduler:
     def _run_job(self, job: Job) -> None:
         if not self.store.try_start(job):
             return  # cancelled between pop and start
+        self._span(job, "queue_wait", job.started_at - job.submitted_at)
         t0 = time.monotonic()
         prog_hits0 = GLOBAL.get("program_cache_hits", 0)
         try:
@@ -177,6 +209,7 @@ class Scheduler:
                 job, CANCELLED,
                 unique=result.get("unique_state_count"),
             )
+            self._finish_spans(job)
             return
         except Exception as exc:
             import traceback
@@ -191,6 +224,7 @@ class Scheduler:
                     traceback=traceback.format_exc(limit=5)[-2000:],
                 )
             self.store.transition(job, FAILED, error=job.error[:500])
+            self._finish_spans(job)
             return
         result["completed"] = True
         result["elapsed_sec"] = round(time.monotonic() - t0, 3)
@@ -215,6 +249,7 @@ class Scheduler:
             unique=result.get("unique_state_count"),
             violation=result.get("violation"),
         )
+        self._finish_spans(job)
         self._enforce_checker_retention(job)
 
     def _enforce_checker_retention(self, job: Job) -> None:

@@ -93,6 +93,23 @@ class CheckService:
         # the direct evidence that a repeat submission reused the first
         # run's programs instead of recompiling.
         out.update(GLOBAL.snapshot())
+        # Job SLO surface (docs/SERVING.md "Job SLO metrics"): the
+        # scheduler's span histograms plus the derived operator gauges —
+        # queue p95 straight off the wait histogram, and the
+        # warm-vs-cold start ratio off the knob-cache counters.
+        hists = self.scheduler.metrics.snapshot_histograms()
+        if hists:
+            out["histograms"] = hists
+            qw = hists.get("job_queue_wait_sec")
+            if qw:
+                out["queue_wait_p95_sec"] = qw["p95"]
+        starts = out.get("knob_cache_hits", 0) + out.get(
+            "knob_cache_misses", 0
+        )
+        if starts:
+            out["warm_start_ratio"] = round(
+                out.get("knob_cache_hits", 0) / starts, 4
+            )
         return out
 
     def status(self) -> dict:
@@ -192,7 +209,29 @@ def serve(
             path = self.path.split("?", 1)[0].rstrip("/")
             try:
                 if path == "/.metrics":
-                    self._send(200, service.metrics())
+                    # JSON by default; ``?format=prometheus`` (or a
+                    # scraper's Accept header) selects the text
+                    # exposition so the service plugs into standard
+                    # scrapers (obs/prometheus.py, docs/SERVING.md).
+                    from ..obs.prometheus import (
+                        CONTENT_TYPE, render_prometheus, wants_prometheus,
+                    )
+
+                    if wants_prometheus(
+                        self._query(), self.headers.get("Accept")
+                    ):
+                        body = render_prometheus(
+                            service.metrics()
+                        ).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", CONTENT_TYPE)
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._send(200, service.metrics())
                 elif path in ("", "/.status"):
                     self._send(200, service.status())
                 elif path == "/jobs":

@@ -1,0 +1,338 @@
+"""Histograms, the Prometheus exposition, and journal-derived reports
+(obs/metrics.py, obs/prometheus.py, obs/report.py;
+docs/OBSERVABILITY.md "Run reports").
+"""
+
+import json
+
+import pytest
+
+from stateright_tpu.obs.metrics import (
+    COUNT_BUCKETS, Histogram, MetricsRegistry,
+)
+from stateright_tpu.obs.prometheus import (
+    ExpositionError, parse_prometheus, render_prometheus,
+)
+from stateright_tpu.obs.report import (
+    analyze_journal, bench_trajectory, render_markdown,
+    render_trajectory_markdown, report_main,
+)
+
+# --- histograms --------------------------------------------------------------
+
+
+def test_histogram_buckets_sum_count_and_quantiles():
+    h = Histogram((1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.counts == [1, 2, 1, 1]  # (..1], (1..2], (2..4], +Inf
+    assert h.sum == pytest.approx(106.5)
+    # p50 falls in the (1..2] bucket; p99 in the +Inf tail (reported at
+    # its lower bound — never an invented upper bound).
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    assert h.quantile(0.99) == 4.0
+    snap = h.snapshot()
+    assert snap["count"] == 5 and snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+def test_histogram_weighted_observation_and_bad_boundaries():
+    h = Histogram(COUNT_BUCKETS)
+    h.observe(3, count=16)  # one fused quantum = 16 equal waves
+    assert h.count == 16 and h.counts[2] == 16
+    with pytest.raises(ValueError):
+        Histogram((2.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram(())
+
+
+def test_registry_observe_creates_and_snapshots():
+    reg = MetricsRegistry()
+    reg.observe("lat", 0.1, boundaries=(0.05, 0.5))
+    reg.observe("lat", 0.01)  # boundaries fixed at first use
+    snap = reg.snapshot_histograms()
+    assert snap["lat"]["count"] == 2
+    assert snap["lat"]["boundaries"] == [0.05, 0.5]
+    assert reg.snapshot() == {}  # histograms never leak into the flat view
+
+
+# --- prometheus exposition ---------------------------------------------------
+
+
+def test_render_prometheus_types_and_parse_roundtrip():
+    metrics = {
+        "engine": "tpu-wavefront",
+        "done": True,
+        "unique_state_count": 288,
+        "table_load_factor": 0.017,
+        "device_call_sec_total": 1.25,
+        "jobs": {"queued": 0, "done": 2},
+        "histograms": {
+            "wave_latency_sec": Histogram((0.01, 0.1)).snapshot(),
+        },
+        "trace_summary": {"nested": {"too": "deep"}},  # skipped
+    }
+    metrics["histograms"]["wave_latency_sec"]["counts"] = [3, 1, 1]
+    metrics["histograms"]["wave_latency_sec"]["count"] = 5
+    metrics["histograms"]["wave_latency_sec"]["sum"] = 0.5
+    text = render_prometheus(metrics)
+    fams = parse_prometheus(text)
+    assert fams["stateright_unique_state_count"]["type"] == "counter"
+    assert fams["stateright_device_call_sec_total"]["type"] == "counter"
+    assert fams["stateright_table_load_factor"]["type"] == "gauge"
+    assert fams["stateright_done"]["samples"][0][2] == 1
+    # dict-of-numbers -> one labeled gauge family
+    jobs = {
+        labels["key"]: v
+        for _, labels, v in fams["stateright_jobs"]["samples"]
+    }
+    assert jobs == {"queued": 0, "done": 2}
+    # histogram: cumulative buckets, +Inf == count
+    lat = fams["stateright_wave_latency_sec"]
+    buckets = [
+        (labels["le"], v)
+        for n, labels, v in lat["samples"] if n.endswith("_bucket")
+    ]
+    assert buckets[-1] == ("+Inf", 5)
+    assert [v for _, v in buckets] == [3, 4, 5]
+    # strings land as labels on the info metric, not as samples
+    info = fams["stateright_info"]["samples"][0]
+    assert info[1]["engine"] == "tpu-wavefront"
+    assert "stateright_trace_summary" not in fams
+
+
+def test_wants_prometheus_respects_accept_preference_order():
+    from stateright_tpu.obs.prometheus import wants_prometheus
+
+    # Explicit query param always wins.
+    assert wants_prometheus({"format": "prometheus"}, "application/json")
+    assert not wants_prometheus({"format": "json"}, "text/plain")
+    # A scraper's Accept (text exposition first) selects Prometheus ...
+    assert wants_prometheus(
+        {}, "application/openmetrics-text;version=1.0.0,"
+            "text/plain;version=0.0.4;q=0.5,*/*;q=0.1")
+    assert wants_prometheus({}, "text/plain")
+    # ... but a JSON client listing text/plain as a FALLBACK keeps JSON
+    # (axios et al. send exactly this default).
+    assert not wants_prometheus({}, "application/json, text/plain, */*")
+    assert not wants_prometheus({}, "*/*")
+    assert not wants_prometheus({}, None)
+
+
+def test_parse_prometheus_rejects_malformed_expositions():
+    with pytest.raises(ExpositionError):
+        parse_prometheus("this is not a sample\n")
+    with pytest.raises(ExpositionError):
+        parse_prometheus("# TYPE x wibble\nx 1\n")
+    with pytest.raises(ExpositionError):
+        parse_prometheus("x notanumber\n")
+    # histogram with non-cumulative buckets
+    bad = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+        "h_sum 1\nh_count 3\n"
+    )
+    with pytest.raises(ExpositionError):
+        parse_prometheus(bad)
+
+
+# --- journal run reports -----------------------------------------------------
+
+
+def _wave(t, waves, unique, depth, call_sec, **extra):
+    return {
+        "t": t, "event": "wave", "waves": waves, "unique": unique,
+        "states": unique * 2, "depth": depth, "flags": 0,
+        "call_sec": call_sec, "occupancy": unique / 4096,
+        "remaining": 0, **extra,
+    }
+
+
+def test_run_report_untraced_supervised_journal():
+    """A supervisor-shaped journal (waves + crash/restart/resume) yields
+    phase breakdown, bottleneck_phase, a throughput curve, and the
+    restart timeline."""
+    events = [
+        {"t": 100.0, "event": "supervisor_start", "run_dir": "x"},
+        {"t": 100.1, "event": "run_start"},
+        _wave(101.0, 8, 1000, 3, 0.8),
+        _wave(102.0, 16, 2500, 5, 0.7),
+        {"t": 102.5, "event": "crash", "rc": 137},
+        {"t": 102.6, "event": "restart", "restarts": 1},
+        {"t": 102.7, "event": "resume"},
+        _wave(104.0, 24, 5000, 8, 0.9),
+        {"t": 104.1, "event": "checkpoint", "path": "ck.npz"},
+        {"t": 104.2, "event": "grow", "flags": 1, "grown": "capacity"},
+        _wave(106.0, 32, 9000, 11, 1.1),
+        {"t": 106.1, "event": "engine_done", "unique": 9000},
+        {"t": 106.2, "event": "supervisor_done"},
+    ]
+    rep = analyze_journal(events)
+    assert rep["kind"] == "run"
+    assert rep["unique"] == 9000 and rep["waves"] == 4
+    assert rep["grows"] == 1 and rep["checkpoints"] == 1
+    assert rep["restarts"] == 1 and rep["faults"] == 1
+    assert rep["phase_source"] == "untraced-device/host-split"
+    assert set(rep["phase_breakdown"]) == {"device", "host"}
+    assert rep["bottleneck_phase"] in ("device", "host")
+    curve = rep["throughput_curve"]
+    assert curve[-1]["unique"] == 9000
+    assert all(pt["uniq_per_sec"] >= 0 for pt in curve)
+    assert [e["event"] for e in rep["timeline"]].count("crash") == 1
+    md = render_markdown(rep)
+    assert "bottleneck" in md.lower() and "crash" in md
+    json.dumps(rep)  # the --json form must serialize
+
+
+def test_run_report_traced_journal_names_device_phase():
+    events = [
+        _wave(1.0, 1, 100, 1, 0.5, wave_breakdown={
+            "step": 0.1, "dedup": 0.3, "append": 0.05, "readback": 0.05,
+        }),
+        _wave(2.0, 2, 250, 2, 0.5, wave_breakdown={
+            "step": 0.1, "dedup": 0.25, "append": 0.05, "readback": 0.1,
+        }),
+        {"t": 2.5, "event": "trace_summary", "hbm_util_frac": 0.004},
+    ]
+    rep = analyze_journal(events)
+    assert rep["phase_source"] == "traced"
+    assert rep["bottleneck_phase"] == "dedup"  # readback excluded
+    assert rep["trace_summary"]["hbm_util_frac"] == 0.004
+
+
+def test_service_journal_report_collects_job_spans():
+    events = [
+        {"t": 10.0, "event": "service_start", "workers": 1},
+        {"t": 10.1, "event": "job_submitted", "job": "job-000001",
+         "workload": "twophase", "engine": "tpu"},
+        {"t": 10.2, "event": "job_running", "job": "job-000001"},
+        {"t": 10.2, "event": "job_span", "job": "job-000001",
+         "span": "queue_wait", "sec": 0.1},
+        {"t": 12.0, "event": "job_done", "job": "job-000001"},
+        {"t": 12.0, "event": "job_span", "job": "job-000001",
+         "span": "run", "sec": 1.8},
+        {"t": 12.0, "event": "job_span", "job": "job-000001",
+         "span": "total", "sec": 1.9},
+        {"t": 12.1, "event": "job_submitted", "job": "job-000002",
+         "workload": "fixtures", "engine": "tpu"},
+        {"t": 12.2, "event": "job_cancelled", "job": "job-000002"},
+        {"t": 12.2, "event": "job_span", "job": "job-000002",
+         "span": "total", "sec": 0.1},
+    ]
+    rep = analyze_journal(events)
+    assert rep["kind"] == "service"
+    jobs = rep["jobs"]
+    assert jobs["count"] == 2
+    assert jobs["by_state"] == {"done": 1, "cancelled": 1}
+    assert jobs["detail"]["job-000001"]["spans"]["queue_wait"] == 0.1
+    assert "queue_wait_p95_sec" in jobs
+    md = render_markdown(rep)
+    assert "job-000001" in md and "queue_wait" in md
+
+
+# --- bench trajectory + regression flagging ----------------------------------
+
+
+def _round(tmp_path, name, value, metric="paxos3_unique_states_per_sec",
+           rc=0, **extra):
+    parsed = (
+        {"metric": metric, "value": value, "unit": "u/s",
+         "vs_baseline": 1.0, **extra}
+        if value is not None else {}
+    )
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps({"rc": rc, "parsed": parsed}))
+    return str(p)
+
+
+def test_trajectory_flags_synthetic_degraded_round(tmp_path):
+    paths = [
+        _round(tmp_path, "BENCH_r01", 100_000.0),
+        _round(tmp_path, "BENCH_r02", 250_000.0),
+        _round(tmp_path, "BENCH_r03", 120_000.0),  # < 0.8 * best -> flag
+        _round(tmp_path, "BENCH_r04", None, rc=1),  # partial: never flagged
+        _round(tmp_path, "BENCH_r05", 260_000.0),
+    ]
+    traj = bench_trajectory(paths)
+    assert [r["round"] for r in traj["rounds"]] == [
+        "BENCH_r01", "BENCH_r02", "BENCH_r03", "BENCH_r04", "BENCH_r05",
+    ]
+    assert len(traj["regressions"]) == 1
+    flag = traj["regressions"][0]
+    assert flag["round"] == "BENCH_r03"
+    assert flag["best_round"] == "BENCH_r02"
+    assert flag["ratio"] == pytest.approx(0.48)
+    md = render_trajectory_markdown(traj)
+    assert "⚠" in md and "BENCH_r03" in md
+    # A metric change (new headline workload) never cross-flags.
+    paths.append(
+        _round(tmp_path, "BENCH_r06", 10.0, metric="other_metric")
+    )
+    assert len(bench_trajectory(paths)["regressions"]) == 1
+
+
+def test_trajectory_on_committed_rounds_is_clean():
+    """The repo's real BENCH_r*.json history renders without error and
+    carries no regression (the trajectory is monotone so far)."""
+    import glob
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    if not paths:
+        pytest.skip("no BENCH rounds committed")
+    traj = bench_trajectory(paths)
+    assert len(traj["rounds"]) == len(paths)
+    assert traj["regressions"] == []
+    assert "BENCH_r01" in render_trajectory_markdown(traj)
+
+
+# --- the report CLI verb -----------------------------------------------------
+
+
+def test_report_main_on_journal_and_bench_glob(tmp_path, capsys):
+    from stateright_tpu.runtime.journal import Journal
+
+    jpath = str(tmp_path / "journal.jsonl")
+    with Journal(jpath) as j:
+        j.append("wave", waves=1, unique=10, depth=1, call_sec=0.1,
+                 occupancy=0.01, remaining=0, states=20, flags=0)
+        j.append("engine_done", unique=10)
+    assert report_main([jpath]) == 0
+    out = capsys.readouterr().out
+    assert "Run report" in out and "bottleneck" in out.lower()
+
+    _round(tmp_path, "BENCH_r01", 100.0)
+    _round(tmp_path, "BENCH_r02", 10.0)
+    md_out = tmp_path / "traj.md"
+    assert report_main(
+        [str(tmp_path / "BENCH_r*.json"), "--out", str(md_out)]
+    ) == 0
+    text = md_out.read_text()
+    assert "BENCH_r02" in text and "⚠" in text
+
+    # --json emits the dict; mixing journals and rounds is refused.
+    capsys.readouterr()
+    assert report_main([jpath, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "run" and rep["unique"] == 10
+    assert report_main([jpath, str(tmp_path / "BENCH_r01.json")]) == 2
+    assert report_main(["/nonexistent/path.jsonl"]) == 2
+    assert report_main([]) == 2
+
+
+def test_report_cli_verb_through_example_main(tmp_path, capsys):
+    """`python -m stateright_tpu.models.<any> report <journal>` — the
+    verb rides on every model CLI."""
+    from stateright_tpu.cli import example_main
+    from stateright_tpu.models.twophase import cli_spec
+
+    jpath = str(tmp_path / "journal.jsonl")
+    from stateright_tpu.runtime.journal import Journal
+
+    with Journal(jpath) as j:
+        j.append("wave", waves=1, unique=5, depth=1, call_sec=0.1,
+                 occupancy=0.01, remaining=0, states=5, flags=0)
+    rc = example_main(cli_spec(), ["report", jpath])
+    assert rc == 0
+    assert "Run report" in capsys.readouterr().out

@@ -62,6 +62,48 @@ def test_journal_concurrent_appenders_interleave_lines(tmp_path):
     assert {e["src"] for e in events} == {"a", "b"}
 
 
+def test_journal_rotation_rolls_over_mid_append(tmp_path):
+    """Size-capped journals roll into ``.1..N`` segments: the append
+    that would cross the cap first shifts segments (atomic renames
+    under the lock), then lands whole in a fresh live file — no record
+    is ever split across segments, and readers merge oldest-first."""
+    import os
+
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, max_bytes=256, max_segments=3)
+    for i in range(30):
+        j.append("e", i=i)
+    j.close()
+    names = sorted(os.listdir(tmp_path))
+    assert "j.jsonl" in names and "j.jsonl.1" in names
+    assert "j.jsonl.4" not in names  # oldest fell off at the cap
+    # Every retained segment holds whole lines; merged read is a
+    # contiguous, ordered suffix of what was appended.
+    events = read_journal(path)
+    idx = [e["i"] for e in events]
+    assert idx == list(range(idx[0], 30))
+    assert os.path.getsize(path) <= 256
+    # A fresh instance on the same path keeps appending after the
+    # existing segments (the reopen-after-rollover path).
+    j2 = Journal(path, max_bytes=256, max_segments=3)
+    j2.append("e", i=30)
+    j2.close()
+    assert read_journal(path)[-1]["i"] == 30
+    assert last_event(path, "e")["i"] == 30
+
+
+def test_journal_unrotated_default_never_renames(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)  # default: no cap, exactly the old behavior
+    for i in range(50):
+        j.append("e", i=i)
+    j.close()
+    import os
+
+    assert sorted(os.listdir(tmp_path)) == ["j.jsonl"]
+    assert len(read_journal(path)) == 50
+
+
 def test_journal_reporter_streams_report_protocol(tmp_path):
     """JournalReporter adapts the standard Reporter protocol onto a
     journal: the reference's text report data lands as machine-readable

@@ -190,6 +190,92 @@ def test_cancel_queued_and_running_jobs(tmp_path):
         svc.scheduler.shutdown()
 
 
+def test_job_spans_and_slo_metrics(tmp_path):
+    """Per-job lifecycle spans (docs/SERVING.md "Job SLO metrics"): the
+    scheduler stamps queue_wait/run/total ``job_span`` events into the
+    journal for completed AND cancelled jobs, and the aggregated
+    metrics carry the SLO histograms, queue p95, and the warm-start
+    ratio; the whole dict renders as a parseable Prometheus
+    exposition."""
+    from stateright_tpu.obs.prometheus import (
+        parse_prometheus, render_prometheus,
+    )
+
+    svc = CheckService(
+        journal=str(tmp_path / "j.jsonl"),
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    try:
+        done = submit_and_wait(
+            svc, {"workload": "fixtures", "n": 5, "engine": "bfs"})
+        assert done.state == DONE
+        # A blocker keeps the single worker busy so the next job is
+        # deterministically cancelled while still queued.
+        blocker = svc.submit({
+            "workload": "twophase", "n": 8, "engine": "bfs",
+            "threads": 1, "timeout": 120.0,
+        })
+        queued = svc.submit({"workload": "twophase", "n": 3})
+        deadline = time.time() + 60
+        while blocker.state != "running" and time.time() < deadline:
+            time.sleep(0.02)
+        assert svc.cancel(queued.id) and queued.state == CANCELLED
+        assert svc.cancel(blocker.id) and blocker.wait(60)
+        assert blocker.state == CANCELLED
+
+        spans = [e for e in read_journal(str(tmp_path / "j.jsonl"))
+                 if e["event"] == "job_span"]
+        by_job = {}
+        for s in spans:
+            by_job.setdefault(s["job"], set()).add(s["span"])
+        assert by_job[done.id] == {"queue_wait", "run", "total"}
+        assert by_job[queued.id] == {"total"}  # never started: no run span
+        assert by_job[blocker.id] == {"queue_wait", "run", "total"}
+        assert all(s["sec"] >= 0 for s in spans)
+
+        m = svc.metrics()
+        hists = m["histograms"]
+        assert hists["job_queue_wait_sec"]["count"] == 2  # done + blocker
+        assert hists["job_total_sec"]["count"] == 3  # every terminal job
+        assert hists["job_run_sec"]["count"] == 2
+        assert m["queue_wait_p95_sec"] >= 0
+        assert m["jobs_cancelled"] == 2
+        assert 0.0 <= m.get("warm_start_ratio", 0.0) <= 1.0
+
+        fams = parse_prometheus(render_prometheus(m))
+        assert fams["stateright_job_total_sec"]["type"] == "histogram"
+        assert fams["stateright_jobs_cancelled"]["type"] == "counter"
+        assert fams["stateright_jobs_cancelled"]["samples"][0][2] == 2
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_http_metrics_prometheus_exposition(http_service):
+    """GET /.metrics?format=prometheus on the serve server: text
+    exposition content type, parseable, job SLO series present."""
+    from stateright_tpu.obs.prometheus import parse_prometheus
+
+    svc, base = http_service
+    job = svc.submit({"workload": "fixtures", "n": 5, "engine": "bfs"})
+    assert job.wait(60)
+    req = urllib.request.Request(base + "/.metrics?format=prometheus")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("Content-Type", "").startswith(
+            "text/plain"
+        )
+        text = resp.read().decode()
+    fams = parse_prometheus(text)
+    assert fams["stateright_jobs_completed"]["samples"][0][2] == 1
+    assert fams["stateright_job_queue_wait_sec"]["type"] == "histogram"
+    jobs = {
+        labels["key"]: v
+        for _, labels, v in fams["stateright_jobs"]["samples"]
+    }
+    assert jobs["done"] == 1
+    # JSON stays the default without the format param.
+    assert http_json("GET", base + "/.metrics")["jobs_completed"] == 1
+
+
 def test_request_stop_stops_tpu_engine_promptly():
     """Engine-level pin for the service's cancel path: request_stop on a
     running wavefront checker winds it down like a deadline."""
