@@ -58,6 +58,7 @@ def _usage(name: str, spec: "CliSpec") -> str:
         "  report <journal.jsonl | BENCH-glob | dir> [--json]"
         " [--out FILE] [--threshold FRAC]"
     )
+    lines.append("  watch <journal.jsonl> [--interval SEC] [--once]")
     if spec.spawn is not None:
         lines.append(
             "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
@@ -930,6 +931,15 @@ def example_main(spec: CliSpec, argv=None) -> int:
         from .obs.report import report_main
 
         return report_main(args)
+
+    if sub == "watch":
+        # Live journal tail -> one-line refreshing progress view
+        # (obs/watch.py, docs/OBSERVABILITY.md "watch"); model-agnostic
+        # like `report`.  `--once` prints a single snapshot (the CI
+        # smoke's mode).
+        from .obs.watch import watch_main
+
+        return watch_main(args)
 
     print(_usage(spec.name, spec))
     return 2

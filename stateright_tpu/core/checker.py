@@ -270,6 +270,15 @@ class Checker:
             "program_cache_misses": int(
                 GLOBAL.get("program_cache_misses", 0)
             ),
+            # Compile observability (wave_common.cached_program, docs/
+            # OBSERVABILITY.md "Compile events"): accumulated first-call
+            # compile wall time and the storm counter — included on
+            # every engine so one scrape answers "is this process
+            # recompiling, and is it thrashing".
+            "compile_sec_total": round(
+                float(GLOBAL.get("compile_sec_total", 0.0)), 4
+            ),
+            "recompile_storms": int(GLOBAL.get("recompile_storms", 0)),
         }
 
     # --- shared functionality -----------------------------------------------

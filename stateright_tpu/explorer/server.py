@@ -84,7 +84,7 @@ def _properties_view(checker) -> List[List[Any]]:
 
 
 def _status_view(checker, snapshot: _Snapshot) -> dict:
-    return {
+    out = {
         "done": checker.is_done(),
         "model": type(checker.model()).__name__,
         "state_count": checker.state_count(),
@@ -93,6 +93,16 @@ def _status_view(checker, snapshot: _Snapshot) -> dict:
         "properties": _properties_view(checker),
         "recent_path": snapshot.path_repr,
     }
+    # Live vitals beside the counts (the same mid-run-safe subset the
+    # checking service embeds in a running job's snapshot —
+    # obs/metrics.vitals_view): one /.status poll answers "is this run
+    # moving, and how fast" without a second /.metrics request.
+    from ..obs.metrics import vitals_view
+
+    vitals = vitals_view(checker)
+    if vitals is not None:
+        out["vitals"] = vitals
+    return out
 
 
 def _state_views(checker, fp_path: str) -> List[dict]:

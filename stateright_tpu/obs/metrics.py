@@ -35,6 +35,37 @@ LATENCY_BUCKETS = (
 )
 # Waves-between-growth-events ladder (powers of two, like the geometry).
 COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# Fractions-of-a-buffer ladder (valid density vs the worst-case U
+# buffer, hot-table load factor): log-spaced below 10% — where the
+# measured densities actually live (docs/OBSERVABILITY.md "Density
+# telemetry") — then coarse to 1.0.
+FRACTION_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.2, 0.3, 0.4, 0.5, 0.75, 1.0,
+)
+
+# The live-vitals subset of ``Checker.metrics()`` that per-job / per-run
+# status surfaces embed (serve ``GET /jobs/{id}``, the Explorer's
+# ``/.status``): progress + health, small enough to poll without
+# shipping the whole snapshot.  One definition so the two surfaces and
+# docs/SERVING.md cannot drift.
+VITALS_KEYS = (
+    "unique_state_count", "state_count", "max_depth", "waves",
+    "uniq_per_sec_ema", "waves_per_sec_ema", "table_load_factor",
+    "valid_density_ema", "grows", "overflow_retries",
+)
+
+
+def vitals_view(checker):
+    """The :data:`VITALS_KEYS` subset of ``checker.metrics()``, or None
+    when it cannot be read (a checker mid-teardown whose device buffers
+    are already freed must never break a status snapshot).  The one
+    extraction both embedding surfaces share."""
+    try:
+        m = checker.metrics()
+    except Exception:
+        return None
+    return {k: m[k] for k in VITALS_KEYS if k in m}
 
 
 class Histogram:

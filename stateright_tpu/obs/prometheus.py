@@ -221,6 +221,28 @@ def parse_prometheus(text: str) -> Dict[str, dict]:
         fam["samples"].append((name, labels, value))
     for family, fam in families.items():
         if fam["type"] != "histogram":
+            # Labeled gauge/counter families (the per-shard series:
+            # ``stateright_shard_unique{key="3"}``) must be internally
+            # consistent: every sample in a family carries the SAME
+            # label-name set, and no two samples repeat the same label
+            # set (a duplicate series is a scrape-breaking exposition).
+            label_names = None
+            seen = set()
+            for name, labels, _v in fam["samples"]:
+                names = frozenset(labels)
+                if label_names is None:
+                    label_names = names
+                elif names != label_names:
+                    raise ExpositionError(
+                        f"family {family} mixes label sets "
+                        f"{sorted(label_names)} and {sorted(names)}"
+                    )
+                sig = (name, tuple(sorted(labels.items())))
+                if sig in seen:
+                    raise ExpositionError(
+                        f"family {family} repeats series {sig}"
+                    )
+                seen.add(sig)
             continue
         buckets = [
             (labels.get("le"), v)

@@ -1,0 +1,257 @@
+"""Live journal watcher: the ``watch`` CLI verb.
+
+``watch <journal.jsonl>`` tails a run (or service) journal — including
+rotating ones, whose segments :func:`~stateright_tpu.runtime.journal.
+read_journal_stats` merges — and renders a refreshing ONE-LINE progress
+view: wall clock, depth, unique states, a uniq/s EMA computed over the
+trailing wave events, hot-table load factor, measured valid density,
+the bottleneck phase, and warning badges (recompile storms, torn lines,
+faults).  It reads the journal file only — never the engine — so it
+watches supervised children, serve daemons, and remote runs over any
+shared filesystem alike, mid-run or post-mortem.
+
+``--once`` prints a single snapshot line and exits (the non-interactive
+mode CI greps); otherwise the line refreshes every ``--interval``
+seconds (default 2) until interrupted — or, for a run journal that has
+reached ``engine_done``/``supervisor_done``, until the final line is
+printed.  On a TTY the line redraws in place; a pipe gets one line per
+refresh.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+# uniq/s smoothing over the trailing wave events, mirroring the
+# engines' live EMA (wave_loop.LoopVitals, alpha 0.3) so the watched
+# number and the /.metrics number read alike.
+EMA_ALPHA = 0.3
+_EMA_TAIL = 32  # trailing wave events folded into the EMA
+
+
+def summarize_events(events: List[dict], skipped: int = 0) -> dict:
+    """Reduce a journal event list to the one-line snapshot fields."""
+    from ..parallel.wave_common import (
+        COMPILE_STORM_THRESHOLD, COMPILE_STORM_WINDOW_SEC,
+    )
+
+    out: dict = {"events": len(events), "warnings": []}
+    if skipped:
+        out["warnings"].append(f"torn-lines={skipped}")
+    if not events:
+        return out
+    times = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+    if times:
+        out["t"] = round(max(times) - min(times), 1)
+        out["last_event_age"] = round(time.time() - max(times), 1)
+
+    waves = [e for e in events if e.get("event") == "wave"]
+    if waves:
+        last = waves[-1]
+        for k in ("unique", "depth", "waves", "remaining"):
+            if k in last:
+                out[k] = last[k]
+        if isinstance(last.get("occupancy"), (int, float)):
+            out["load_factor"] = last["occupancy"]
+        dens = [
+            w["density"] for w in waves
+            if isinstance(w.get("density"), (int, float))
+        ]
+        if dens:
+            out["density"] = dens[-1]
+        # uniq/s EMA over the trailing segments.
+        pts = [
+            (w["t"], w["unique"]) for w in waves[-_EMA_TAIL:]
+            if isinstance(w.get("t"), (int, float))
+            and isinstance(w.get("unique"), int)
+        ]
+        ema: Optional[float] = None
+        for (t0, u0), (t1, u1) in zip(pts, pts[1:]):
+            if t1 > t0:
+                rate = max(0, u1 - u0) / (t1 - t0)
+                ema = rate if ema is None else ema + EMA_ALPHA * (rate - ema)
+        if ema is not None:
+            out["uniq_per_sec"] = round(ema, 1)
+        # Bottleneck: the dominant device phase on traced journals, the
+        # device/host split otherwise (obs/report.py's rule, inlined so
+        # a watch tick stays O(waves), not a full report).
+        from .trace import HOST_PHASES
+
+        phases: dict = {}
+        for w in waves:
+            if isinstance(w.get("wave_breakdown"), dict):
+                for name, sec in w["wave_breakdown"].items():
+                    phases[name] = phases.get(name, 0.0) + float(sec)
+        if phases:
+            device = {
+                k: v for k, v in phases.items() if k not in HOST_PHASES
+            } or phases
+            out["bottleneck"] = max(device, key=device.get)
+        else:
+            device = sum(float(w.get("call_sec", 0.0)) for w in waves)
+            wall = (
+                waves[-1]["t"] - waves[0]["t"]
+                if len(waves) > 1
+                and all("t" in w for w in (waves[0], waves[-1]))
+                else device
+            )
+            out["bottleneck"] = (
+                "device" if device >= max(0.0, wall - device) else "host"
+            )
+
+    # Service journals: job counts by their latest lifecycle event.
+    job_state: dict = {}
+    for e in events:
+        ev = str(e.get("event", ""))
+        if ev in ("job_submitted", "job_running", "job_done", "job_failed",
+                  "job_cancelled") and e.get("job"):
+            job_state[e["job"]] = ev[len("job_"):]
+    if job_state:
+        counts: dict = {}
+        for s in job_state.values():
+            s = "queued" if s == "submitted" else s
+            counts[s] = counts.get(s, 0) + 1
+        out["jobs"] = counts
+
+    # Recompile storms: the journaled storm flag, or enough compile
+    # events inside the trailing window to cross the threshold now.
+    compiles = [e for e in events if e.get("event") == "compile"]
+    if any(e.get("storm") for e in compiles):
+        out["recompile_storm"] = True
+    elif compiles and times:
+        tail = [
+            e for e in compiles
+            if e["t"] >= max(times) - COMPILE_STORM_WINDOW_SEC
+        ]
+        if len(tail) >= COMPILE_STORM_THRESHOLD:
+            out["recompile_storm"] = True
+    if out.get("recompile_storm"):
+        out["warnings"].append("recompile-storm")
+    out["compiles"] = len(compiles)
+
+    faults = sum(
+        1 for e in events if e.get("event") in ("crash", "hang")
+    )
+    if faults:
+        out["warnings"].append(f"faults={faults}")
+    grows = sum(1 for e in events if e.get("event") == "grow")
+    if grows:
+        out["grows"] = grows
+    kinds = {e.get("event") for e in events}
+    if "engine_done" in kinds or "supervisor_done" in kinds:
+        out["done"] = True
+    if "service_stop" in kinds:
+        out["done"] = True
+    return out
+
+
+def _fmt(v, digits: int = 4) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def render_line(s: dict) -> str:
+    """The one-line progress view.  Field names are part of the
+    greppable surface (docs/OBSERVABILITY.md "watch"): ``density=`` and
+    ``bottleneck=`` always appear on run journals (— when unknown)."""
+    parts = []
+    if "t" in s:
+        parts.append(f"t+{s['t']}s")
+    if "jobs" in s:
+        parts.append(
+            "jobs " + " ".join(
+                f"{k}={v}" for k, v in sorted(s["jobs"].items())
+            )
+        )
+    if "unique" in s or "depth" in s:
+        parts.append(f"depth={_fmt(s.get('depth'))}")
+        parts.append(f"unique={_fmt(s.get('unique'))}")
+        parts.append(f"uniq/s={_fmt(s.get('uniq_per_sec'))}")
+        parts.append(f"load_factor={_fmt(s.get('load_factor'))}")
+        parts.append(f"density={_fmt(s.get('density'))}")
+        parts.append(f"bottleneck={_fmt(s.get('bottleneck'))}")
+        if "waves" in s:
+            parts.append(f"waves={s['waves']}")
+        if s.get("grows"):
+            parts.append(f"grows={s['grows']}")
+    if s.get("compiles"):
+        parts.append(f"compiles={s['compiles']}")
+    if s.get("done"):
+        parts.append("done")
+    if not parts:
+        parts.append(f"events={s.get('events', 0)} (no waves yet)")
+    line = " ".join(parts)
+    for w in s.get("warnings", ()):
+        line += f" ⚠ {w}"
+    return line
+
+
+def watch_main(args: List[str], out=None) -> int:
+    """``watch <journal.jsonl> [--interval SEC] [--once]`` (cli.py)."""
+    out = out or sys.stdout
+    once = False
+    interval = 2.0
+    targets: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--once":
+            once = True
+        elif a == "--interval" or a.startswith("--interval="):
+            if a == "--interval":
+                i += 1
+                val = args[i] if i < len(args) else None
+            else:
+                val = a.split("=", 1)[1]
+            try:
+                interval = float(val)
+            except (TypeError, ValueError):
+                print("--interval requires seconds", file=sys.stderr)
+                return 2
+            if interval <= 0:
+                print("--interval must be positive", file=sys.stderr)
+                return 2
+        else:
+            targets.append(a)
+        i += 1
+    if len(targets) != 1:
+        print("watch takes exactly one journal path", file=sys.stderr)
+        return 2
+    path = targets[0]
+    if not os.path.exists(path) and once:
+        print(f"no such journal: {path}", file=sys.stderr)
+        return 2
+
+    from ..runtime.journal import read_journal_stats
+
+    tty = hasattr(out, "isatty") and out.isatty()
+    try:
+        while True:
+            events, skipped = (
+                read_journal_stats(path) if os.path.exists(path)
+                else ([], 0)
+            )
+            s = summarize_events(events, skipped)
+            line = render_line(s)
+            if once:
+                print(line, file=out)
+                return 0
+            if tty:
+                print("\r\x1b[2K" + line, end="", file=out, flush=True)
+            else:
+                print(line, file=out, flush=True)
+            if s.get("done"):
+                if tty:
+                    print(file=out)
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        if tty:
+            print(file=out)
+        return 0

@@ -800,8 +800,26 @@ class ShardedTpuChecker(Checker):
         from .wave_common import cached_program
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run,
+            label="ShardedTpuChecker.fused",
+            journal=self._journal,
+            provenance=self._key_provenance(),
         )
+
+    def _key_provenance(self) -> dict:
+        """Human-readable knobs behind the program-cache keys (the
+        journaled ``compile`` events' attribution field —
+        docs/OBSERVABILITY.md "Compile events")."""
+        return {
+            "model": type(self._compiled).__name__,
+            "shards": self._n,
+            "capacity_per_shard": self._cap_s,
+            "chunk_size": self._chunk,
+            "dedup_factor": self._dedup_factor,
+            "bucket_slack": self._bucket_slack,
+            "waves_per_call": self._waves_per_call,
+            "symmetry": self._canon is not None,
+        }
 
     def _seed_program(self, seed_w: int):
         """Init-state seeding program, cached like the run program (the
@@ -925,7 +943,12 @@ class ShardedTpuChecker(Checker):
 
         from .wave_common import cached_program
 
-        return cached_program(_PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build)
+        return cached_program(
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+            label="ShardedTpuChecker.seed",
+            journal=self._journal,
+            provenance={"shards": self._n, "seed_w": seed_w},
+        )
 
     # --- host loop -----------------------------------------------------------
 
@@ -959,7 +982,10 @@ class ShardedTpuChecker(Checker):
         from .wave_common import cached_program
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced,
+            label="ShardedTpuChecker.traced",
+            journal=self._journal,
+            provenance=self._key_provenance(),
         )
 
     def _build_traced(self):
@@ -1251,13 +1277,15 @@ class ShardedTpuChecker(Checker):
         )
         disc_h = np.asarray(disc).reshape(n, len(props))
         waves = 0
-        # Always-on vitals (latency histogram, uniq/s EMA, grow
-        # counters) — same registry keys as the fused loop's.
-        from .wave_loop import LoopVitals
+        # Always-on vitals (latency histogram, uniq/s EMA, density,
+        # grow counters) — same registry keys as the fused loop's.
+        from .wave_loop import LoopVitals, journal_geometry
 
         vitals = LoopVitals(
-            self._metrics, initial_unique=self._unique_count
+            self._metrics, initial_unique=self._unique_count,
+            initial_states=self._state_count,
         )
+        journal_geometry(self)
 
         while int((level_end - level_start).sum()) > 0:
             if target_depth and depth >= target_depth - 1:
@@ -1422,6 +1450,16 @@ class ShardedTpuChecker(Checker):
                 exchange_payload_bytes=useful,
             )
             enrich["exchange_occupancy"] = round(occ_wave, 6)
+            vitals.record_quantum(
+                t7 - t0, 1, self._unique_count, committed=True,
+                states=self._state_count,
+                cand_lanes=self._wl_cand_lanes(),
+                occupancy=float(unique_l.max()) / cap_s,
+            )
+            vitals.record_host(phases["readback"])
+            self._update_shard_metrics(
+                level_end - level_start, unique_l, cand_total
+            )
             if self._journal:
                 self._journal.append(
                     "wave",
@@ -1433,6 +1471,10 @@ class ShardedTpuChecker(Checker):
                     flags=0,
                     call_sec=round(t7 - t0, 6),
                     occupancy=round(float(unique_l.max()) / cap_s, 6),
+                    **(
+                        {"density": round(vitals.last_density, 6)}
+                        if vitals.last_density is not None else {}
+                    ),
                     **enrich,
                 )
             self._metrics.update(
@@ -1443,10 +1485,6 @@ class ShardedTpuChecker(Checker):
             )
             self._metrics.inc("device_call_sec_total", t7 - t0)
             self._metrics.inc("device_calls", 1)
-            vitals.record_quantum(
-                t7 - t0, 1, self._unique_count, committed=True
-            )
-            vitals.record_host(phases["readback"])
 
             # Shared termination tail (wave_loop.py): finish_when /
             # target_state_count / deadline / cooperative cancel, the
@@ -1710,6 +1748,12 @@ class ShardedTpuChecker(Checker):
             .astype(np.int64)
         )
         self._last_stats_h = stats_h
+        # Per-shard skew gauges from the SAME readback (no extra sync).
+        self._update_shard_metrics(
+            stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START],
+            stats_h[:, S_UNIQUE_L],
+            (stats_h[:, S_CAND_HI] << 32) | stats_h[:, S_CAND_LO],
+        )
         remaining = int(
             (stats_h[:, S_LEVEL_END] - stats_h[:, S_LEVEL_START]).sum()
         )
@@ -1739,6 +1783,65 @@ class ShardedTpuChecker(Checker):
 
     def _wl_discovered_names(self):
         return self._discovery_gids
+
+    def _wl_cand_lanes(self) -> int:
+        """Density denominator (wave_loop.LoopVitals): the mesh-global
+        worst-case compaction width — every shard's ``U`` buffer —
+        matching the psum'd generated-successor numerator."""
+        return self._n * self._u_sz()
+
+    def _wl_geometry(self) -> dict:
+        """The ``geometry`` journal event payload (wave_loop.
+        journal_geometry) — the advisor's knob ground truth, incl. the
+        exchange-bucket rung the bucket_slack recommendation is
+        relative to."""
+        return {
+            "engine": "tpu-sharded",
+            "shards": self._n,
+            "capacity_per_shard": self._cap_s,
+            "chunk_size": self._chunk,
+            "dedup_factor": self._dedup_factor,
+            "bucket_slack": self._bucket_slack,
+            "exchange_bucket_lanes": (
+                0 if self._n == 1 else self._bucket_lanes()
+            ),
+            "u_lanes": self._wl_cand_lanes(),
+            "waves_per_call": self._waves_per_call,
+        }
+
+    @staticmethod
+    def _skew(arr) -> float:
+        m = float(np.asarray(arr, np.float64).mean())
+        return round(float(np.asarray(arr).max()) / m, 4) if m > 0 else 1.0
+
+    def _update_shard_metrics(self, frontier, unique_l, cand) -> None:
+        """Per-shard gauges + max/mean skew, refreshed from the stats
+        readback the loop already holds (never an extra device sync):
+        ``shard_frontier`` (remaining frontier backlog), ``shard_unique``
+        (owner-table inserts), ``shard_exchange_bytes`` (cumulative
+        useful exchange payload contributed) — each a flat numeric dict,
+        which obs/prometheus.py renders as ONE labeled gauge family per
+        name — plus the scalar ``*_skew_max_over_mean`` gauges the
+        ROADMAP #2/#3 load-balance story watches
+        (docs/OBSERVABILITY.md "Density and skew telemetry")."""
+        w3 = (self._compiled.state_width + 3) * 4
+        xbytes = [
+            0 if self._n == 1 else int(c) * w3 for c in cand
+        ]
+        self._metrics.update(
+            shard_frontier={
+                str(d): int(frontier[d]) for d in range(self._n)
+            },
+            shard_unique={
+                str(d): int(unique_l[d]) for d in range(self._n)
+            },
+            shard_exchange_bytes={
+                str(d): xbytes[d] for d in range(self._n)
+            },
+            frontier_skew_max_over_mean=self._skew(frontier),
+            unique_skew_max_over_mean=self._skew(unique_l),
+            exchange_skew_max_over_mean=self._skew(cand),
+        )
 
     def _wl_write_checkpoint(self, carry) -> dict:
         self._write_snapshot(

@@ -106,7 +106,124 @@ def two_phase_capable(cm) -> bool:
     return cm.boundary(np.zeros((cm.state_width,), np.uint32)) is None
 
 
-def cached_program(cache: dict, max_size: int, key, build):
+# --- compile observability (docs/OBSERVABILITY.md "Compile events") ----------
+#
+# A program-cache MISS is the recompile event the serving layer's
+# warm-start story hinges on; these knobs turn misses into attributable
+# evidence: each compiled program's FIRST invocation is timed (JAX
+# compiles lazily at first call, so that wall time is compile + first
+# execution — an upper bound on compile cost, documented as such), the
+# knobs that formed the cache key travel as ``provenance`` on the
+# journaled ``compile`` event, and a burst of misses inside the storm
+# window raises a ``recompile_storms`` counter + a storm-flagged journal
+# event (the `watch` verb and CI smoke alert on it).  A storm means the
+# key is churning — knob defaults moving under a warm cache, or a
+# geometry ladder thrashing — exactly the condition that silently eats a
+# "warm" daemon's latency budget.
+COMPILE_STORM_WINDOW_SEC = 120.0
+COMPILE_STORM_THRESHOLD = 6
+_COMPILE_TIMES: list = []  # monotonic stamps of recent first-call compiles
+_STORM_ACTIVE = [False]
+
+
+def _note_compile(now: float) -> bool:
+    """Fold one compile stamp into the storm window; True exactly at the
+    rising edge (quiet -> storm), so the counter counts storms, not
+    compiles."""
+    _COMPILE_TIMES.append(now)
+    cutoff = now - COMPILE_STORM_WINDOW_SEC
+    while _COMPILE_TIMES and _COMPILE_TIMES[0] < cutoff:
+        _COMPILE_TIMES.pop(0)
+    in_storm = len(_COMPILE_TIMES) >= COMPILE_STORM_THRESHOLD
+    rising = in_storm and not _STORM_ACTIVE[0]
+    _STORM_ACTIVE[0] = in_storm
+    return rising
+
+
+# Per-cache-entry instrumentation context, REFRESHED on every
+# cached_program access (hit or miss) so a wrapper's deferred first
+# call attributes the compile to the engine that actually invoked it —
+# the builder's journal is never captured permanently (an engine that
+# builds but dies before invoking must not receive a later caller's
+# compile event into its finished run's record).  Keyed by
+# (id(cache), key); entries evicted in lockstep with the cache.
+_PROGRAM_CTX: dict = {}
+
+
+def _record_compile(ctx, sublabel, sec) -> None:
+    import logging
+    import time
+
+    from ..obs.metrics import GLOBAL, LATENCY_BUCKETS
+
+    label = f"{ctx.get('label', 'program')}{sublabel}"
+    GLOBAL.inc("compile_sec_total", sec)
+    GLOBAL.set("last_compile_sec", round(sec, 4))
+    GLOBAL.observe("compile_sec", sec, boundaries=LATENCY_BUCKETS)
+    storm = _note_compile(time.monotonic())
+    if storm:
+        GLOBAL.inc("recompile_storms")
+        logging.getLogger(__name__).warning(
+            "recompile storm: %d compiles within %.0fs (latest: %s) — "
+            "a program-cache key is churning",
+            len(_COMPILE_TIMES), COMPILE_STORM_WINDOW_SEC, label,
+        )
+    journal = ctx.get("journal")
+    if journal is not None:
+        fields = {"label": label, "sec": round(sec, 4),
+                  "cache_size": ctx.get("cache_size", 0)}
+        if ctx.get("provenance"):
+            fields["provenance"] = ctx["provenance"]
+        if storm:
+            fields["storm"] = True
+            fields["storm_compiles"] = len(_COMPILE_TIMES)
+        journal.append("compile", **fields)
+
+
+def _timed_first_call(fn, sublabel, ctx):
+    """Wrap one compiled callable so its FIRST invocation — where JAX
+    actually traces + lowers + compiles — is timed and recorded; later
+    calls pay one flag check.  ``ctx`` is the live per-cache-entry
+    context (journal/label/provenance), read at FIRE time."""
+    import time
+    from functools import wraps
+
+    state = [True]
+
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        if state[0]:
+            state[0] = False
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            _record_compile(ctx, sublabel, time.perf_counter() - t0)
+            return out
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _instrument_programs(prog, ctx):
+    """Wrap every callable in a program (a bare callable, a tuple like
+    the single-chip ``(seed, run)`` pair, or the traced-mode dict) —
+    each is a distinct XLA program with its own compile."""
+    if callable(prog):
+        return _timed_first_call(prog, "", ctx)
+    if isinstance(prog, tuple):
+        return tuple(
+            _timed_first_call(p, f"[{i}]", ctx) if callable(p) else p
+            for i, p in enumerate(prog)
+        )
+    if isinstance(prog, dict):
+        return {
+            k: _timed_first_call(p, f".{k}", ctx) if callable(p) else p
+            for k, p in prog.items()
+        }
+    return prog
+
+
+def cached_program(cache: dict, max_size: int, key, build,
+                   label: str = "program", journal=None, provenance=None):
     """Bounded-FIFO memo for compiled engine programs, shared by the
     single-chip and sharded engines so the key-tuple + eviction idiom
     exists once.  The KEY must cover everything the built closure traces
@@ -115,15 +232,32 @@ def cached_program(cache: dict, max_size: int, key, build):
     Hits and misses count into the process-global metrics registry
     (``program_cache_hits`` / ``program_cache_misses``): the observable
     evidence that a warm repeat of a workload skipped its compiles —
-    the checking service's warmup-reuse counter (docs/SERVING.md)."""
+    the checking service's warmup-reuse counter (docs/SERVING.md).
+
+    A miss additionally records COMPILE observability (the helpers
+    above): each built callable's first invocation is timed, journaled
+    as a ``compile`` event carrying ``label`` and ``provenance`` (the
+    human-readable knobs behind the cache key), folded into the
+    process-global ``compile_sec_total``/``compile_sec`` metrics, and
+    watched by the recompile-storm detector.  ``journal``/``label``/
+    ``provenance`` refresh the entry's live context on EVERY access —
+    hits included — so a deferred first call journals into the engine
+    that actually invoked (and paid for) the compile, never a dead
+    builder's record; hits journal nothing themselves (a hit is the
+    warm path the evidence exists to prove)."""
     from ..obs.metrics import GLOBAL
 
+    ctx = _PROGRAM_CTX.setdefault((id(cache), key), {})
+    ctx.update(label=label, journal=journal, provenance=provenance)
     prog = cache.get(key)
     if prog is None:
         GLOBAL.inc("program_cache_misses")
-        prog = build()
+        ctx["cache_size"] = len(cache) + 1
+        prog = _instrument_programs(build(), ctx)
         while len(cache) >= max_size:
-            cache.pop(next(iter(cache)))
+            evicted = next(iter(cache))
+            cache.pop(evicted)
+            _PROGRAM_CTX.pop((id(cache), evicted), None)
         cache[key] = prog
     else:
         GLOBAL.inc("program_cache_hits")

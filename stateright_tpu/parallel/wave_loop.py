@@ -142,25 +142,50 @@ class LoopVitals:
       (:func:`log_grow`) counts only ACTUAL geometry changes: a
       recovery that re-runs without growing (the tiered engine's
       spill-instead-of-grow) moves ``overflow_retries`` but not
-      ``grows``.
+      ``grows``;
+    - ``valid_density_ema`` gauge + ``valid_density`` histogram — the
+      measured per-wave VALID-candidate count as a fraction of the
+      worst-case compaction/dedup ``U`` buffer (``cand_lanes``).  The
+      numerator is the quantum's ``state_count`` delta divided by its
+      wave count: ``state_count`` advances by exactly the
+      boundary-passing valid successors each committed wave
+      (wave_common.wave_eval's ``generated``), so the density needs NO
+      extra readback — the fused program stays byte-for-byte pinned.
+      Bounded at 1.0 by construction: the flag-4 overflow criterion
+      fires on the SAME valid-lane count (hashset.compact_valid — "a
+      stricter criterion than distinct keys"), so a committed wave's
+      numerator can never exceed its ``U`` buffer.  This is the number
+      the dedup-geometry ladder (ROADMAP #1) sizes against, and what
+      the report advisor (obs/report.py) reads back out of the journal;
+    - ``table_load_factor`` histogram (``load_factor``) — the hot-table
+      load trajectory, one observation per committed quantum (the gauge
+      form already rides ``table_occupancy``).
     """
 
     EMA_ALPHA = 0.3
 
-    def __init__(self, registry, initial_unique: Optional[int] = None):
-        from ..obs.metrics import COUNT_BUCKETS, LATENCY_BUCKETS
+    def __init__(self, registry, initial_unique: Optional[int] = None,
+                 initial_states: Optional[int] = None):
+        from ..obs.metrics import (
+            COUNT_BUCKETS, FRACTION_BUCKETS, LATENCY_BUCKETS,
+        )
 
         self._reg = registry
         self._latency_buckets = LATENCY_BUCKETS
         self._count_buckets = COUNT_BUCKETS
+        self._fraction_buckets = FRACTION_BUCKETS
         self._uniq_ema: Optional[float] = None
         self._wave_ema: Optional[float] = None
+        self._density_ema: Optional[float] = None
+        self.last_density: Optional[float] = None
         # Baseline for the first quantum's uniq/s delta: the unique
         # count already committed before the loop starts (init seeding,
         # or a resumed snapshot's count — which must not read as "found
         # this call").  None = unknown; the first quantum then only
-        # primes the baseline.
+        # primes the baseline.  ``initial_states`` is the same baseline
+        # for the density's generated-successors delta.
         self._last_unique = initial_unique
+        self._last_states = initial_states
         self._waves_since_grow = 0
         self._host_mark: Optional[float] = None
         self._reg.inc("host_sec_total", 0.0)  # key exists from wave 0
@@ -186,17 +211,46 @@ class LoopVitals:
 
     def record_quantum(
         self, call_sec: float, waves: int, unique: int, committed: bool,
+        states: Optional[int] = None, cand_lanes: Optional[int] = None,
+        occupancy: Optional[float] = None,
     ) -> None:
         """Fold one device-call quantum into the vitals.  Aborted
         (flagged) quanta count latency but not rates: their unique delta
-        is zero by construction and would drag the EMA to the floor."""
+        is zero by construction and would drag the EMA to the floor.
+        ``states``/``cand_lanes`` feed the density telemetry (see the
+        class docstring), ``occupancy`` the load-factor trajectory."""
         waves = max(1, int(waves))
         self._reg.observe(
             "wave_latency_sec", call_sec / waves, count=waves,
             boundaries=self._latency_buckets,
         )
+        self.last_density = None  # stale density must not journal on abort
         if not committed:
             return
+        if occupancy is not None:
+            self._reg.observe(
+                "load_factor", occupancy,
+                boundaries=self._fraction_buckets,
+            )
+        if states is not None and cand_lanes:
+            if self._last_states is not None:
+                density = (
+                    max(0, states - self._last_states) / waves / cand_lanes
+                )
+                self.last_density = density
+                self._density_ema = (
+                    density if self._density_ema is None
+                    else self._density_ema
+                    + self.EMA_ALPHA * (density - self._density_ema)
+                )
+                self._reg.observe(
+                    "valid_density", density, count=waves,
+                    boundaries=self._fraction_buckets,
+                )
+                self._reg.update(
+                    valid_density_ema=round(self._density_ema, 6),
+                )
+            self._last_states = states
         self._waves_since_grow += waves
         if call_sec > 0:
             wave_rate = waves / call_sec
@@ -228,6 +282,18 @@ class LoopVitals:
             boundaries=self._count_buckets,
         )
         self._waves_since_grow = 0
+
+
+def journal_geometry(eng) -> None:
+    """One ``geometry`` journal event at loop start (fused and traced
+    alike): the engine's live geometry knobs plus the worst-case
+    candidate-lane denominator the density telemetry divides by —
+    everything the report advisor (obs/report.py) needs to turn measured
+    densities back into recommended knobs.  Engines expose it via the
+    optional ``_wl_geometry()`` hook."""
+    geom = getattr(eng, "_wl_geometry", None)
+    if eng._journal and geom is not None:
+        eng._journal.append("geometry", **geom())
 
 
 class WaveView(NamedTuple):
@@ -311,8 +377,11 @@ class FusedWaveLoop:
         eng = self.eng
         cadence = CheckpointCadence(eng._ckpt_every_waves, eng._ckpt_every_sec)
         vitals = LoopVitals(
-            eng._metrics, initial_unique=getattr(eng, "_unique_count", None)
+            eng._metrics,
+            initial_unique=getattr(eng, "_unique_count", None),
+            initial_states=getattr(eng, "_state_count", None),
         )
+        journal_geometry(eng)
         waves_total = 0
         while True:
             t_call = time.monotonic()
@@ -322,9 +391,13 @@ class FusedWaveLoop:
             t_done = time.monotonic()
             call_sec = t_done - t_call
             vitals.call_ended(t_done)
+            cand_lanes = getattr(eng, "_wl_cand_lanes", None)
             vitals.record_quantum(
                 call_sec, view.waves_this_call, view.unique,
                 committed=view.flags == 0,
+                states=view.states,
+                cand_lanes=cand_lanes() if cand_lanes is not None else None,
+                occupancy=view.occupancy,
             )
             waves_total += view.waves_this_call
             with eng._lock:
@@ -344,6 +417,10 @@ class FusedWaveLoop:
                     flags=view.flags,
                     call_sec=round(call_sec, 4),
                     occupancy=round(view.occupancy, 6),
+                    **(
+                        {"density": round(vitals.last_density, 6)}
+                        if vitals.last_density is not None else {}
+                    ),
                     **view.extra,
                 )
             eng._metrics.update(

@@ -750,8 +750,25 @@ class TpuChecker(Checker):
         from .wave_common import cached_program
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_run,
+            label=f"{type(self).__name__}.fused",
+            journal=self._journal,
+            provenance=self._key_provenance(),
         )
+
+    def _key_provenance(self) -> dict:
+        """The human-readable knobs behind the program-cache keys — what
+        a journaled ``compile`` event says CHANGED when a warm daemon
+        recompiles (docs/OBSERVABILITY.md "Compile events")."""
+        return {
+            "model": type(self._compiled).__name__,
+            "capacity": self._capacity,
+            "log_capacity": self._log_capacity,
+            "max_frontier": self._max_frontier,
+            "dedup_factor": self._dedup_factor,
+            "waves_per_call": self._waves_per_call,
+            "symmetry": self._canon is not None,
+        }
 
     # --- host loop -----------------------------------------------------------
 
@@ -1131,6 +1148,33 @@ class TpuChecker(Checker):
     def _wl_discovered_names(self):
         return self._discovery_slots
 
+    def _wl_cand_lanes(self) -> int:
+        """The worst-case compaction/dedup buffer width ``U`` — the
+        denominator of the density telemetry (wave_loop.LoopVitals):
+        measured valid candidates per wave over THIS is the fraction of
+        the sort/compact work that touches live lanes.  Queried per
+        quantum because auto-grow may relax the geometry mid-run."""
+        from .hashset import unique_buffer_size
+
+        return unique_buffer_size(
+            self._max_frontier * self._compiled.max_actions,
+            self._dedup_factor,
+        )
+
+    def _wl_geometry(self) -> dict:
+        """The ``geometry`` journal event's payload (wave_loop.
+        journal_geometry): live knobs + the density denominator, the
+        advisor's ground truth for this run."""
+        return {
+            "engine": "tpu-wavefront",
+            "capacity": self._capacity,
+            "log_capacity": self._log_capacity,
+            "max_frontier": self._max_frontier,
+            "dedup_factor": self._dedup_factor,
+            "u_lanes": self._wl_cand_lanes(),
+            "waves_per_call": self._waves_per_call,
+        }
+
     def _wl_write_checkpoint(self, carry) -> dict:
         stats_h = self._last_stats_h
         self._write_snapshot(
@@ -1210,7 +1254,10 @@ class TpuChecker(Checker):
         from .wave_common import cached_program
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, self._build_traced,
+            label=f"{type(self).__name__}.traced",
+            journal=self._journal,
+            provenance=self._key_provenance(),
         )
 
     def _build_traced(self):
@@ -1424,14 +1471,16 @@ class TpuChecker(Checker):
                 self._state_count = n_init
                 self._unique_count = int(stats_h[STAT_UNIQUE])
 
-            # Always-on vitals (latency histogram, uniq/s EMA, grow
-            # counters) — same registry keys as the fused loop's, so
-            # /.metrics readers see one schema in either mode.
-            from .wave_loop import LoopVitals
+            # Always-on vitals (latency histogram, uniq/s EMA, density,
+            # grow counters) — same registry keys as the fused loop's,
+            # so /.metrics readers see one schema in either mode.
+            from .wave_loop import LoopVitals, journal_geometry
 
             vitals = LoopVitals(
-                self._metrics, initial_unique=self._unique_count
+                self._metrics, initial_unique=self._unique_count,
+                initial_states=self._state_count,
             )
+            journal_geometry(self)
             wave_idx = 0
             while level_start < level_end:
                 if target_depth and depth >= target_depth - 1:
@@ -1576,6 +1625,13 @@ class TpuChecker(Checker):
                     phases, self._traced_wave_bytes(rounds, two_phase),
                     probe_rounds=rounds,
                 )
+                vitals.record_quantum(
+                    t5 - t0, 1, self._unique_count, committed=True,
+                    states=self._state_count,
+                    cand_lanes=self._wl_cand_lanes(),
+                    occupancy=self._unique_count / cap,
+                )
+                vitals.record_host(phases["readback"])
                 if self._journal:
                     self._journal.append(
                         "wave",
@@ -1588,6 +1644,10 @@ class TpuChecker(Checker):
                         flags=0,
                         call_sec=round(t5 - t0, 6),
                         occupancy=round(self._unique_count / cap, 6),
+                        **(
+                            {"density": round(vitals.last_density, 6)}
+                            if vitals.last_density is not None else {}
+                        ),
                         **enrich,
                     )
                 self._metrics.update(
@@ -1597,10 +1657,6 @@ class TpuChecker(Checker):
                 )
                 self._metrics.inc("device_call_sec_total", t5 - t0)
                 self._metrics.inc("device_calls", 1)
-                vitals.record_quantum(
-                    t5 - t0, 1, self._unique_count, committed=True
-                )
-                vitals.record_host(phases["readback"])
 
                 # Shared termination tail (wave_loop.py): the same
                 # predicate order as the fused loop by construction.
@@ -1899,7 +1955,11 @@ class TpuChecker(Checker):
             return rehash_chunk
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+            label=f"{type(self).__name__}.rehash",
+            journal=self._journal,
+            provenance={"capacity": self._capacity,
+                        "max_frontier": self._max_frontier},
         )
 
     def _rehash(self, rows, tail_h: int, start_h: int = 0):
@@ -1983,7 +2043,10 @@ class TpuChecker(Checker):
             return chain
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+            label=f"{type(self).__name__}.chain",
+            journal=self._journal,
+            provenance={"length": length},
         )
 
     def _slot_path(self, slot: int) -> Path:

@@ -11,7 +11,10 @@ per-call deadline is declared hung and restarted from the last checkpoint.
 Event schema (full field lists in docs/RUNTIME.md): every event carries
 ``t`` (unix wall time, float seconds) and ``event`` (a string tag).
 Engine events: ``resume``, ``wave``, ``checkpoint``, ``grow``,
-``engine_done``, and — traced runs only — ``trace_summary``.  Under
+``geometry`` (the run's live knobs, once per loop start), ``compile``
+(program-cache misses with first-call timing + key provenance,
+parallel/wave_common.py), ``engine_done``, and — traced runs only —
+``trace_summary``.  Under
 ``trace=True`` each ``wave`` event is enriched with ``wave_breakdown``
 (per-phase seconds), ``bytes`` (modeled bytes touched), and
 ``hbm_util_frac`` (plus measured ``exchange_payload_bytes`` /
@@ -153,7 +156,19 @@ def _segment_paths(path: str) -> List[str]:
 def read_journal(path: str) -> List[Dict]:
     """Parse a journal file into a list of event dicts, merging rotated
     segments (oldest first) when present.  Tolerates a torn trailing
-    line (a writer killed mid-``write``).
+    line (a writer killed mid-``write``); see
+    :func:`read_journal_stats` for the skip count."""
+    return read_journal_stats(path)[0]
+
+
+def read_journal_stats(path: str):
+    """Like :func:`read_journal`, but also returns how many lines were
+    SKIPPED as torn/garbled (undecodable JSON, or a truncation that
+    still parses but is not an event object — ``{"t": 17`` torn after
+    the value decodes as the integer 17).  Consumers that summarize a
+    crashed run's journal (obs/report.py, the ``watch`` verb) surface
+    the count as a warning instead of silently absorbing — or worse,
+    crashing on — the torn tail.
 
     A rollover landing BETWEEN the segment listing and the reads would
     silently skip the segment whose name shifted, so the read is
@@ -162,9 +177,11 @@ def read_journal(path: str) -> List[Dict]:
     ``max_bytes`` of appends, so two consecutive passes racing distinct
     rollovers is already pathological)."""
     events: List[Dict] = []
+    skipped = 0
     for _ in range(3):
         segs = _segment_paths(str(path))
         events = []
+        skipped = 0
         for seg in segs:
             try:
                 with open(seg, "r", encoding="utf-8") as fh:
@@ -173,14 +190,19 @@ def read_journal(path: str) -> List[Dict]:
                         if not line:
                             continue
                         try:
-                            events.append(json.loads(line))
+                            rec = json.loads(line)
                         except json.JSONDecodeError:
-                            continue  # torn tail from a killed writer
+                            skipped += 1  # torn tail from a killed writer
+                            continue
+                        if not isinstance(rec, dict):
+                            skipped += 1  # truncation that still parses
+                            continue
+                        events.append(rec)
             except FileNotFoundError:
                 continue  # racing a rollover; the re-check below catches it
         if _segment_paths(str(path)) == segs:
             break
-    return events
+    return events, skipped
 
 
 def last_event(path: str, event: Optional[str] = None) -> Optional[Dict]:

@@ -182,7 +182,12 @@ class Job:
         self._finished = threading.Event()
 
     def snapshot(self) -> dict:
-        """JSON view served by ``GET /jobs/<id>``."""
+        """JSON view served by ``GET /jobs/<id>``.  While the job is
+        RUNNING and its checker is attached, a ``vitals`` key carries
+        the engine's live counters (``Checker.metrics()`` is documented
+        mid-run-safe — it reads already-synced scalars, never the
+        device), so a client watching one job no longer needs the
+        aggregated ``/.metrics`` to see whether ITS check is moving."""
         out = {
             "id": self.id,
             "state": self.state,
@@ -193,6 +198,12 @@ class Job:
             "result": self.result,
             "error": self.error,
         }
+        if self.state == RUNNING and self.checker is not None:
+            from ..obs.metrics import vitals_view
+
+            vitals = vitals_view(self.checker)
+            if vitals is not None:
+                out["vitals"] = vitals
         if self.explorer_address is not None:
             out["explorer_address"] = list(self.explorer_address)
         return out

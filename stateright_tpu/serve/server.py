@@ -97,7 +97,11 @@ class CheckService:
         # scheduler's span histograms plus the derived operator gauges —
         # queue p95 straight off the wait histogram, and the
         # warm-vs-cold start ratio off the knob-cache counters.
-        hists = self.scheduler.metrics.snapshot_histograms()
+        # Process-global histograms ride along too — ``compile_sec``
+        # (wave_common.cached_program's first-call compile timings) is
+        # the distribution behind the warm-start evidence.
+        hists = dict(GLOBAL.snapshot_histograms())
+        hists.update(self.scheduler.metrics.snapshot_histograms())
         if hists:
             out["histograms"] = hists
             qw = hists.get("job_queue_wait_sec")

@@ -251,7 +251,10 @@ class TieredTpuChecker(TpuChecker):
             return {"query": query, "probe": probe, "fresh": fresh_of}
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+            label="TieredTpuChecker.cold",
+            journal=self._journal,
+            provenance={"u_lanes": u_sz, "cold_chunk": chunk},
         )
 
     def _cold_filter(self, hi, lo, u_new, u_origin, n_new_hot):
@@ -530,7 +533,10 @@ class TieredTpuChecker(TpuChecker):
             return seg_fp
 
         return cached_program(
-            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build
+            _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+            label="TieredTpuChecker.segfp",
+            journal=self._journal,
+            provenance={"max_frontier": r},
         )
 
     def _segment_fingerprints(self, rows, start: int, end: int):
@@ -851,6 +857,14 @@ class TieredTpuChecker(TpuChecker):
         unique count would silently un-tier the workload)."""
         out = super().tuned_kwargs()
         out["capacity"] = self._capacity
+        return out
+
+    def _wl_geometry(self) -> dict:
+        out = super()._wl_geometry()
+        out["engine"] = "tpu-tiered"
+        out["spill_threshold"] = self._spill_threshold
+        if self._memory_budget_mb is not None:
+            out["memory_budget_mb"] = self._memory_budget_mb
         return out
 
     def metrics(self) -> dict:

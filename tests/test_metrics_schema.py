@@ -41,6 +41,11 @@ GUARANTEED = {
     "table_load_factor": (int, float),
     "program_cache_hits": int,
     "program_cache_misses": int,
+    # Compile observability (ISSUE 11): the process-global first-call
+    # compile time + storm counter ride the guaranteed schema so one
+    # scrape answers "is this process recompiling / thrashing".
+    "compile_sec_total": (int, float),
+    "recompile_storms": int,
 }
 
 
@@ -122,6 +127,38 @@ def test_fused_untraced_run_reports_vitals():
     assert m["host_sec_total"] >= 0
     assert m["device_call_sec_total"] > 0
     assert m["table_load_factor"] > 0
+    # Density telemetry (ISSUE 11): the valid-candidates-vs-U-buffer
+    # fraction, as EMA gauge + histogram, and the load-factor
+    # trajectory — all on the untouched fused path.
+    assert 0 < m["valid_density_ema"] <= 1.0
+    assert m["histograms"]["valid_density"]["count"] > 0
+    assert m["histograms"]["load_factor"]["count"] > 0
+
+
+def test_every_device_engine_reports_density_keys():
+    """The acceptance bar: every engine's metrics() reports the new
+    density keys — single-chip, sharded (with per-shard skew), and
+    tiered."""
+    model = TwoPhaseSys(rm_count=3)
+    tpu = model.checker().spawn_tpu(
+        capacity=1 << 14, max_frontier=1 << 9, device=_cpu(),
+    ).join()
+    mesh = jax.sharding.Mesh(np.array(jax.devices("cpu")[:2]), ("shards",))
+    sharded = model.checker().spawn_tpu_sharded(
+        mesh=mesh, capacity=1 << 12, chunk_size=1 << 6,
+    ).join()
+    tiered = model.checker().spawn_tpu_tiered(
+        capacity=512, max_frontier=1 << 6,
+    ).join()
+    for who, m in (("tpu", tpu.metrics()), ("sharded", sharded.metrics()),
+                   ("tiered", tiered.metrics())):
+        assert 0 < m["valid_density_ema"] <= 1.0, who
+        assert m["histograms"]["valid_density"]["count"] > 0, who
+        assert m["histograms"]["load_factor"]["count"] > 0, who
+    sm = sharded.metrics()
+    assert set(sm["shard_unique"]) == {"0", "1"}
+    assert sm["unique_skew_max_over_mean"] >= 1.0
+    json.dumps(sm)
 
 
 def test_forced_grow_records_waves_per_grow_histogram():

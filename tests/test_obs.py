@@ -387,3 +387,156 @@ def test_sharded_metrics_include_accounting():
     assert m["shards"] == 2
     assert m["accounting"]["waves"] >= 1
     assert "exchange_occupancy" in m["accounting"]
+
+
+# --- density telemetry + compile observability (ISSUE 11) ---------------------
+
+
+def test_untraced_run_reports_density_and_geometry_event(tmp_path):
+    """trace=False (fused program pinned, no extra syncs — the golden
+    test above stays green): metrics() still carries the density EMA +
+    histogram and the load-factor trajectory, and the journal gains one
+    ``geometry`` event plus a per-quantum ``density`` field."""
+    journal = str(tmp_path / "journal.jsonl")
+    tpu = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 9, device=_cpu(),
+            journal=journal,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+    m = tpu.metrics()
+    assert 0 < m["valid_density_ema"] <= 1.0
+    dh = m["histograms"]["valid_density"]
+    assert dh["count"] > 0
+    lf = m["histograms"]["load_factor"]
+    assert lf["count"] > 0
+    evs = read_journal(journal)
+    geo = [e for e in evs if e["event"] == "geometry"]
+    assert len(geo) == 1
+    assert geo[0]["engine"] == "tpu-wavefront"
+    assert geo[0]["u_lanes"] > 0 and geo[0]["dedup_factor"] == 8
+    waves = [e for e in evs if e["event"] == "wave"]
+    assert len(waves) == 1  # the no-extra-syncs pin, restated
+    assert 0 < waves[0]["density"] <= 1.0
+
+
+def test_traced_run_journals_density_per_wave(tmp_path):
+    journal = str(tmp_path / "journal.jsonl")
+    tpu = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 9, device=_cpu(),
+            trace=True, journal=journal,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+    waves = [e for e in read_journal(journal) if e["event"] == "wave"]
+    assert waves and all(0 <= w["density"] <= 1.0 for w in waves)
+    assert any(e["event"] == "geometry"
+               for e in read_journal(journal))
+
+
+def test_sharded_per_shard_gauges_and_skew(tmp_path):
+    """The fused sharded loop exports per-shard frontier/insert/
+    exchange gauges with a max/mean skew — derived from the stats
+    readback it already holds (no extra syncs) — and the Prometheus
+    exposition renders them as labeled families that validate."""
+    from stateright_tpu.obs.prometheus import (
+        parse_prometheus, render_prometheus,
+    )
+
+    journal = str(tmp_path / "journal.jsonl")
+    sh = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sharded(
+            mesh=_mesh(4), capacity=1 << 14, chunk_size=1 << 8,
+            journal=journal,
+        )
+        .join()
+    )
+    assert sh.unique_state_count() == 288
+    m = sh.metrics()
+    for fam in ("shard_frontier", "shard_unique", "shard_exchange_bytes"):
+        assert set(m[fam]) == {"0", "1", "2", "3"}, fam
+    assert sum(m["shard_unique"].values()) == 288
+    for skew in ("frontier_skew_max_over_mean", "unique_skew_max_over_mean",
+                 "exchange_skew_max_over_mean"):
+        assert m[skew] >= 1.0, skew
+    # Hash ownership balances statically: skew stays near 1 on a
+    # non-adversarial model.
+    assert m["unique_skew_max_over_mean"] < 2.0
+    assert 0 < m["valid_density_ema"] <= 1.0
+    fams = parse_prometheus(render_prometheus(m))
+    per_shard = fams["stateright_shard_unique"]
+    assert {labels["key"] for _n, labels, _v in per_shard["samples"]} == {
+        "0", "1", "2", "3",
+    }
+    geo = [e for e in read_journal(journal) if e["event"] == "geometry"]
+    assert geo and geo[0]["shards"] == 4 and geo[0]["bucket_slack"] == 50
+
+
+def test_compile_events_carry_label_provenance_and_timing(tmp_path):
+    """A program-cache miss journals a ``compile`` event per built XLA
+    program (first-call timed) with the key provenance, and the
+    process-global compile metrics move."""
+    from stateright_tpu.obs.metrics import GLOBAL
+
+    journal = str(tmp_path / "journal.jsonl")
+    before = float(GLOBAL.get("compile_sec_total", 0.0))
+    tpu = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 9, device=_cpu(),
+            journal=journal, waves_per_call=5,  # unusual key: forced miss
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+    compiles = [
+        e for e in read_journal(journal) if e["event"] == "compile"
+    ]
+    assert len(compiles) >= 2  # the (seed, run) pair at least
+    labels = {c["label"] for c in compiles}
+    assert any(lb.startswith("TpuChecker.fused") for lb in labels)
+    for c in compiles:
+        assert c["sec"] >= 0
+        assert c["provenance"]["waves_per_call"] == 5
+        assert c["provenance"]["capacity"] == 1 << 14
+    m = tpu.metrics()
+    assert m["compile_sec_total"] >= before
+    assert isinstance(m["recompile_storms"], int)
+
+
+def test_recompile_storm_detector_rising_edge():
+    """The storm detector fires once at the quiet->storm edge, not per
+    compile, and resets when the window drains."""
+    from stateright_tpu.parallel import wave_common as wc
+
+    saved = (list(wc._COMPILE_TIMES), wc._STORM_ACTIVE[0])
+    wc._COMPILE_TIMES.clear()
+    wc._STORM_ACTIVE[0] = False
+    try:
+        t0 = 1000.0
+        edges = [
+            wc._note_compile(t0 + i) for i in range(wc.COMPILE_STORM_THRESHOLD)
+        ]
+        assert edges.count(True) == 1 and edges[-1] is True
+        assert wc._note_compile(t0 + 10) is False  # still in storm: no edge
+        # Window drains -> quiet -> a new burst fires a new edge.
+        far = t0 + wc.COMPILE_STORM_WINDOW_SEC + 100
+        assert wc._note_compile(far) is False
+        for i in range(wc.COMPILE_STORM_THRESHOLD - 2):
+            assert wc._note_compile(far + 1 + i) is False
+        assert wc._note_compile(far + 50) is True
+    finally:
+        wc._COMPILE_TIMES.clear()
+        wc._COMPILE_TIMES.extend(saved[0])
+        wc._STORM_ACTIVE[0] = saved[1]

@@ -336,3 +336,295 @@ def test_report_cli_verb_through_example_main(tmp_path, capsys):
     rc = example_main(cli_spec(), ["report", jpath])
     assert rc == 0
     assert "Run report" in capsys.readouterr().out
+
+
+# --- histogram edge cases (obs/metrics.Histogram) -----------------------------
+
+
+def test_histogram_empty_percentile_readback():
+    """An empty histogram reads back 0.0 quantiles (never a div-by-zero
+    or an invented value) and a well-formed snapshot."""
+    h = Histogram((1.0, 2.0))
+    assert h.quantile(0.5) == 0.0 and h.quantile(0.99) == 0.0
+    snap = h.snapshot()
+    assert snap["count"] == 0 and snap["sum"] == 0.0
+    assert snap["p50"] == snap["p95"] == snap["p99"] == 0.0
+    assert snap["counts"] == [0, 0, 0]
+
+
+def test_histogram_single_boundary_ladder():
+    """A one-boundary ladder: two buckets ((-inf..b], +Inf); quantiles
+    interpolate from 0 inside the finite bucket and report the boundary
+    for the +Inf tail."""
+    h = Histogram((10.0,))
+    h.observe(4.0, count=2)
+    assert h.counts == [2, 0]
+    assert 0.0 <= h.quantile(0.5) <= 10.0
+    h.observe(100.0)  # lands in +Inf
+    assert h.counts == [2, 1]
+    assert h.quantile(0.99) == 10.0  # +Inf reports its lower bound
+
+
+def test_histogram_weighted_observations_straddling_inf():
+    """Weighted observations split across the last finite bucket and
+    the +Inf tail: counts, sum, and quantiles stay consistent."""
+    h = Histogram((1.0,))
+    h.observe(0.5, count=3)
+    h.observe(5.0, count=7)  # +Inf bucket, weighted
+    assert h.count == 10
+    assert h.counts == [3, 7]
+    assert h.sum == pytest.approx(0.5 * 3 + 5.0 * 7)
+    # rank(0.5)=5 falls inside +Inf -> its lower bound, the last
+    # finite boundary.
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.2) == pytest.approx(1.0 * (2 / 3), abs=1e-9)
+    snap = h.snapshot()
+    assert snap["p50"] == 1.0 and snap["p99"] == 1.0
+
+
+# --- labeled gauge families (per-shard series) --------------------------------
+
+
+def test_render_and_parse_labeled_gauge_families():
+    """Flat numeric dicts (the sharded engine's per-shard gauges) render
+    as ONE labeled family and validate."""
+    text = render_prometheus({
+        "shard_unique": {"0": 10, "1": 12},
+        "unique_skew_max_over_mean": 1.2,
+    })
+    fams = parse_prometheus(text)
+    fam = fams["stateright_shard_unique"]
+    assert fam["type"] == "gauge"
+    assert sorted(
+        (labels["key"], v) for _n, labels, v in fam["samples"]
+    ) == [("0", 10.0), ("1", 12.0)]
+
+
+def test_parse_prometheus_rejects_inconsistent_labeled_families():
+    # Mixed label-name sets within one family.
+    with pytest.raises(ExpositionError, match="mixes label sets"):
+        parse_prometheus(
+            "# TYPE g gauge\n"
+            'g{key="0"} 1\n'
+            'g{shard="1"} 2\n'
+        )
+    # Duplicate series (same name + label set twice).
+    with pytest.raises(ExpositionError, match="repeats series"):
+        parse_prometheus(
+            "# TYPE g gauge\n"
+            'g{key="0"} 1\n'
+            'g{key="0"} 2\n'
+        )
+
+
+# --- torn journal tails -------------------------------------------------------
+
+
+def test_report_tolerates_torn_final_journal_line(tmp_path):
+    """A crashed writer's torn tail — both an undecodable fragment and
+    a truncation that still parses as a bare JSON scalar — is skipped
+    with a report warning, never an exception (and never an
+    AttributeError on a non-dict event)."""
+    jpath = tmp_path / "journal.jsonl"
+    jpath.write_text(
+        json.dumps(_wave(1.0, 1, 100, 1, 0.5)) + "\n"
+        + json.dumps(_wave(2.0, 2, 250, 2, 0.5)) + "\n"
+        + '{"t": 3.0, "event": "wa'  # killed mid-os.write
+    )
+    rep = analyze_journal(str(jpath))
+    assert rep["waves"] == 2 and rep["unique"] == 250
+    assert any("torn" in w for w in rep["warnings"])
+    md = render_markdown(rep)
+    assert "⚠" in md and "torn" in md
+
+    # Truncation that still decodes — as a scalar, not an object.
+    with open(jpath, "a") as fh:
+        fh.write("\n17\n")
+    rep = analyze_journal(str(jpath))
+    assert rep["waves"] == 2
+    assert any("2 torn" in w for w in rep["warnings"])
+
+
+# --- geometry advisor ---------------------------------------------------------
+
+
+def test_advisor_recommends_dedup_rung_from_measured_density():
+    from stateright_tpu.obs.report import advise_geometry
+
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-wavefront",
+         "capacity": 1 << 20, "log_capacity": 1 << 20,
+         "max_frontier": 1 << 15, "dedup_factor": 8, "u_lanes": 425_984,
+         "waves_per_call": 256},
+    ]
+    for i in range(8):
+        events.append(_wave(
+            float(i + 1), i + 1, 10_000 * (i + 1), i, 0.5,
+            density=0.01 + 0.002 * i,  # peak 0.024
+        ))
+    adv = advise_geometry(events)
+    assert adv["measured"]["peak_density"] == pytest.approx(0.024)
+    # 1/(0.024*4) ~ 10.4x shrink -> dedup rung 8 -> 64 capped by the
+    # doubling-within-shrink rule: 8*2=16 <= 8*10.4, 16*2=32 <= 83,
+    # 32*2=64 <= 83 -> 64.
+    assert adv["recommended"]["dedup_factor"] == 64
+    assert adv["recommended"]["unique_buffer_lanes"] <= 425_984
+    assert adv["recommended"]["max_frontier"] == 1 << 15
+    assert adv["recommended"]["capacity"] >= 2 * 80_000
+
+    # An observed dedup overflow overrides: recommend the proven rung.
+    events.append({"t": 9.0, "event": "grow", "flags": 4,
+                   "grown": "dedup_factor=1"})
+    adv = advise_geometry(events)
+    assert adv["recommended"]["dedup_factor"] == 1
+    assert adv["notes"]
+
+
+def test_advisor_bucket_slack_consistent_with_bench_r06_rung():
+    """Acceptance pin: fed the measured paxos c=2 virtual-8 exchange
+    occupancies (BENCH_r06.json, the PR-8 bucketed-exchange round), the
+    advisor's recommended bucket_slack must equal the knob-cache rung
+    that round measured and persisted."""
+    import os
+
+    from stateright_tpu.obs.report import advise_geometry
+
+    r06_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r06.json",
+    )
+    with open(r06_path) as fh:
+        r06 = json.load(fh)["parsed"]["sharded_virtual8"]
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-sharded",
+         "shards": 8, "capacity_per_shard": 1 << 14,
+         "chunk_size": 1 << 11, "dedup_factor": 4,
+         "bucket_slack": r06["bucket_slack"],
+         "exchange_bucket_lanes": r06["exchange_bucket_lanes"],
+         "u_lanes": 8 * 16384, "waves_per_call": 1},
+    ]
+    for i in range(int(r06["waves"])):
+        # Per-wave occupancies around the round's measured mean, with a
+        # 2x peak wave — the shape the traced journal actually has.
+        occ = r06["exchange_occupancy"] * (2.0 if i == 3 else 1.0)
+        events.append(_wave(
+            float(i + 1), i + 1, 40_000 * (i + 1), i, 0.5,
+            density=0.005, exchange_occupancy=occ,
+        ))
+    adv = advise_geometry(events)
+    assert adv["recommended"]["bucket_slack"] == r06["bucket_slack"]
+    assert adv["measured"]["peak_exchange_occupancy"] == pytest.approx(
+        2 * r06["exchange_occupancy"]
+    )
+
+
+def test_advisor_bucket_slack_after_observed_overflow_ramp():
+    from stateright_tpu.obs.report import advise_geometry
+
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-sharded",
+         "shards": 4, "bucket_slack": 50, "dedup_factor": 4,
+         "chunk_size": 2048, "u_lanes": 4 * 16384},
+        {"t": 0.5, "event": "grow", "flags": 32,
+         "grown": "bucket_slack=100"},
+        {"t": 0.6, "event": "grow", "flags": 32,
+         "grown": "bucket_slack=200"},
+        _wave(1.0, 1, 1000, 1, 0.5, density=0.01,
+              exchange_occupancy=0.4),
+    ]
+    adv = advise_geometry(events)
+    assert adv["recommended"]["bucket_slack"] == 200
+    assert any("climbed" in n for n in adv["notes"])
+
+
+def test_advisor_lands_in_report_and_markdown():
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-wavefront",
+         "capacity": 4096, "max_frontier": 512, "dedup_factor": 8,
+         "u_lanes": 4096, "waves_per_call": 4},
+        _wave(1.0, 4, 500, 2, 0.5, density=0.05),
+        _wave(2.0, 8, 900, 4, 0.5, density=0.08),
+    ]
+    rep = analyze_journal(events)
+    assert "advisor" in rep
+    md = render_markdown(rep)
+    assert "Geometry advisor" in md and "dedup_factor" in md
+    json.dumps(rep)
+
+
+# --- the watch verb -----------------------------------------------------------
+
+
+def test_watch_summarize_run_journal():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-wavefront",
+         "u_lanes": 4096, "dedup_factor": 8},
+        _wave(1.0, 4, 500, 2, 0.5, density=0.03),
+        _wave(2.0, 8, 900, 4, 0.5, density=0.05),
+        {"t": 2.1, "event": "engine_done", "unique": 900},
+    ]
+    s = summarize_events(events)
+    assert s["unique"] == 900 and s["depth"] == 4
+    assert s["density"] == 0.05
+    assert s["uniq_per_sec"] == pytest.approx(400.0)
+    assert s["done"] is True
+    line = render_line(s)
+    assert "density=0.05" in line and "bottleneck=" in line
+    assert "done" in line
+
+
+def test_watch_flags_recompile_storm_and_torn_lines():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = [_wave(1.0, 1, 100, 1, 0.5)]
+    events += [
+        {"t": 1.0 + i * 0.1, "event": "compile", "label": f"p{i}",
+         "sec": 0.2}
+        for i in range(6)  # >= COMPILE_STORM_THRESHOLD inside the window
+    ]
+    s = summarize_events(events, skipped=1)
+    assert s["recompile_storm"] is True
+    line = render_line(s)
+    assert "recompile-storm" in line and "torn-lines=1" in line
+
+
+def test_watch_summarize_service_journal():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = [
+        {"t": 0.0, "event": "service_start", "workers": 1},
+        {"t": 0.1, "event": "job_submitted", "job": "job-1"},
+        {"t": 0.2, "event": "job_running", "job": "job-1"},
+        {"t": 0.3, "event": "job_submitted", "job": "job-2"},
+        {"t": 1.0, "event": "job_done", "job": "job-1"},
+    ]
+    s = summarize_events(events)
+    assert s["jobs"] == {"done": 1, "queued": 1}
+    assert "jobs" in render_line(s)
+
+
+def test_watch_once_cli_smoke(tmp_path, capsys):
+    """`watch <journal> --once` through the model CLI: one greppable
+    line with the density and bottleneck fields, rc 0; a missing
+    journal is rc 2."""
+    from stateright_tpu.cli import example_main
+    from stateright_tpu.models.twophase import cli_spec
+    from stateright_tpu.runtime.journal import Journal
+
+    jpath = str(tmp_path / "journal.jsonl")
+    with Journal(jpath) as j:
+        j.append("geometry", engine="tpu-wavefront", u_lanes=4096)
+        j.append("wave", waves=1, unique=5, depth=1, call_sec=0.1,
+                 occupancy=0.01, remaining=0, states=10, flags=0,
+                 density=0.002)
+        j.append("engine_done", unique=5)
+    rc = example_main(cli_spec(), ["watch", jpath, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "density=0.002" in out and "bottleneck=" in out
+    assert example_main(
+        cli_spec(), ["watch", str(tmp_path / "nope.jsonl"), "--once"]
+    ) == 2
+    assert example_main(cli_spec(), ["watch", "--once"]) == 2

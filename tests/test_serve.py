@@ -520,3 +520,80 @@ def test_service_journal_lines_never_tear_under_concurrent_writers(tmp_path):
         rec = json.loads(line)  # raises on any torn/interleaved line
         seen.add((rec["writer"], rec["i"]))
     assert len(seen) == writers * per
+
+
+def test_running_job_snapshot_carries_live_vitals():
+    """GET /jobs/{id} while RUNNING embeds the engine's live vitals
+    subset (obs/metrics.VITALS_KEYS) — and drops it again once the job
+    is terminal (the result carries the final counts instead)."""
+    from stateright_tpu.obs.metrics import VITALS_KEYS
+    from stateright_tpu.serve.jobs import RUNNING, Job
+
+    class FakeChecker:
+        def metrics(self):
+            return {
+                "unique_state_count": 123, "state_count": 456,
+                "max_depth": 7, "waves": 9, "uniq_per_sec_ema": 1000.5,
+                "table_load_factor": 0.02, "valid_density_ema": 0.004,
+                "grows": 1, "overflow_retries": 2, "engine": "x",
+                "not_a_vital": 1,
+            }
+
+    job = Job("job-000042", JobSpec(workload="twophase", n=3))
+    assert "vitals" not in job.snapshot()  # queued: no checker yet
+    job.state = RUNNING
+    job.checker = FakeChecker()
+    snap = job.snapshot()
+    vit = snap["vitals"]
+    assert vit["unique_state_count"] == 123
+    assert vit["valid_density_ema"] == 0.004
+    assert set(vit) <= set(VITALS_KEYS)
+    assert "not_a_vital" not in vit
+    json.dumps(snap)
+
+    # A checker whose metrics() raises mid-teardown never breaks the
+    # snapshot.
+    class Exploding:
+        def metrics(self):
+            raise RuntimeError("buffers freed")
+
+    job.checker = Exploding()
+    assert "vitals" not in job.snapshot()
+
+    job.state = DONE
+    job.checker = FakeChecker()
+    assert "vitals" not in job.snapshot()  # terminal: result is the record
+
+
+def test_running_job_vitals_over_http(http_service):
+    """Integration: poll GET /jobs/{id} while a job actually runs; at
+    least one poll of a non-trivial job sees the vitals key (best
+    effort — a fast box may finish first, so only the SHAPE is pinned
+    when we do catch it)."""
+    svc, base = http_service
+
+    def req(method, path, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        r = urllib.request.Request(base + path, data=data, method=method)
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    resp = req("POST", "/jobs", {
+        "workload": "twophase", "n": 4,
+        "engine_kwargs": {"capacity": 1 << 14, "max_frontier": 1 << 5,
+                          "waves_per_call": 1},
+    })
+    saw_vitals = None
+    for _ in range(400):
+        snap = req("GET", f"/jobs/{resp['id']}")
+        if snap["state"] not in ("queued", "running"):
+            break
+        if snap["state"] == "running" and "vitals" in snap:
+            saw_vitals = snap["vitals"]
+        time.sleep(0.01)
+    final = req("GET", f"/jobs/{resp['id']}/result?wait=60")
+    assert final["state"] == "done", final
+    assert "vitals" not in final
+    if saw_vitals is not None:
+        assert saw_vitals["unique_state_count"] >= 0
+        assert "table_load_factor" in saw_vitals
