@@ -31,7 +31,10 @@ it; every earlier line is a valid fallback record from an earlier phase):
            `wave_breakdown`, `hbm_util_frac`, `bottleneck_phase`,
            `exchange_occupancy`, `denominator_native` (VERDICT r5 weak
            #6/#9, docs/OBSERVABILITY.md) — come from phase_trace,
-           phase_sharded_smoke, and phase_denominator_native.  The reference suite re-emits after EVERY
+           phase_sharded_smoke, and phase_denominator_native; the
+           `dedup_share`/`bytes_dedup` regression gauge (the sort-rung
+           ladder, ISSUE 12) from phase_dedup, rung folded through the
+           knob cache.  The reference suite re-emits after EVERY
            workload child, so a deadline kill mid-suite keeps the
            completed workloads in the artifact.  Discovered tuned_kwargs
            persist in a knob cache (.bench_knobs/, runtime/knob_cache.py)
@@ -805,6 +808,103 @@ def phase_trace(record: dict, tuned: dict) -> None:
     )
 
 
+def phase_dedup(record: dict) -> None:
+    """Dedup-sort rung regression phase (ISSUE 12): `paxos check 2`
+    traced twice at the same engine sizes — once PINNED to the full
+    worst-case sort buffer (sort_lanes past U clamps to the pre-ladder
+    geometry and disarms the density tuner), once at the adaptive rung
+    warm-started from the knob cache — both golden-gated at 16,668 and
+    verdict-equality-gated against each other.  Reported: the traced
+    `wave_breakdown` dedup share and modeled `bytes.dedup` for both
+    legs, the discovered rung (folded back through the knob cache for
+    the next round), and the byte ratio.  The top-level `dedup_share` /
+    `bytes_dedup` keys are what the trajectory report tracks per round.
+
+    The legs run at the headline's buffer geometry scaled to paxos2 (the
+    c=3 traced run is minutes on a tunneled device and phase_trace
+    already pays it once); the rung-vs-full DELTA is what this phase
+    gauges, and the byte model makes it deterministic."""
+    import numpy as np
+
+    if budget_remaining() < 420.0:
+        record["dedup_skipped"] = (
+            f"global time budget too low ({budget_remaining():.0f}s left)"
+        )
+        log(f"dedup: {record['dedup_skipped']}")
+        return
+    base = dict(capacity=1 << 16, max_frontier=1 << 11)
+    key = _knob_key("paxos_check_2_dedup_rung")
+    cached = load_knobs(KNOB_CACHE_DIR, key) or {}
+
+    def spawn(sort_lanes):
+        kw = dict(base)
+        if sort_lanes is not None:
+            kw["sort_lanes"] = sort_lanes
+        return paxos_model(2).checker().spawn_tpu(trace=True, **kw)
+
+    def traced_leg(sort_lanes):
+        run_device(lambda: spawn(sort_lanes))  # warm the phase programs
+        ck, dt = run_device_timed(lambda: spawn(sort_lanes))
+        unique = ck.unique_state_count()
+        assert unique == SMOKE_UNIQUE, (
+            f"dedup phase golden mismatch: unique={unique} != "
+            f"{SMOKE_UNIQUE}"
+        )
+        return ck, dt
+
+    full_ck, full_dt = traced_leg(1 << 30)  # clamps to the full buffer
+    rung_ck, rung_dt = traced_leg(cached.get("sort_lanes"))
+    assert np.array_equal(
+        full_ck.discovered_fingerprints(),
+        rung_ck.discovered_fingerprints(),
+    ), "sort-rung run diverged from the fixed-geometry discovery set"
+    # Persist the PINNED rung only (sort_lanes_rung; 0 = the run never
+    # tuned off the full buffer — caching the full width would pin the
+    # next round's adaptive leg and measure nothing).
+    discovered = int(rung_ck.metrics().get("sort_lanes_rung", 0) or 0)
+    if discovered:
+        store_knobs(
+            KNOB_CACHE_DIR, key, {"sort_lanes": discovered},
+            golden_unique=SMOKE_UNIQUE,
+        )
+    else:
+        discovered = int(rung_ck.metrics()["sort_lanes"])
+    s_full = full_ck.trace_summary()
+    s_rung = rung_ck.trace_summary()
+    share_full = s_full["wave_breakdown_frac"].get("dedup", 0.0)
+    share_rung = s_rung["wave_breakdown_frac"].get("dedup", 0.0)
+    bytes_full = s_full["bytes"]["dedup"]
+    bytes_rung = s_rung["bytes"]["dedup"]
+    assert bytes_rung <= bytes_full, (
+        f"bytes.dedup did not drop with the rung: {bytes_rung} vs "
+        f"{bytes_full}"
+    )
+    record["dedup_phase"] = {
+        "workload": "paxos_check_2",
+        "sort_lanes_full": int(full_ck.metrics()["sort_lanes"]),
+        "sort_lanes_rung": discovered,
+        "rung_cached": "sort_lanes" in cached,
+        "dedup_share_full": round(share_full, 4),
+        "dedup_share_rung": round(share_rung, 4),
+        "bytes_dedup_full": int(bytes_full),
+        "bytes_dedup_rung": int(bytes_rung),
+        "bytes_dedup_ratio": round(bytes_rung / max(1, bytes_full), 4),
+        "bottleneck_full": s_full["bottleneck_phase"],
+        "bottleneck_rung": s_rung["bottleneck_phase"],
+        "sec_full": round(full_dt, 2),
+        "sec_rung": round(rung_dt, 2),
+    }
+    # Trajectory keys (obs/report.py picks dedup_share off the round).
+    record["dedup_share"] = round(share_rung, 4)
+    record["bytes_dedup"] = int(bytes_rung)
+    log(
+        f"dedup: paxos2 rung={discovered} share {share_full:.3f} -> "
+        f"{share_rung:.3f}, bytes.dedup {bytes_full} -> {bytes_rung} "
+        f"({record['dedup_phase']['bytes_dedup_ratio']}x), bottleneck "
+        f"{s_full['bottleneck_phase']} -> {s_rung['bottleneck_phase']}"
+    )
+
+
 def phase_denominator_native(record: dict) -> None:
     """Honest-denominator bound (VERDICT r5 weak #9): the single-threaded
     C++ hot-loop BFS in native/stateright_core.cpp on direct 2pc —
@@ -1206,6 +1306,7 @@ OPTIONAL_PHASES = (
     "serving",
     "tiered",
     "trace",
+    "dedup",
     "symmetry",
     "ttfv",
     "sharded_smoke",
@@ -1271,6 +1372,7 @@ def main() -> None:
         "serving": phase_serving,
         "tiered": phase_tiered,
         "trace": lambda r: phase_trace(r, tuned),
+        "dedup": phase_dedup,
         "symmetry": phase_symmetry,
         "ttfv": lambda r: phase_ttfv(r, threads, tuned),
         "sharded_smoke": phase_sharded_smoke,

@@ -42,6 +42,7 @@ def _usage(name: str, spec: "CliSpec") -> str:
         lines.append(f"  check-tpu [{n_meta}]{net}"
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
                      " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]"
+                     " [--sort-lanes N]"
                      " [--tiered] [--memory-budget-mb MB]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
@@ -113,19 +114,22 @@ def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
     ``(positional_args, supervise, checkpoint_dir, resume, trace,
-    sharded, bucket_slack, tiered, memory_budget_mb)`` — ``sharded`` is
-    None (single-chip), 0 (mesh over every visible device), or a mesh
-    width; ``bucket_slack`` is the sharded engine's exchange-bucket rung
-    in percent; ``tiered``/``memory_budget_mb`` select the out-of-core
-    engine under an HBM budget (docs/TIERED.md; the budget flag alone
-    implies ``--tiered``) — or raises ``ValueError`` on a malformed
-    flag."""
+    sharded, bucket_slack, sort_lanes, tiered, memory_budget_mb)`` —
+    ``sharded`` is None (single-chip), 0 (mesh over every visible
+    device), or a mesh width; ``bucket_slack`` is the sharded engine's
+    exchange-bucket rung in percent; ``sort_lanes`` the dedup-sort
+    geometry rung (any device engine; docs/OBSERVABILITY.md "The
+    dedup-sort rung ladder"); ``tiered``/``memory_budget_mb`` select
+    the out-of-core engine under an HBM budget (docs/TIERED.md; the
+    budget flag alone implies ``--tiered``) — or raises ``ValueError``
+    on a malformed flag."""
     supervise = False
     resume = False
     trace = False
     ckpt_dir = None
     sharded = None
     bucket_slack = None
+    sort_lanes = None
     tiered = False
     memory_budget_mb = None
     out = []
@@ -193,6 +197,22 @@ def _extract_runtime_flags(args):
                 )
             if bucket_slack < 1:
                 raise ValueError("--bucket-slack must be >= 1")
+        elif a == "--sort-lanes" or a.startswith("--sort-lanes="):
+            if a == "--sort-lanes":
+                i += 1
+                if i >= len(args):
+                    raise ValueError("--sort-lanes requires a lane count")
+                val = args[i]
+            else:
+                val = a.split("=", 1)[1]
+            try:
+                sort_lanes = int(val)
+            except ValueError:
+                raise ValueError(
+                    "--sort-lanes requires an integer lane count"
+                ) from None
+            if sort_lanes < 1:
+                raise ValueError("--sort-lanes must be >= 1")
         elif a == "--checkpoint-dir":
             i += 1
             if i >= len(args):
@@ -212,7 +232,7 @@ def _extract_runtime_flags(args):
         i += 1
     return (
         out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-        tiered, memory_budget_mb,
+        sort_lanes, tiered, memory_budget_mb,
     )
 
 
@@ -622,7 +642,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
     try:
         (
             args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-            tiered, memory_budget_mb,
+            sort_lanes, tiered, memory_budget_mb,
         ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
@@ -630,6 +650,13 @@ def example_main(spec: CliSpec, argv=None) -> int:
     if (sharded is not None or bucket_slack is not None) and sub != "check-tpu":
         print(
             "--sharded/--bucket-slack require the check-tpu subcommand",
+            file=sys.stderr,
+        )
+        return 2
+    if sort_lanes is not None and sub != "check-tpu":
+        print(
+            "--sort-lanes requires the check-tpu subcommand (it sizes "
+            "the device engines' dedup-sort rung)",
             file=sys.stderr,
         )
         return 2
@@ -764,6 +791,10 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 # --checkpoint-dir the enriched wave records land in the
                 # run dir's journal.jsonl — the wave-trace artifact.
                 tpu_kwargs["trace"] = True
+            if sort_lanes is not None:
+                # The dedup-sort geometry rung — a knob every device
+                # engine accepts (single-chip, sharded, tiered).
+                tpu_kwargs["sort_lanes"] = sort_lanes
             if sharded is not None:
                 # Multi-chip run over the first SHARDS visible devices
                 # (0 = all).  The spec's single-chip kwargs translate:

@@ -5,8 +5,9 @@ rotating ones, whose segments :func:`~stateright_tpu.runtime.journal.
 read_journal_stats` merges — and renders a refreshing ONE-LINE progress
 view: wall clock, depth, unique states, a uniq/s EMA computed over the
 trailing wave events, hot-table load factor, measured valid density,
-the bottleneck phase, and warning badges (recompile storms, torn lines,
-faults).  It reads the journal file only — never the engine — so it
+the current dedup-sort rung (from the ``geometry`` events and rung-climb
+``grow`` notes), the bottleneck phase, and warning badges (recompile
+storms, sort-rung ladder thrash, torn lines, faults).  It reads the journal file only — never the engine — so it
 watches supervised children, serve daemons, and remote runs over any
 shared filesystem alike, mid-run or post-mortem.
 
@@ -21,6 +22,7 @@ refresh.
 from __future__ import annotations
 
 import os
+import re
 import sys
 import time
 from typing import List, Optional
@@ -30,6 +32,14 @@ from typing import List, Optional
 # number and the /.metrics number read alike.
 EMA_ALPHA = 0.3
 _EMA_TAIL = 32  # trailing wave events folded into the EMA
+
+# Sort-rung ladder-thrash badge: this many flag-4 rung-climb retries
+# inside the trailing window means the dedup-sort geometry ladder is
+# thrashing (climb → downshift → climb), the condition that silently
+# burns a run's budget on recompiles (docs/OBSERVABILITY.md "The
+# dedup-sort rung ladder").
+SORT_THRASH_WINDOW_SEC = 120.0
+SORT_THRASH_RETRIES = 3
 
 
 def summarize_events(events: List[dict], skipped: int = 0) -> dict:
@@ -140,6 +150,38 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
     grows = sum(1 for e in events if e.get("event") == "grow")
     if grows:
         out["grows"] = grows
+
+    # Current sort-geometry rung: the latest ``geometry`` event's
+    # sort_lanes (engines re-journal geometry on every tuner downshift),
+    # advanced by any LATER rung-climb grow events (their ``grown``
+    # notes carry "sort_lanes=N") — so the watched rung tracks both
+    # directions of the ladder.  Flag-4 rung retries inside the
+    # trailing window raise the ladder-thrash badge.
+    rung = None
+    rung_retry_times: List[float] = []
+    for e in events:
+        ev = e.get("event")
+        if ev == "geometry" and e.get("sort_lanes") is not None:
+            rung = e.get("sort_lanes")
+        elif ev == "grow":
+            m = re.search(r"sort_lanes=(\d+)", str(e.get("grown", "")))
+            if m:
+                rung = int(m.group(1))
+                if int(e.get("flags", 0) or 0) & 4 and isinstance(
+                    e.get("t"), (int, float)
+                ):
+                    rung_retry_times.append(e["t"])
+    if rung is not None:
+        out["sort_rung"] = rung
+    if times and rung_retry_times:
+        tail_retries = [
+            t for t in rung_retry_times
+            if t >= max(times) - SORT_THRASH_WINDOW_SEC
+        ]
+        out["sort_rung_retries"] = len(rung_retry_times)
+        if len(tail_retries) >= SORT_THRASH_RETRIES:
+            out["rung_thrash"] = True
+            out["warnings"].append("rung-thrash")
     kinds = {e.get("event") for e in events}
     if "engine_done" in kinds or "supervisor_done" in kinds:
         out["done"] = True
@@ -175,6 +217,8 @@ def render_line(s: dict) -> str:
         parts.append(f"uniq/s={_fmt(s.get('uniq_per_sec'))}")
         parts.append(f"load_factor={_fmt(s.get('load_factor'))}")
         parts.append(f"density={_fmt(s.get('density'))}")
+        if "sort_rung" in s:
+            parts.append(f"sort_rung={_fmt(s.get('sort_rung'))}")
         parts.append(f"bottleneck={_fmt(s.get('bottleneck'))}")
         if "waves" in s:
             parts.append(f"waves={s['waves']}")

@@ -132,6 +132,7 @@ class ShardedTpuChecker(Checker):
         checkpoint_every_sec: Optional[float] = None,
         trace: bool = False,
         bucket_slack: Optional[int] = None,
+        sort_lanes: Optional[int] = None,
         waves_per_call: Optional[int] = None,
     ):
         """Same checkpoint/journal hooks as the single-chip engine
@@ -163,7 +164,19 @@ class ShardedTpuChecker(Checker):
         raises flag 32, and the host retries the same chunk at the next
         rung (slack ×2) — the engine's standard overflow-flag + retry
         contract.  Warm starts pass the discovered rung back in (the
-        knob cache persists it) and skip the ramp."""
+        knob cache persists it) and skip the ramp.
+
+        ``sort_lanes``: the adaptive sort-geometry rung (wavefront.py's
+        knob, shared ladder in wave_loop.py): a power-of-two width for
+        the per-shard pre-exchange compact/dedup-sort buffers — the
+        owner-bucketing argsort, the exchange buckets
+        (``exchange_bucket_lanes`` is slack% of the RUNG's even share),
+        and the post-exchange insert all shrink with it.  None starts at
+        the full worst-case ``U`` and lets the density tuner downshift;
+        a wave whose valid candidates exceed the rung raises the
+        non-committing flag 4 and the host retries one rung up.  The
+        discovered rung rides the knob cache and snapshots exactly like
+        ``bucket_slack``."""
         super().__init__(options.model)
         import jax
 
@@ -260,6 +273,22 @@ class ShardedTpuChecker(Checker):
         if self._bucket_slack < 1:
             raise ValueError("bucket_slack must be a positive percentage")
         self._bucket_retries = 0  # overflow-retry rungs climbed this run
+        # Adaptive sort-geometry rung (wave_loop.py's ladder; the
+        # single-chip engine's knob, wavefront.py documents the
+        # contract).  None = full worst-case buffer until the density
+        # tuner has evidence; an explicit rung is a warm start.
+        from .wave_loop import SORT_RUNG_MIN, clamp_sort_lanes
+
+        self._sort_lanes = (
+            None if sort_lanes is None else clamp_sort_lanes(sort_lanes)
+        )
+        # Explicit rung = warm start: the density tuner stands down
+        # (the single-chip rule, wavefront.py).
+        self._sort_tune = sort_lanes is None
+        self._sort_rung_floor = SORT_RUNG_MIN
+        self._sort_peak_valid = 0.0
+        self._sort_quanta = 0
+        self._sort_retries = 0  # flag-4 rung climbs this run
         if waves_per_call is None:
             from .wave_common import default_waves_per_call
 
@@ -321,16 +350,31 @@ class ShardedTpuChecker(Checker):
             self._chunk * self._compiled.max_actions, self._dedup_factor
         )
 
+    def _sort_width(self) -> int:
+        """The EFFECTIVE pre-exchange compact/sort buffer width: the
+        sort-geometry rung capped at the live worst-case ``U``
+        (wavefront.py's `_sort_width`, same contract).  Everything
+        downstream — the owner argsort, the exchange buckets, the
+        post-exchange insert — derives its shape from this number."""
+        full = self._u_sz()
+        if self._sort_lanes is None:
+            return full
+        return min(self._sort_lanes, full)
+
     def _bucket_lanes(self) -> int:
         """Per-destination exchange bucket width at the CURRENT slack
         rung — the one source of truth (wave_loop.exchange_bucket_lanes)
         shared by the device programs, the traced byte model, and
         ``accounting()``, so reported payload geometry can never drift
-        from what the device transmits."""
+        from what the device transmits.  Sized from the SORT width (the
+        buffer the exchange actually buckets), so the dedup rung shrinks
+        transmitted bytes too; the cap at the full sort buffer keeps the
+        top slack rung overflow-free by construction (a shard never has
+        more candidates than its sort buffer holds)."""
         from .wave_loop import exchange_bucket_lanes
 
         return exchange_bucket_lanes(
-            self._u_sz(), self._n, self._bucket_slack
+            self._sort_width(), self._n, self._bucket_slack
         )
 
     # --- device program ------------------------------------------------------
@@ -393,6 +437,10 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
+        # The live sort-geometry rung: the pre-exchange compact/dedup
+        # buffers below span this width, so the owner argsort, bucket
+        # scatters, and exchange payload all follow it.
+        sort_lanes = self._sort_width()
         b = f * a  # per-shard candidate lanes (pre-compaction)
         # Per-destination exchange bucket (wave_loop.exchange_bucket_lanes
         # via _bucket_lanes — the same number accounting() reports).
@@ -475,7 +523,9 @@ class ShardedTpuChecker(Checker):
                 from .hashset import compact_valid_indices
 
                 v_orig, v_act, _n_valid, local_overflow = (
-                    compact_valid_indices(flat_valid, dedup_factor)
+                    compact_valid_indices(
+                        flat_valid, dedup_factor, sort_lanes=sort_lanes
+                    )
                 )
                 rows_v, _valid_v, lane_flags_v = jax.vmap(cm.step_lane)(
                     states[v_orig // u(a)], v_orig % u(a)
@@ -497,7 +547,8 @@ class ShardedTpuChecker(Checker):
                 # downstream scatter work on real keys, not the
                 # sentinel-padded majority.
                 v_hi, v_lo, v_orig, v_act, local_overflow = compact_valid(
-                    hi, lo, flat_valid, dedup_factor
+                    hi, lo, flat_valid, dedup_factor,
+                    sort_lanes=sort_lanes,
                 )
                 u_hi, u_lo, u_origin0, u_valid, _never = prededup(
                     v_hi, v_lo, v_act, dedup_factor=1
@@ -786,6 +837,7 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._sort_width(),  # the live sort-geometry rung
             self._bucket_slack,  # shapes the exchange buckets
             self._waves_per_call,  # baked into run() as a constant
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
@@ -816,6 +868,7 @@ class ShardedTpuChecker(Checker):
             "capacity_per_shard": self._cap_s,
             "chunk_size": self._chunk,
             "dedup_factor": self._dedup_factor,
+            "sort_lanes": self._sort_width(),
             "bucket_slack": self._bucket_slack,
             "waves_per_call": self._waves_per_call,
             "symmetry": self._canon is not None,
@@ -975,6 +1028,7 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._sort_width(),  # the live sort-geometry rung
             self._bucket_slack,  # shapes the exchange buckets
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
             tuple(p.expectation for p in self._properties),
@@ -1025,6 +1079,7 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
+        sort_lanes = self._sort_width()  # the live sort-geometry rung
         b = f * a
         bkt = self._bucket_lanes()  # per-destination exchange bucket
         u = jnp.uint32
@@ -1056,7 +1111,7 @@ class ShardedTpuChecker(Checker):
             )
             flat_valid = valid.reshape(b)
             v_orig, v_act, _n_valid, local_overflow = compact_valid_indices(
-                flat_valid, dedup_factor
+                flat_valid, dedup_factor, sort_lanes=sort_lanes
             )
             if nexts is None:
                 # Two-phase: construct successors only for the compacted
@@ -1181,7 +1236,6 @@ class ShardedTpuChecker(Checker):
         bytes over measured wall time is per-device bandwidth;
         obs/roofline.py documents the model)."""
         from ..obs.roofline import copy_bytes, probe_bytes, sort_bytes
-        from .hashset import unique_buffer_size
 
         cm = self._compiled
         w = cm.state_width
@@ -1189,7 +1243,10 @@ class ShardedTpuChecker(Checker):
         n = self._n
         f = self._chunk
         b = f * cm.max_actions
-        u_sz = unique_buffer_size(b, self._dedup_factor)
+        # The LIVE sort rung, not the worst-case unique_buffer_size:
+        # bytes.dedup drops in proportion to the rung — the ladder's
+        # regression gauge (docs/OBSERVABILITY.md).
+        u_sz = self._sort_width()
         bkt = self._bucket_lanes()
         recv = n * bkt if n > 1 else u_sz  # post-exchange insert lanes
         step = copy_bytes(f, w) + b * 4 + copy_bytes(u_sz, w)
@@ -1486,6 +1543,15 @@ class ShardedTpuChecker(Checker):
             self._metrics.inc("device_call_sec_total", t7 - t0)
             self._metrics.inc("device_calls", 1)
 
+            # Density-driven sort-rung downshift, per committed wave
+            # (wave_loop.maybe_retune_sort); a rung change re-keys the
+            # phase programs and recomputes the rung-derived buckets.
+            from .wave_loop import maybe_retune_sort
+
+            if maybe_retune_sort(self, vitals.last_density):
+                bkt = self._bucket_lanes()
+                progs = self._traced_programs()
+
             # Shared termination tail (wave_loop.py): finish_when /
             # target_state_count / deadline / cooperative cancel, the
             # same predicate order as the fused loop by construction.
@@ -1645,6 +1711,15 @@ class ShardedTpuChecker(Checker):
                 # Adopt the saved run's discovered bucket rung so a
                 # resume never re-pays the overflow-retry ramp.
                 self._bucket_slack = int(snap["bucket_slack"])
+            if "sort_lanes" in snap.files:
+                # Same for the discovered sort-geometry rung (0 = the
+                # saved run ran at the full buffer).  An adopted rung is
+                # a PROVEN rung: the density tuner stands down, exactly
+                # as for an explicit spawn argument.
+                saved_rung = int(snap["sort_lanes"])
+                if saved_rung:
+                    self._sort_lanes = saved_rung
+                    self._sort_tune = False
             from .wavefront import _device_owned
 
             def up(x):
@@ -1787,8 +1862,33 @@ class ShardedTpuChecker(Checker):
     def _wl_cand_lanes(self) -> int:
         """Density denominator (wave_loop.LoopVitals): the mesh-global
         worst-case compaction width — every shard's ``U`` buffer —
-        matching the psum'd generated-successor numerator."""
+        matching the psum'd generated-successor numerator.  Rung-
+        independent, like the single-chip engine's (the rung is sized
+        FROM this density)."""
         return self._n * self._u_sz()
+
+    def _wl_full_sort_lanes(self) -> int:
+        """The PER-SHARD worst-case width the rung is clamped to; with
+        the mesh-global density this makes ``density × full`` the
+        average per-shard valid count — what a shard's rung must hold
+        (skew is absorbed by the tuner headroom, and an undersized rung
+        is a retry, never a wrong answer)."""
+        return self._u_sz()
+
+    def _wl_apply_sort_rung(self, rung: int) -> None:
+        """Apply a density-tuner downshift (wave_loop.maybe_retune_sort):
+        swap the knob, re-journal the geometry event, and — in fused
+        mode — rebuild the run program.  The carry (tables, store,
+        queue, stats) is rung-independent."""
+        self._sort_lanes = int(rung)
+        self._sort_quanta = 0
+        # Not mirrored into the metrics registry — metrics() reports
+        # the live _sort_width(); a stale registry copy would shadow a
+        # later ladder climb (wavefront.py's rule).
+        if self._journal:
+            self._journal.append("geometry", **self._wl_geometry())
+        if getattr(self, "_run_fn", None) is not None:
+            self._run_fn = self._programs()
 
     def _wl_geometry(self) -> dict:
         """The ``geometry`` journal event payload (wave_loop.
@@ -1801,6 +1901,7 @@ class ShardedTpuChecker(Checker):
             "capacity_per_shard": self._cap_s,
             "chunk_size": self._chunk,
             "dedup_factor": self._dedup_factor,
+            "sort_lanes": self._sort_width(),
             "bucket_slack": self._bucket_slack,
             "exchange_bucket_lanes": (
                 0 if self._n == 1 else self._bucket_lanes()
@@ -1926,25 +2027,49 @@ class ShardedTpuChecker(Checker):
 
         notes = []
         if flags & 4:
-            from .hashset import unique_buffer_size
-            from .wavefront import max_safe_unique_lanes
+            from .wave_loop import climb_sort_rung, reset_sort_rung_to_full
 
-            a = self._compiled.max_actions
-            u_cap = max_safe_unique_lanes(self._compiled.state_width + 3)
-            relaxed = relax_dedup_geometry(
-                self._chunk,
-                self._dedup_factor,
-                lambda c, dd: self._n * unique_buffer_size(c * a, dd),
-                u_cap,
-                chunk_label="chunk_size",
-            )
-            if relaxed is None:
-                return None
-            self._dedup_factor, self._chunk, note = relaxed
-            notes.append(note)
+            # Sort-rung ladder first (the shared wave_loop rule, same as
+            # the single-chip _grow): a flag-4 overflow at a rung below
+            # the full U means the RUNG was too small; climb one rung
+            # and re-run.  Only at the full buffer does the flag mean
+            # the pre-ladder condition.
+            full = self._u_sz()
+            note = climb_sort_rung(self, full)
+            if note is not None:
+                self._sort_retries += 1
+                notes.append(note)
+            else:
+                from .hashset import unique_buffer_size
+                from .wavefront import max_safe_unique_lanes
+
+                a = self._compiled.max_actions
+                u_cap = max_safe_unique_lanes(
+                    self._compiled.state_width + 3
+                )
+                relaxed = relax_dedup_geometry(
+                    self._chunk,
+                    self._dedup_factor,
+                    lambda c, dd: self._n * unique_buffer_size(c * a, dd),
+                    u_cap,
+                    chunk_label="chunk_size",
+                )
+                if relaxed is None:
+                    return None
+                self._dedup_factor, self._chunk, note = relaxed
+                # The full buffer overflowed on valid count: the relaxed
+                # dd=1 geometry starts at its own full width (evidence +
+                # geometry re-journal in the shared helper).
+                reset_sort_rung_to_full(self, full)
+                notes.append(note)
         if flags & 32:
+            # Evaluate the slack ladder against the SAME width the live
+            # buckets derive from (_bucket_lanes uses the sort rung):
+            # stepping it against the worst-case U would double the
+            # slack without widening the actual (tile-rounded) bucket
+            # and deterministically re-fail the same chunk.
             nxt = next_bucket_slack(
-                self._u_sz(), self._n, self._bucket_slack
+                self._sort_width(), self._n, self._bucket_slack
             )
             if nxt is None:
                 return None
@@ -2000,13 +2125,17 @@ class ShardedTpuChecker(Checker):
         cm = self._compiled
         n = self._n
         f = self._chunk
-        u_sz = self._u_sz()
+        u_sz = self._sort_width()  # the buffer the exchange buckets
         bkt = self._bucket_lanes()
         return {
             "shards": n,
             "waves": waves_total,
             "chunk_size": f,
             "exchange_lanes_per_shard": u_sz,
+            # The discovered sort-geometry rung + its retry count, the
+            # bucket_slack pattern (knob cache / warm-start evidence).
+            "sort_lanes": u_sz,
+            "sort_retries": self._sort_retries,
             # The bucketed payload shape: each shard ships one
             # [bkt, W+3] bucket per destination per wave.
             "exchange_bucket_lanes": 0 if n == 1 else bkt,
@@ -2093,6 +2222,10 @@ class ShardedTpuChecker(Checker):
                 # overflow-retry ramp the saved run already climbed.
                 n_shards=self._n,
                 bucket_slack=self._bucket_slack,
+                # The discovered sort rung rides along like the bucket
+                # rung (0 = running at the full buffer), so a resume
+                # skips the sort ladder's ramp too.
+                sort_lanes=self._sort_lanes or 0,
                 **arrays,
             )
         os.replace(tmp, path)
@@ -2119,6 +2252,15 @@ class ShardedTpuChecker(Checker):
             chunk_size=self._chunk,
             dedup_factor=self._dedup_factor,
             bucket_slack=self._bucket_slack,
+            # The discovered sort rung (the second ladder the knob
+            # cache persists — warm runs skip both ramps) — ONLY when
+            # one was actually pinned; persisting the full worst-case
+            # width would disarm every warm repeat's density tuner
+            # (wavefront.py's rule).
+            **(
+                {"sort_lanes": self._sort_width()}
+                if self._sort_lanes is not None else {}
+            ),
         )
 
     def discovered_fingerprints(self):
@@ -2182,6 +2324,9 @@ class ShardedTpuChecker(Checker):
             capacity_per_shard=self._cap_s,
             chunk_size=self._chunk,
             dedup_factor=self._dedup_factor,
+            sort_lanes=self._sort_width(),
+            # Pinned rung vs live width: wavefront.py's rule.
+            sort_lanes_rung=self._sort_lanes or 0,
             bucket_slack=self._bucket_slack,
             exchange_bucket_lanes=(
                 0 if self._n == 1 else self._bucket_lanes()

@@ -70,6 +70,147 @@ def next_bucket_slack(u_sz: int, n: int, slack_pct: int) -> Optional[int]:
     return None
 
 
+# --- sort-geometry rung ladder (the dedup-sort analogue of the bucket
+# ladder above; ROADMAP #1) ---------------------------------------------------
+#
+# The dedup sort pre-insert runs 3 co-sorted planes over the compaction
+# buffer every wave; the worst-case buffer U = max(min(B, 16K),
+# B/dedup_factor) is sized for a wave where EVERY candidate lane is valid,
+# while the measured valid density (LoopVitals) is a few percent of it.
+# ``sort_lanes`` is a power-of-two rung the engines compact into INSTEAD
+# of U — the sort, probe rounds, and every U-sized gather downstream then
+# touch rung lanes, not worst-case lanes.  The contract is exactly the
+# bucket-slack ladder's: a wave whose valid candidates exceed the rung
+# raises the non-committing flag-4 overflow, the host climbs one rung
+# (×2, capped at the full U buffer — which reproduces the pre-ladder
+# criterion exactly, so the top rung can never be wrong), and the
+# discovered rung persists in the knob cache / tuned_kwargs so warm runs
+# start past the ramp.  Downshifts are density-driven between committed
+# quanta (:func:`downshift_sort_lanes`), with at-least-halving hysteresis
+# so the compiled rung set stays small (the recompile-storm detector
+# watches a thrashing ladder).
+SORT_RUNG_MIN = 256
+
+# Sizing headroom over the measured per-wave valid peak: quantum-averaged
+# densities under-read the true in-wave peak, and an undersized rung costs
+# a retry (never a wrong answer), so 4× balances "rarely retries" against
+# "stops sorting dead lanes" (the report advisor's constant).
+SORT_RUNG_HEADROOM = 4.0
+
+# Committed density observations required before a downshift.  BFS
+# density RAMPS over the first levels (tiny init frontier), so early
+# peaks badly under-read steady state — acting on two waves of evidence
+# measured as a downshift-then-climb-back thrash on 2pc(4); eight quanta
+# of peak-tracking ride out the ramp (a fused quantum is up to 256
+# waves, so production runs reach the window almost immediately).
+SORT_TUNE_MIN_QUANTA = 8
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+def clamp_sort_lanes(requested: int) -> int:
+    """Normalize a requested rung onto the ladder: next power of two,
+    floored at ``SORT_RUNG_MIN``.  The full-buffer cap is applied live by
+    the engines (``min(rung, U)``) because auto-grow can move U mid-run."""
+    return max(SORT_RUNG_MIN, _pow2_ceil(max(1, int(requested))))
+
+
+def next_sort_lanes(cur: int, u_sz: int) -> Optional[int]:
+    """The next rung up (doubling, capped at the full ``u_sz`` buffer),
+    or None when the sort already spans the full buffer — at which point
+    the rung overflow criterion IS the pre-ladder dedup criterion and the
+    remaining growth lever is ``relax_dedup_geometry``."""
+    if cur >= u_sz:
+        return None
+    return min(max(SORT_RUNG_MIN, cur * 2), u_sz)
+
+
+def downshift_sort_lanes(
+    cur: int, u_sz: int, floor: int, peak_valid: float
+) -> Optional[int]:
+    """Density-driven downshift decision: the rung that holds the
+    measured per-wave valid peak at ``SORT_RUNG_HEADROOM``× headroom,
+    or None when no at-least-halving move exists.  ``floor`` is the
+    overflow-proven minimum (a rung this run already climbed past must
+    never be revisited — that is the ladder-thrash mode the watch verb
+    badges)."""
+    want = max(
+        SORT_RUNG_MIN,
+        int(floor),
+        _pow2_ceil(max(1, int(peak_valid * SORT_RUNG_HEADROOM) + 1)),
+    )
+    want = min(want, u_sz)
+    if want * 2 <= cur:
+        return want
+    return None
+
+
+def climb_sort_rung(eng, full: int) -> Optional[str]:
+    """The flag-4 rung-climb half of the growth rule, shared by both
+    engines (the relax_dedup_geometry pattern — one definition so the
+    retry semantics cannot drift): climb one rung toward ``full``,
+    record the overflow-proven floor and peak evidence, and return the
+    grow note.  None when the rung already spans the full buffer — the
+    caller falls back to :func:`relax_dedup_geometry`."""
+    cur = eng._sort_width()
+    nxt = next_sort_lanes(cur, full)
+    if nxt is None:
+        return None
+    eng._sort_lanes = nxt
+    eng._sort_rung_floor = nxt
+    # The overflow proved this wave's valid count exceeds the old rung.
+    eng._sort_peak_valid = max(eng._sort_peak_valid, cur)
+    return f"sort_lanes={nxt}"
+
+
+def reset_sort_rung_to_full(eng, old_full: int) -> None:
+    """The relax-path tail: a FULL-buffer flag-4 overflow relaxed
+    dedup_factor, so the rung resets to the new (larger) full width and
+    the evidence records that one wave held ≥ ``old_full`` valid lanes
+    (the density tuner must not shrink the new buffer back).  The
+    geometry event is re-journaled so journal readers — the `watch`
+    verb's ``sort_rung`` in particular — track the reset; the grow note
+    alone carries no ``sort_lanes=`` and would leave them stale."""
+    eng._sort_lanes = None
+    eng._sort_peak_valid = max(eng._sort_peak_valid, old_full)
+    if eng._journal:
+        eng._journal.append("geometry", **eng._wl_geometry())
+
+
+def maybe_retune_sort(eng, density) -> bool:
+    """Shared density→rung downshift, called by every host loop after a
+    committed quantum (fused and traced alike; engines without the
+    ``_wl_apply_sort_rung`` hook are untouched).  Folds the quantum's
+    measured density into the engine's running valid peak, and applies a
+    downshift when :func:`downshift_sort_lanes` finds one.  Returns True
+    exactly when the rung changed — traced loops use it to refresh their
+    phase programs."""
+    apply = getattr(eng, "_wl_apply_sort_rung", None)
+    if apply is None or density is None:
+        return False
+    if not getattr(eng, "_sort_tune", False):
+        # An EXPLICIT sort_lanes (warm start from the knob cache, or a
+        # pinned measurement leg) is the caller's rung: the tuner must
+        # not fight it.  The overflow ladder stays armed regardless —
+        # an explicit rung that proves too small still climbs.
+        return False
+    full = eng._wl_full_sort_lanes()
+    cur = eng._sort_width()
+    eng._sort_quanta += 1
+    eng._sort_peak_valid = max(eng._sort_peak_valid, density * full)
+    if eng._sort_quanta < SORT_TUNE_MIN_QUANTA:
+        return False
+    want = downshift_sort_lanes(
+        cur, full, eng._sort_rung_floor, eng._sort_peak_valid
+    )
+    if want is None:
+        return False
+    apply(want)
+    return True
+
+
 def relax_dedup_geometry(chunk, dedup_factor, lanes_of, lane_cap,
                          chunk_label: str, chunk_floor: int = 2048):
     """The shared dedup-overflow growth rule: straight to the always-safe
@@ -437,6 +578,11 @@ class FusedWaveLoop:
                 after_commit = getattr(eng, "_wl_after_commit", None)
                 if after_commit is not None:
                     carry = after_commit(carry, view) or carry
+                # Density-driven sort-rung downshift (engines with the
+                # hook only): the carry is rung-independent — only the
+                # per-wave scratch buffers reshape — so a retune is a
+                # program swap between calls, never a migration.
+                maybe_retune_sort(eng, vitals.last_density)
             if (
                 eng._checkpoint_path is not None
                 and view.flags == 0

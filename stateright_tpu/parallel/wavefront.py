@@ -173,6 +173,7 @@ class TpuChecker(Checker):
         # so the default only changes their discovery path, not their
         # final geometry; batches under the 16K buffer floor never see it.
         dedup_factor: int = 8,
+        sort_lanes: Optional[int] = None,
         waves_per_call: Optional[int] = None,
         device=None,
         compiled: Optional[CompiledModel] = None,
@@ -203,6 +204,17 @@ class TpuChecker(Checker):
         encoding overflows are never retried: they mean the compiled
         model's layout cannot represent a reachable state.  Resumed runs
         adopt the snapshot's geometry and may auto-grow past it.
+
+        ``sort_lanes``: the adaptive sort-geometry rung (docs/
+        OBSERVABILITY.md "The dedup-sort rung ladder") — a power-of-two
+        width for the per-wave compact/dedup-sort buffers, replacing the
+        worst-case ``U = max(min(B, 16K), B/dedup_factor)``.  None (the
+        default) starts at the full buffer and lets the density-driven
+        tuner downshift mid-run; pass the knob-cache rung
+        (``tuned_kwargs()['sort_lanes']``) to warm-start past the ramp.
+        A wave whose valid candidates exceed the rung overflows (flag 4,
+        nothing commits) and the host retries one rung up — identical
+        discovery sets at every rung, by construction.
 
         ``journal`` (a :class:`~stateright_tpu.runtime.journal.Journal`
         or a path) streams wave-level telemetry — per-call frontier
@@ -281,6 +293,26 @@ class TpuChecker(Checker):
         # auto-tune must not silently inflate it when the TABLE grows.
         self._log_capacity_explicit = log_capacity is not None
         self._dedup_factor = dedup_factor
+        # Adaptive sort-geometry rung (wave_loop.py's ladder, ROADMAP
+        # #1): ``sort_lanes`` sizes the per-wave compact/sort/probe
+        # buffers to a power-of-two rung instead of the worst-case U.
+        # None starts at the full buffer (today's program) and lets the
+        # density-driven tuner downshift once measured evidence exists;
+        # an explicit rung (a knob-cache warm start) skips the ramp.
+        # Overflowing a rung is the non-committing flag 4: the host
+        # climbs one rung and re-runs the chunk, no work lost.
+        from .wave_loop import SORT_RUNG_MIN, clamp_sort_lanes
+
+        self._sort_lanes = (
+            None if sort_lanes is None else clamp_sort_lanes(sort_lanes)
+        )
+        # The density tuner only drives runs that did NOT pin a rung:
+        # an explicit sort_lanes is a warm start (or a measurement leg)
+        # the tuner must not fight; the overflow ladder stays armed.
+        self._sort_tune = sort_lanes is None
+        self._sort_rung_floor = SORT_RUNG_MIN
+        self._sort_peak_valid = 0.0
+        self._sort_quanta = 0
         self._auto_tune = bool(auto_tune)
         self._max_frontier = max_frontier
         # Spawn-time guard on the compact/dedup buffer width: configs past
@@ -436,6 +468,11 @@ class TpuChecker(Checker):
         qcap = self._log_capacity  # one row-log position per unique state
         pad = self._block_pad()  # append-block lanes past qcap
         dedup_factor = self._dedup_factor
+        # The live sort-geometry rung: the compact/dedup/insert buffers
+        # below span this width; everything downstream (probe rounds,
+        # result gathers, the append-block compaction source) follows
+        # the compacted buffer's shape automatically.
+        sort_lanes = self._sort_width()
         props = self._properties
         n_props = len(props)
         ev_indices = self._ev_indices
@@ -498,7 +535,7 @@ class TpuChecker(Checker):
                 from .hashset import compact_valid_indices
 
                 v_orig, v_act, n_valid, v_overflow = compact_valid_indices(
-                    flat_valid, dedup_factor
+                    flat_valid, dedup_factor, sort_lanes=sort_lanes
                 )
                 src_state = v_orig // jnp.uint32(a)
                 lane_k = v_orig % jnp.uint32(a)
@@ -517,7 +554,8 @@ class TpuChecker(Checker):
                 flat = nexts.reshape(f * a, w)
                 hi_b, lo_b = fp_of(flat)
                 v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
-                    hi_b, lo_b, flat_valid, dedup_factor
+                    hi_b, lo_b, flat_valid, dedup_factor,
+                    sort_lanes=sort_lanes,
                 )
                 hi, lo = v_hi, v_lo
                 compact_rows = None
@@ -738,6 +776,7 @@ class TpuChecker(Checker):
             self._log_capacity,
             self._max_frontier,
             self._dedup_factor,
+            self._sort_width(),  # the live sort-geometry rung
             self._waves_per_call,  # baked into run() as a constant
             tuple(p.expectation for p in self._properties),
             (
@@ -766,6 +805,7 @@ class TpuChecker(Checker):
             "log_capacity": self._log_capacity,
             "max_frontier": self._max_frontier,
             "dedup_factor": self._dedup_factor,
+            "sort_lanes": self._sort_width(),
             "waves_per_call": self._waves_per_call,
             "symmetry": self._canon is not None,
         }
@@ -920,8 +960,27 @@ class TpuChecker(Checker):
             return f"log_capacity={self._log_capacity}"
         if flag & 4:
             from .hashset import unique_buffer_size
-            from .wave_loop import relax_dedup_geometry
+            from .wave_loop import (
+                climb_sort_rung, relax_dedup_geometry,
+                reset_sort_rung_to_full,
+            )
 
+            # Sort-rung ladder first: when the compact/sort buffers run
+            # at a rung below the full U, a flag-4 overflow means the
+            # RUNG was too small, not the worst-case geometry — climb
+            # one rung (×2, capped at U) and re-run; the climbed rung
+            # becomes the floor the density tuner may never revisit.
+            # Only once the rung spans the full buffer does the flag
+            # mean the pre-ladder condition, handled below.  The rule
+            # lives in wave_loop (climb_sort_rung), shared with the
+            # sharded engine so the two cannot drift.
+            full = unique_buffer_size(
+                self._max_frontier * self._compiled.max_actions,
+                self._dedup_factor,
+            )
+            note = climb_sort_rung(self, full)
+            if note is not None:
+                return note
             # Straight to the always-safe 1, not stepwise (the
             # intermediate dd=2-at-doubled-frontier stop measured as a
             # NEW worker-crash geometry on the 61.5M-state 2pc run),
@@ -945,6 +1004,10 @@ class TpuChecker(Checker):
                 # worker-crash band.
                 return None
             self._dedup_factor, self._max_frontier, note = relaxed
+            # The FULL buffer overflowed on valid count: the relaxed
+            # dd=1 geometry starts at its own full width (evidence +
+            # geometry re-journal in the shared helper).
+            reset_sort_rung_to_full(self, full)
             return note
         return None
 
@@ -1152,14 +1215,49 @@ class TpuChecker(Checker):
         """The worst-case compaction/dedup buffer width ``U`` — the
         denominator of the density telemetry (wave_loop.LoopVitals):
         measured valid candidates per wave over THIS is the fraction of
-        the sort/compact work that touches live lanes.  Queried per
-        quantum because auto-grow may relax the geometry mid-run."""
+        the sort/compact work that touches live lanes.  Deliberately
+        rung-INDEPENDENT (the sort rung is sized FROM density ×
+        worst-case U; a rung-relative density would be self-referential).
+        Queried per quantum because auto-grow may relax the geometry
+        mid-run."""
         from .hashset import unique_buffer_size
 
         return unique_buffer_size(
             self._max_frontier * self._compiled.max_actions,
             self._dedup_factor,
         )
+
+    # --- sort-geometry rung (wave_loop.py's ladder) --------------------------
+
+    def _sort_width(self) -> int:
+        """The EFFECTIVE per-wave compact/sort buffer width: the
+        requested rung capped at the live worst-case ``U`` (auto-grow
+        may move U mid-run), or ``U`` itself when no rung is set.  The
+        one number the device programs, cache keys, byte model, and
+        knob-cache entries all derive from."""
+        full = self._wl_cand_lanes()
+        if self._sort_lanes is None:
+            return full
+        return min(self._sort_lanes, full)
+
+    def _wl_full_sort_lanes(self) -> int:
+        return self._wl_cand_lanes()
+
+    def _wl_apply_sort_rung(self, rung: int) -> None:
+        """Apply a density-tuner downshift (wave_loop.maybe_retune_sort):
+        swap the knob, re-journal the geometry event (the watch verb's
+        source for the current rung), and — in fused mode — rebuild the
+        run program at the new shapes.  The loop carry is untouched:
+        the rung only shapes per-wave scratch buffers."""
+        self._sort_lanes = int(rung)
+        self._sort_quanta = 0  # fresh evidence before another move
+        # NOT mirrored into the metrics registry: metrics() reports the
+        # live _sort_width(), and a stale registry copy would shadow a
+        # later ladder climb (snapshot keys overwrite computed ones).
+        if self._journal:
+            self._journal.append("geometry", **self._wl_geometry())
+        if getattr(self, "_run_fn", None) is not None:
+            _seed, self._run_fn = self._programs()
 
     def _wl_geometry(self) -> dict:
         """The ``geometry`` journal event's payload (wave_loop.
@@ -1171,6 +1269,7 @@ class TpuChecker(Checker):
             "log_capacity": self._log_capacity,
             "max_frontier": self._max_frontier,
             "dedup_factor": self._dedup_factor,
+            "sort_lanes": self._sort_width(),
             "u_lanes": self._wl_cand_lanes(),
             "waves_per_call": self._waves_per_call,
         }
@@ -1248,6 +1347,7 @@ class TpuChecker(Checker):
             self._canon is not None,
             self._max_frontier,
             self._dedup_factor,
+            self._sort_width(),  # the live sort-geometry rung
             self._block_pad(),
             tuple(p.expectation for p in self._properties),
         )
@@ -1286,6 +1386,7 @@ class TpuChecker(Checker):
         f = self._max_frontier
         pad = self._block_pad()
         dedup_factor = self._dedup_factor
+        sort_lanes = self._sort_width()  # the live sort-geometry rung
         props = self._properties
         ev_indices = self._ev_indices
 
@@ -1305,7 +1406,7 @@ class TpuChecker(Checker):
             )
             flat_valid = valid.reshape(f * a)
             v_orig, v_act, n_valid, v_overflow = compact_valid_indices(
-                flat_valid, dedup_factor
+                flat_valid, dedup_factor, sort_lanes=sort_lanes
             )
             if nexts is None:
                 # Two-phase: construct successors only for the compacted
@@ -1377,9 +1478,11 @@ class TpuChecker(Checker):
         proportional, not count-proportional: the device streams full
         fixed-width buffers regardless of how many lanes are live, so
         charging the full widths is what matches what HBM actually
-        moves."""
+        moves.  The compact/canon/dedup widths are the LIVE sort rung
+        (``_sort_width``), not the worst-case U — ``bytes.dedup`` drops
+        in proportion to the rung, which is exactly the regression gauge
+        the ladder is judged by (bench.py's dedup phase)."""
         from ..obs.roofline import copy_bytes, probe_bytes, sort_bytes
-        from .hashset import unique_buffer_size
 
         cm = self._compiled
         w = cm.state_width
@@ -1387,7 +1490,7 @@ class TpuChecker(Checker):
         a = cm.max_actions
         f = self._max_frontier
         b = f * a
-        u_sz = unique_buffer_size(b, self._dedup_factor)
+        u_sz = self._sort_width()
         pad = self._block_pad()
         # step: chunk read + candidate construction + the valid-lane
         # index compaction scan.  Two-phase constructs only U rows (and
@@ -1658,6 +1761,14 @@ class TpuChecker(Checker):
                 self._metrics.inc("device_call_sec_total", t5 - t0)
                 self._metrics.inc("device_calls", 1)
 
+                # Density-driven sort-rung downshift, per committed wave
+                # (the traced analogue of the fused loop's between-quanta
+                # retune); a rung change re-keys the phase programs.
+                from .wave_loop import maybe_retune_sort
+
+                if maybe_retune_sort(self, vitals.last_density):
+                    progs = self._traced_programs()
+
                 # Shared termination tail (wave_loop.py): the same
                 # predicate order as the fused loop by construction.
                 from .wave_loop import loop_should_break
@@ -1796,10 +1907,10 @@ class TpuChecker(Checker):
         dropping the checker object frees all of it.
 
         Engine tuning knobs that do not shape the persisted arrays —
-        ``dedup_factor`` in particular — are deliberately NOT part of the
-        snapshot key: a resume may use different tuning, in which case
-        overflow-failure behavior (not correctness) can differ from the
-        original run."""
+        ``dedup_factor`` and the ``sort_lanes`` rung in particular — are
+        deliberately NOT part of the snapshot key: a resume may use
+        different tuning, in which case overflow-failure behavior (not
+        correctness) can differ from the original run."""
         self.join()
         if self._carry_dev is None:
             raise RuntimeError("no run state to snapshot")
@@ -1819,6 +1930,16 @@ class TpuChecker(Checker):
             log_capacity=u + max(64, u // 64),
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
+            # The discovered sort rung — ONLY when one was actually
+            # pinned (ladder climb, density tuner, or explicit spawn):
+            # a warm spawn from an explicit rung disarms the tuner, so
+            # persisting the full worst-case width from a run too short
+            # to tune would freeze that workload at full-U forever
+            # (the sharded snapshot's none-sentinel rule).
+            **(
+                {"sort_lanes": self._sort_width()}
+                if self._sort_lanes is not None else {}
+            ),
         )
 
     def discovered_fingerprints(self):
@@ -1868,6 +1989,11 @@ class TpuChecker(Checker):
             log_capacity=self._log_capacity,
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
+            sort_lanes=self._sort_width(),
+            # The PINNED rung (0 = running at the full buffer with the
+            # tuner armed) — what warm-start stores persist, vs the
+            # live width above (what the programs actually compiled).
+            sort_lanes_rung=self._sort_lanes or 0,
         )
         snap = self._metrics.snapshot()
         # Table load factor: mid-run it is the loop's already-synced

@@ -467,19 +467,28 @@ class Scheduler:
         # The keys are exactly the engines' spawn kwargs: single-chip
         # (and tiered, whose budget-derived capacity lands here as the
         # capacity it pinned) exposes capacity/log_capacity/
-        # max_frontier/dedup_factor, the sharded engine capacity/
-        # chunk_size/dedup_factor/bucket_slack (the discovered
-        # exchange-bucket rung — persisting it is what lets a warm
-        # repeat skip the bucket overflow-retry ramp, not just the
-        # auto-tune growth).  Each engine's metrics() emits its own
-        # subset; the `in m` filter picks the right one.
+        # max_frontier/dedup_factor/sort_lanes, the sharded engine
+        # capacity/chunk_size/dedup_factor/bucket_slack/sort_lanes (the
+        # discovered exchange-bucket and sort-geometry rungs —
+        # persisting them is what lets a warm repeat skip the
+        # overflow-retry ramps, not just the auto-tune growth).  Each
+        # engine's metrics() emits its own subset; the `in m` filter
+        # picks the right one.
         m = checker.metrics()
-        return {
+        out = {
             k: int(m[k])
             for k in ("capacity", "log_capacity", "max_frontier",
                       "chunk_size", "dedup_factor", "bucket_slack")
             if k in m
         }
+        # The sort rung persists ONLY when the run actually pinned one
+        # (sort_lanes_rung; 0 = full buffer, tuner armed): storing the
+        # live full width from a too-short-to-tune run would spawn
+        # every warm repeat with an explicit rung and disarm its tuner.
+        rung = int(m.get("sort_lanes_rung", 0) or 0)
+        if rung:
+            out["sort_lanes"] = rung
+        return out
 
     def _poll_to_completion(self, job: Job, checker) -> None:
         while not checker.is_done():

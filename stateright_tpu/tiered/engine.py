@@ -199,13 +199,11 @@ class TieredTpuChecker(TpuChecker):
         import jax
         import jax.numpy as jnp
 
-        from ..parallel.hashset import unique_buffer_size
         from ..parallel.wave_common import cached_program
 
-        u_sz = unique_buffer_size(
-            self._max_frontier * self._compiled.max_actions,
-            self._dedup_factor,
-        )
+        # The query buffers span the live sort rung (the insert's
+        # compact width), not the worst-case U.
+        u_sz = self._sort_width()
         chunk = self._cold_chunk
         key = ("tiered-cold", u_sz, chunk)
 

@@ -112,6 +112,149 @@ def test_prededup_inactive_lanes_do_not_count():
     assert not bool(overflow)
 
 
+# --- the overflow-criterion pair (a pinned contract) --------------------------
+#
+# compact_valid / compact_valid_indices flag on the VALID-LANE count;
+# prededup flags on the DISTINCT-REPRESENTATIVE count.  Both comparisons
+# are strict (> not >=): exactly-full commits, one past trips.  The
+# engines' flag 4 (and the sort-rung ladder's retry criterion) derive
+# from these two, so the boundary is pinned here at exactly-u_sz and
+# u_sz+1 for BOTH.
+
+
+def test_compact_valid_overflow_boundary_exact_and_plus_one():
+    b = 1 << 15
+    dd = 4
+    v_sz = unique_buffer_size(b, dd)
+    assert v_sz < b
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    exactly = jnp.asarray(np.arange(b) < v_sz)
+    *_r, ovf = compact_valid(hi, lo, exactly, dd)
+    assert not bool(ovf)
+    *_r, i_ovf = compact_valid_indices(exactly, dd)
+    assert not bool(i_ovf)
+    plus_one = jnp.asarray(np.arange(b) < v_sz + 1)
+    *_r, ovf = compact_valid(hi, lo, plus_one, dd)
+    assert bool(ovf)
+    *_r, i_ovf = compact_valid_indices(plus_one, dd)
+    assert bool(i_ovf)
+
+
+def test_compact_valid_counts_valid_lanes_not_distinct_keys():
+    # ONE distinct key on v_sz+1 valid lanes still trips the flag: the
+    # criterion is valid lanes, deliberately stricter than distinct
+    # keys (the compaction buffer must hold every valid lane BEFORE the
+    # dedup sort can collapse duplicates).
+    b = 1 << 15
+    dd = 4
+    v_sz = unique_buffer_size(b, dd)
+    hi, lo = _keys(np.ones((b,), np.uint64))
+    valid = jnp.asarray(np.arange(b) < v_sz + 1)
+    *_r, ovf = compact_valid(hi, lo, valid, dd)
+    assert bool(ovf)
+
+
+def test_prededup_overflow_boundary_exact_and_plus_one():
+    # Distinct-representative criterion at the same u_sz boundary:
+    # exactly u distinct keys (each on TWO valid lanes — twice the
+    # buffer in valid lanes) commits; u+1 distinct keys trips.  The
+    # duplicate-heavy exactly-full case is precisely where the two
+    # criteria diverge: compact_valid WOULD flag this batch.
+    b = 1 << 15
+    dd = 4
+    u = unique_buffer_size(b, dd)
+    vals = np.repeat(np.arange(1, u + 1, dtype=np.uint64), b // u)
+    hi, lo = _keys(vals)
+    active = jnp.ones((b,), jnp.bool_)
+    *_r, ovf = prededup(hi, lo, active, dd)
+    assert not bool(ovf)
+    *_r, cv_ovf = compact_valid(hi, lo, active, dd)
+    assert bool(cv_ovf)  # the stricter valid-lane criterion fires
+    vals_plus = vals.copy()
+    vals_plus[-1] = np.uint64(u + 1)  # u+1 distinct keys
+    hi, lo = _keys(vals_plus)
+    *_r, ovf = prededup(hi, lo, active, dd)
+    assert bool(ovf)
+
+
+# --- the sort_lanes rung (wave_loop.py's sort-geometry ladder) ----------------
+
+
+def test_sort_lanes_rung_shrinks_buffers_and_boundary():
+    b = 1 << 12
+    rung = 256
+    hi, lo = _keys(np.arange(1, b + 1, dtype=np.uint64))
+    exactly = jnp.asarray(np.arange(b) < rung)
+    v_hi, v_lo, v_orig, v_act, ovf = compact_valid(
+        hi, lo, exactly, 1, sort_lanes=rung
+    )
+    assert v_hi.shape[0] == rung  # the buffer IS the rung
+    assert not bool(ovf)
+    i_orig, i_act, n_valid, i_ovf = compact_valid_indices(
+        exactly, 1, sort_lanes=rung
+    )
+    assert i_orig.shape[0] == rung and not bool(i_ovf)
+    plus_one = jnp.asarray(np.arange(b) < rung + 1)
+    *_r, ovf = compact_valid(hi, lo, plus_one, 1, sort_lanes=rung)
+    assert bool(ovf)
+    u_hi, u_lo, u_origin, u_active, p_ovf = prededup(
+        hi, lo, plus_one, 1, sort_lanes=rung
+    )
+    assert u_hi.shape[0] == rung
+    assert bool(p_ovf)  # rung+1 distinct representatives
+
+
+def test_sort_lanes_rung_results_match_full_buffer_prefix():
+    # A rung that holds the batch is invisible: the compacted prefix —
+    # keys, origins, representatives — is bit-identical to the full
+    # worst-case buffer's (the discovery-set bit-equality gate, at the
+    # unit level).
+    rng = np.random.default_rng(12)
+    b = 1 << 12
+    rung = 512
+    vals = rng.integers(1, 1 << 40, size=b, dtype=np.uint64)
+    valid_np = rng.random(b) < 0.05  # ~200 valid lanes, under the rung
+    hi, lo = _keys(vals)
+    valid = jnp.asarray(valid_np)
+    full = compact_valid(hi, lo, valid, 1)
+    slim = compact_valid(hi, lo, valid, 1, sort_lanes=rung)
+    n = int(valid_np.sum())
+    assert not bool(full[-1]) and not bool(slim[-1])
+    for fu, sl in zip(full[:-1], slim[:-1]):
+        assert np.array_equal(np.asarray(fu)[:n], np.asarray(sl)[:n])
+    pfull = prededup(hi, lo, valid, 1)
+    pslim = prededup(hi, lo, valid, 1, sort_lanes=rung)
+    k = int(jnp.sum(pfull[3]))
+    assert int(jnp.sum(pslim[3])) == k
+    for fu, sl in zip(pfull[:-1], pslim[:-1]):
+        assert np.array_equal(np.asarray(fu)[:k], np.asarray(sl)[:k])
+
+
+def test_insert_batch_compact_sort_lanes_same_table():
+    # Insert-if-absent through a rung-sized buffer lands the same table
+    # contents as the full-buffer insert when distinct keys fit the rung.
+    rng = np.random.default_rng(3)
+    b = 1 << 10
+    rung = 256
+    vals = rng.integers(1, 1 << 40, size=rung // 2, dtype=np.uint64)
+    vals = np.concatenate([vals] * (b // vals.shape[0]))  # duplicates
+    hi, lo = _keys(vals)
+    active = jnp.ones((b,), jnp.bool_)
+    from stateright_tpu.parallel.hashset import insert_batch_compact
+
+    t0, *_r0, ok0, ovf0 = insert_batch_compact(
+        make_hashset(1 << 12), hi, lo, active, dedup_factor=1
+    )
+    t1, *_r1, ok1, ovf1 = insert_batch_compact(
+        make_hashset(1 << 12), hi, lo, active, dedup_factor=1,
+        sort_lanes=rung,
+    )
+    assert bool(ok0) and bool(ok1)
+    assert not bool(ovf0) and not bool(ovf1)
+    assert np.array_equal(np.asarray(t0.key_hi), np.asarray(t1.key_hi))
+    assert np.array_equal(np.asarray(t0.key_lo), np.asarray(t1.key_lo))
+
+
 # --- compact_valid / compact_valid_indices -----------------------------------
 
 

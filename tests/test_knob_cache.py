@@ -115,6 +115,58 @@ def test_scheduler_drops_stale_entry_and_recovers(tmp_path):
         svc.scheduler.shutdown()
 
 
+def test_sort_rung_cold_climb_persists_and_warm_run_skips_retry(tmp_path):
+    """The sort-geometry rung rides the knob cache like bucket_slack
+    (ISSUE 12 satellite): a cold run forced onto the smallest rung
+    climbs the ladder (journaled flag-4 grows), its tuned_kwargs carry
+    the discovered rung, and an identical warm run spawned from the
+    cached knobs starts AT that rung — zero rung retries, identical
+    fingerprint set."""
+    pytest.importorskip("jax")
+    import jax
+    import numpy as np
+
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.parallel.wave_loop import SORT_RUNG_MIN
+    from stateright_tpu.runtime.journal import read_journal
+
+    d = str(tmp_path / "knobs")
+    key = "twophase4|test|sort-rung"
+    cpu = jax.devices("cpu")[0]
+
+    def rung_grows(journal):
+        return [
+            e for e in read_journal(journal)
+            if e["event"] == "grow"
+            and e.get("flags", 0) & 4
+            and "sort_lanes=" in str(e.get("grown", ""))
+        ]
+
+    j_cold = str(tmp_path / "cold.jsonl")
+    cold = TwoPhaseSys(rm_count=4).checker().spawn_tpu(
+        capacity=1 << 14, max_frontier=1 << 9, device=cpu,
+        sort_lanes=SORT_RUNG_MIN, journal=j_cold,
+    ).join()
+    assert cold.unique_state_count() == 1568
+    assert rung_grows(j_cold), "cold run never climbed — forcing is dead"
+    tuned = cold.tuned_kwargs()
+    assert tuned["sort_lanes"] > SORT_RUNG_MIN
+    store_knobs(d, key, tuned, golden_unique=1568)
+
+    warm_knobs = load_knobs(d, key)
+    assert warm_knobs == {k: int(v) for k, v in tuned.items()}
+    j_warm = str(tmp_path / "warm.jsonl")
+    warm = TwoPhaseSys(rm_count=4).checker().spawn_tpu(
+        device=cpu, journal=j_warm, **warm_knobs,
+    ).join()
+    assert warm.unique_state_count() == 1568
+    assert not rung_grows(j_warm), "warm run re-paid the rung ramp"
+    assert warm.metrics()["sort_lanes"] == tuned["sort_lanes"]
+    assert np.array_equal(
+        warm.discovered_fingerprints(), cold.discovered_fingerprints()
+    )
+
+
 def test_second_job_skips_autotune_warm_start(tmp_path):
     """Satellite pin: the second identical job loads the first job's
     final geometry instead of re-running discovery — asserted via the

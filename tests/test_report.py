@@ -590,6 +590,68 @@ def test_watch_flags_recompile_storm_and_torn_lines():
     assert "recompile-storm" in line and "torn-lines=1" in line
 
 
+def test_watch_surfaces_sort_rung_and_thrash_badge():
+    """ISSUE-12 satellite: the current sort rung renders next to density
+    (latest geometry event, advanced by later rung-climb grow notes),
+    and ≥3 flag-4 rung retries inside the window raise the ⚠ badge."""
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = [
+        {"t": 0.0, "event": "geometry", "engine": "tpu-wavefront",
+         "u_lanes": 16384, "sort_lanes": 16384},
+        _wave(1.0, 2, 200, 1, 0.4, density=0.01),
+        {"t": 1.5, "event": "geometry", "engine": "tpu-wavefront",
+         "u_lanes": 16384, "sort_lanes": 2048},  # tuner downshift
+        _wave(2.0, 4, 500, 2, 0.4, density=0.02),
+    ]
+    s = summarize_events(events)
+    assert s["sort_rung"] == 2048
+    assert "rung_thrash" not in s
+    line = render_line(s)
+    assert "sort_rung=2048" in line
+
+    # Three rung-climb retries in the trailing window: the climbed rung
+    # wins (it is LATER than the geometry event) and the badge fires.
+    events += [
+        {"t": 2.0 + i, "event": "grow", "flags": 4,
+         "grown": f"sort_lanes={4096 << i}", "unique": 500, "depth": 2}
+        for i in range(3)
+    ]
+    s = summarize_events(events)
+    assert s["sort_rung"] == 16384  # 4096 -> 8192 -> 16384
+    assert s["sort_rung_retries"] == 3
+    assert s["rung_thrash"] is True
+    assert "rung-thrash" in render_line(s)
+
+
+def test_advisor_recommends_sort_rung():
+    """The geometry advisor sizes the sort rung from measured peak
+    density (4× headroom, pow2), and a mid-run rung climb overrides the
+    derivation with the proven rung — the bucket_slack rules, applied
+    to the second ladder."""
+    geometry = {
+        "t": 0.0, "event": "geometry", "engine": "tpu-wavefront",
+        "capacity": 1 << 15, "max_frontier": 1 << 11, "dedup_factor": 8,
+        "sort_lanes": 16384, "u_lanes": 16384, "waves_per_call": 4,
+    }
+    events = [
+        geometry,
+        _wave(1.0, 4, 500, 2, 0.5, density=0.02),
+        _wave(2.0, 8, 900, 4, 0.5, density=0.05),
+    ]
+    rec = analyze_journal(events)["advisor"]["recommended"]
+    # peak 0.05 * 16384 * 4x headroom = 3276.8 -> pow2 4096.
+    assert rec["sort_lanes"] == 4096
+
+    climbed = events + [
+        {"t": 3.0, "event": "grow", "flags": 4, "grown": "sort_lanes=8192",
+         "unique": 900, "depth": 4},
+    ]
+    adv = analyze_journal(climbed)["advisor"]
+    assert adv["recommended"]["sort_lanes"] == 8192
+    assert any("sort-rung overflow" in n for n in adv["notes"])
+
+
 def test_watch_summarize_service_journal():
     from stateright_tpu.obs.watch import render_line, summarize_events
 

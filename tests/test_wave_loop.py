@@ -11,9 +11,13 @@ jax = pytest.importorskip("jax")
 from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
 from stateright_tpu.parallel.wave_loop import (  # noqa: E402
     BUCKET_SLACK_DEFAULT,
+    SORT_RUNG_MIN,
     CheckpointCadence,
+    clamp_sort_lanes,
+    downshift_sort_lanes,
     exchange_bucket_lanes,
     next_bucket_slack,
+    next_sort_lanes,
     relax_dedup_geometry,
 )
 
@@ -62,6 +66,51 @@ def test_next_bucket_slack_ladder_terminates():
                 slack = nxt
                 seen += 1
                 assert seen < 32, "bucket ladder failed to terminate"
+
+
+# --- sort-geometry rung ladder -----------------------------------------------
+
+
+def test_clamp_sort_lanes_pow2_and_floor():
+    assert clamp_sort_lanes(1) == SORT_RUNG_MIN
+    assert clamp_sort_lanes(SORT_RUNG_MIN) == SORT_RUNG_MIN
+    assert clamp_sort_lanes(SORT_RUNG_MIN + 1) == SORT_RUNG_MIN * 2
+    assert clamp_sort_lanes(3000) == 4096
+    assert clamp_sort_lanes(1 << 20) == 1 << 20
+
+
+def test_next_sort_lanes_ladder_terminates_at_full_buffer():
+    """Doubling from any rung reaches the full U (where the rung
+    criterion IS the pre-ladder dedup criterion) in finitely many
+    strictly-growing steps, then reports None — the signal to fall back
+    to relax_dedup_geometry."""
+    for u_sz in (200, SORT_RUNG_MIN, 8192, 16384, 100_000):
+        rung = min(SORT_RUNG_MIN, u_sz)
+        seen = 0
+        while True:
+            nxt = next_sort_lanes(rung, u_sz)
+            if nxt is None:
+                assert rung >= u_sz
+                break
+            assert nxt > rung
+            assert nxt <= u_sz
+            rung = nxt
+            seen += 1
+            assert seen < 32, "sort-rung ladder failed to terminate"
+
+
+def test_downshift_sort_lanes_hysteresis_floor_and_cap():
+    u = 1 << 14
+    # An at-least-halving move exists: downshift to peak*headroom pow2.
+    assert downshift_sort_lanes(u, u, SORT_RUNG_MIN, 100.0) == 512
+    # Hysteresis: no move when the target would not at least halve.
+    assert downshift_sort_lanes(1024, u, SORT_RUNG_MIN, 200.0) is None
+    # The overflow-proven floor is never revisited.
+    assert downshift_sort_lanes(u, u, 4096, 100.0) == 4096
+    # Never below the ladder minimum...
+    assert downshift_sort_lanes(u, u, SORT_RUNG_MIN, 0.0) == SORT_RUNG_MIN
+    # ...and never above the full buffer (tiny-U geometries are inert).
+    assert downshift_sort_lanes(512, 512, SORT_RUNG_MIN, 1000.0) is None
 
 
 # --- shared growth rule ------------------------------------------------------
