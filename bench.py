@@ -23,8 +23,11 @@ it; every earlier line is a valid fallback record from an earlier phase):
            back to the single-phase step kernel (and says so in the
            record) rather than dying.
   phase 2+ optional phases (native C++ denominator bound, warm-vs-cold
-           serving, tiered out-of-core budget-vs-unconstrained with a
-           verdict-equality gate, roofline trace, symmetry on/off cut,
+           serving, incremental re-check latency on a one-line model
+           edit with zero-waves + verdict-equality gates (`recheck`,
+           docs/INCREMENTAL.md), tiered out-of-core
+           budget-vs-unconstrained with a verdict-equality gate,
+           roofline trace, symmetry on/off cut,
            ttfv, sharded smoke + measured exchange occupancy, reference
            suite) add keys and re-emit;
            they can never zero earlier lines.  The observability keys —
@@ -1100,6 +1103,136 @@ def phase_tiered(record: dict) -> None:
     )
 
 
+RECHECK_RM = 4  # 2pc(4): 1,568 uniques — big enough to time, fast cold
+RECHECK_REPEATS = 5  # median over this many re-eval legs
+RECHECK_WIDEN_FROM, RECHECK_WIDEN_TO = 40, 44  # GridWalk bounds
+
+
+def phase_recheck(record: dict) -> None:
+    """Incremental re-checking phase (incr/, docs/INCREMENTAL.md): the
+    success metric ROADMAP item #5 names — MEDIAN RE-CHECK LATENCY ON A
+    ONE-LINE MODEL EDIT, tracked in the trajectory like warm-vs-cold
+    serving.  Three legs, all verdict-gated:
+
+    - cold: 2pc(RM) journaled into a fresh store (the baseline the
+      re-check is measured against);
+    - property edit: the TwoPhaseEdited fixture (one property appended,
+      codec/constants identical) re-checked RECHECK_REPEATS times —
+      every leg must classify property_only, dispatch ZERO exploration
+      waves, and produce a verdict identical to a from-scratch run of
+      the edited model;
+    - constant widening: GridWalk's bound raised — the seeded run's
+      discovered_fingerprints() must be bit-identical to an
+      unconstrained cold run at the new bound.
+    """
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from stateright_tpu.incr import incremental_check
+    from stateright_tpu.models.fixtures import GridWalk, TwoPhaseEdited
+    from stateright_tpu.models.twophase import TwoPhaseSys
+    from stateright_tpu.runtime.journal import read_journal
+
+    store_dir = tempfile.mkdtemp(prefix="bench-recheck-store-")
+    jpath = os.path.join(store_dir, "journal.jsonl")
+    knobs = dict(capacity=1 << 14, max_frontier=1 << 9)
+    golden = 1_568  # 2pc(4), pinned by tests/test_tpu_wavefront.py
+
+    def waves() -> int:
+        return sum(
+            1 for e in read_journal(jpath) if e.get("event") == "wave"
+        )
+
+    # Leg 1: the cold baseline, journaled into the store.
+    ck, info = incremental_check(
+        TwoPhaseSys(rm_count=RECHECK_RM).checker(), store_dir,
+        engine_kwargs=dict(knobs), journal=jpath,
+    )
+    assert info["mode"] == "cold", info
+    assert ck.unique_state_count() == golden, ck.unique_state_count()
+    cold_sec = info["sec"]
+
+    # Reference verdict for the edited model: a from-scratch run.
+    ref = run_device(
+        lambda: TwoPhaseEdited.build(RECHECK_RM).checker().spawn_tpu(
+            **knobs
+        )
+    )
+    assert ref.unique_state_count() == golden
+
+    # Leg 2: the one-line property edit, re-checked repeatedly
+    # (store_result=False keeps every leg a genuine re-eval instead of
+    # a verdict hit on the first leg's stored entry).
+    secs = []
+    for _ in range(RECHECK_REPEATS):
+        w0 = waves()
+        ck2, info2 = incremental_check(
+            TwoPhaseEdited.build(RECHECK_RM).checker(), store_dir,
+            engine_kwargs=dict(knobs), journal=jpath, store_result=False,
+        )
+        assert info2["mode"] == "property_only", info2
+        assert waves() == w0, "property re-check dispatched exploration waves"
+        secs.append(info2["sec"])
+    assert sorted(ck2.discoveries()) == sorted(ref.discoveries())
+    for name, path in ref.discoveries().items():
+        assert ck2.discoveries()[name] == path, f"path diverged: {name}"
+    assert ck2.state_count() == ref.state_count()
+    median_sec = round(statistics.median(secs), 4)
+
+    # Leg 3: constant widening, fingerprint-equality gated.
+    ck3, info3 = incremental_check(
+        GridWalk(bound=RECHECK_WIDEN_FROM).checker(), store_dir,
+        engine_kwargs=dict(capacity=1 << 13, max_frontier=1 << 7),
+        journal=jpath,
+    )
+    assert info3["mode"] == "cold", info3
+    t_widen0 = time.time()
+    ck4, info4 = incremental_check(
+        GridWalk(bound=RECHECK_WIDEN_TO).checker(), store_dir,
+        engine_kwargs=dict(capacity=1 << 13, max_frontier=1 << 7),
+        journal=jpath,
+    )
+    widen_sec = time.time() - t_widen0
+    assert info4["mode"] == "constant_widening", info4
+    cold_widen = run_device(
+        lambda: GridWalk(bound=RECHECK_WIDEN_TO).checker().spawn_tpu(
+            capacity=1 << 13, max_frontier=1 << 7
+        )
+    )
+    assert np.array_equal(
+        ck4.discovered_fingerprints(),
+        cold_widen.discovered_fingerprints(),
+    ), "seeded widening diverged from the unconstrained cold run"
+
+    record["recheck"] = {
+        "workload": f"2pc_check_{RECHECK_RM}",
+        "cold_sec": round(cold_sec, 3),
+        "recheck_median_sec": median_sec,
+        "recheck_secs": [round(s, 4) for s in secs],
+        "speedup_vs_cold": round(cold_sec / max(median_sec, 1e-9), 1),
+        "zero_waves": True,
+        "verdict_equal": True,
+        "widen_workload": (
+            f"gridwalk_{RECHECK_WIDEN_FROM}_to_{RECHECK_WIDEN_TO}"
+        ),
+        "widen_seeded_states": info4.get("seeded_states"),
+        "widen_sec": round(widen_sec, 3),
+        "widen_unique": ck4.unique_state_count(),
+        "widen_fingerprints_equal": True,
+    }
+    # Top-level gauge the trajectory table tracks (obs/report.py).
+    record["recheck_median_sec"] = median_sec
+    log(
+        f"recheck: 2pc({RECHECK_RM}) cold {cold_sec:.2f}s -> one-line "
+        f"property edit median {median_sec:.3f}s over {RECHECK_REPEATS} "
+        f"legs ({cold_sec / max(median_sec, 1e-9):.0f}x), zero waves; "
+        f"widen {RECHECK_WIDEN_FROM}->{RECHECK_WIDEN_TO} seeded "
+        f"{info4.get('seeded_states')} states, fingerprints bit-equal"
+    )
+
+
 def _force_single_phase() -> bool:
     """Disable the two-phase expansion path (engine falls back to the
     single-phase step kernel).  Returns True if anything changed."""
@@ -1304,6 +1437,7 @@ OPTIONAL_PHASES = (
     "trajectory",
     "denominator_native",
     "serving",
+    "recheck",
     "tiered",
     "trace",
     "dedup",
@@ -1370,6 +1504,7 @@ def main() -> None:
         "trajectory": phase_trajectory,
         "denominator_native": phase_denominator_native,
         "serving": phase_serving,
+        "recheck": phase_recheck,
         "tiered": phase_tiered,
         "trace": lambda r: phase_trace(r, tuned),
         "dedup": phase_dedup,

@@ -43,11 +43,12 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
                      " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]"
                      " [--sort-lanes N]"
-                     " [--tiered] [--memory-budget-mb MB]")
+                     " [--tiered] [--memory-budget-mb MB]"
+                     " [--store-dir DIR] [--incremental]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
         "  serve [ADDRESS] [--journal PATH] [--journal-max-mb MB]"
-        " [--knob-cache DIR] [--workers N]"
+        " [--knob-cache DIR] [--workers N] [--store-dir DIR]"
     )
     lines.append(
         f"  submit [{n_meta}]{net} [--address ADDR] [--engine ENGINE]"
@@ -114,15 +115,19 @@ def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
     ``(positional_args, supervise, checkpoint_dir, resume, trace,
-    sharded, bucket_slack, sort_lanes, tiered, memory_budget_mb)`` —
+    sharded, bucket_slack, sort_lanes, tiered, memory_budget_mb,
+    store_dir, incremental)`` —
     ``sharded`` is None (single-chip), 0 (mesh over every visible
     device), or a mesh width; ``bucket_slack`` is the sharded engine's
     exchange-bucket rung in percent; ``sort_lanes`` the dedup-sort
     geometry rung (any device engine; docs/OBSERVABILITY.md "The
     dedup-sort rung ladder"); ``tiered``/``memory_budget_mb`` select
     the out-of-core engine under an HBM budget (docs/TIERED.md; the
-    budget flag alone implies ``--tiered``) — or raises ``ValueError``
-    on a malformed flag."""
+    budget flag alone implies ``--tiered``); ``store_dir`` /
+    ``incremental`` route the check through the persistent verification
+    store (docs/INCREMENTAL.md: ``--store-dir`` alone records the run,
+    ``--incremental`` additionally reuses stored entries) — or raises
+    ``ValueError`` on a malformed flag."""
     supervise = False
     resume = False
     trace = False
@@ -132,12 +137,28 @@ def _extract_runtime_flags(args):
     sort_lanes = None
     tiered = False
     memory_budget_mb = None
+    store_dir = None
+    incremental = False
     out = []
     i = 0
     while i < len(args):
         a = args[i]
         if a == "--supervise":
             supervise = True
+        elif a == "--incremental":
+            incremental = True
+        elif a == "--store-dir" or a.startswith("--store-dir="):
+            if a == "--store-dir":
+                i += 1
+                if i >= len(args):
+                    raise ValueError("--store-dir requires a directory")
+                store_dir = args[i]
+            else:
+                store_dir = a.split("=", 1)[1]
+            if not store_dir:
+                raise ValueError(
+                    "--store-dir requires a non-empty directory"
+                )
         elif a == "--resume":
             resume = True
         elif a == "--trace":
@@ -232,7 +253,7 @@ def _extract_runtime_flags(args):
         i += 1
     return (
         out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-        sort_lanes, tiered, memory_budget_mb,
+        sort_lanes, tiered, memory_budget_mb, store_dir, incremental,
     )
 
 
@@ -642,10 +663,38 @@ def example_main(spec: CliSpec, argv=None) -> int:
     try:
         (
             args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-            sort_lanes, tiered, memory_budget_mb,
+            sort_lanes, tiered, memory_budget_mb, store_dir, incremental,
         ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
+        return 2
+    if incremental and (store_dir is None or sub != "check-tpu"):
+        print(
+            "--incremental requires check-tpu with --store-dir DIR (the "
+            "persistent verification store it reuses; "
+            "docs/INCREMENTAL.md)",
+            file=sys.stderr,
+        )
+        return 2
+    if store_dir is not None and sub not in ("check-tpu", "serve"):
+        print(
+            "--store-dir requires the check-tpu subcommand (or `serve`, "
+            "where it enables the service's verification store; "
+            "docs/INCREMENTAL.md)",
+            file=sys.stderr,
+        )
+        return 2
+    if store_dir is not None and (
+        sharded is not None or tiered or trace or supervise or resume
+        or ckpt_dir is not None
+    ):
+        print(
+            "--store-dir does not combine with --sharded/--tiered/"
+            "--trace/--supervise/--checkpoint-dir/--resume (the store "
+            "journals plain spawn_tpu runs; run those modes without the "
+            "store)",
+            file=sys.stderr,
+        )
         return 2
     if (sharded is not None or bucket_slack is not None) and sub != "check-tpu":
         print(
@@ -835,11 +884,36 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 if memory_budget_mb is not None:
                     tpu_kwargs["memory_budget_mb"] = memory_budget_mb
                 checker = builder.spawn_tpu_tiered(**tpu_kwargs)
+            elif store_dir is not None:
+                # Incremental re-checking through the persistent
+                # verification store (docs/INCREMENTAL.md): classify
+                # the spec delta and take the cheapest sound path —
+                # verdict cache / property re-eval / seeded widening /
+                # loud cold run.  The store's journal.jsonl carries the
+                # incr_* evidence plus any engine events.
+                from .incr.recheck import incremental_check
+
+                checker, recheck_info = incremental_check(
+                    builder,
+                    store_dir,
+                    engine_kwargs=tpu_kwargs,
+                    journal=os.path.join(
+                        os.path.abspath(store_dir), "journal.jsonl"
+                    ),
+                    reuse=incremental,
+                )
             else:
                 checker = builder.spawn_tpu(**tpu_kwargs)
         else:
             checker = builder.spawn_bfs()
         checker.join_and_report(WriteReporter(sys.stdout))
+        if sub == "check-tpu" and store_dir is not None:
+            # One parseable line with the recheck classification, so
+            # shell pipelines and the CI smoke can gate on the mode
+            # without reading the store journal.
+            import json as _json
+
+            print("recheck: " + _json.dumps(recheck_info, sort_keys=True))
         if sub == "check-tpu" and trace:
             # One parseable line with the roofline reduction, so shell
             # pipelines (and the CI trace smoke) can gate on it without
@@ -944,9 +1018,13 @@ def example_main(spec: CliSpec, argv=None) -> int:
     if sub == "serve":
         # The checking-service daemon (serve/server.py): one process,
         # one mesh, many jobs — every registered workload is servable,
-        # whichever model module launched it.
+        # whichever model module launched it.  --store-dir was consumed
+        # by the shared runtime-flag parser above; hand it back to the
+        # daemon's own parser.
         from .serve.__main__ import main as serve_main
 
+        if store_dir is not None:
+            args = args + ["--store-dir", store_dir]
         return serve_main(args)
 
     if sub == "submit":

@@ -181,6 +181,34 @@ class CheckerBuilder:
         return serve(self, address, **kwargs)
 
 
+def property_verdicts(checker):
+    """Per-property verdict rows for a finished checker, plus the first
+    failure-classified discovery name (in the model's property order —
+    the deterministic ``violation`` the serving layer and the
+    incremental verification store both report).  ONE definition so a
+    job result (serve/portfolio.checker_summary) and a stored verdict
+    record (incr/store._summarize) can never disagree about the same
+    run."""
+    model = checker.model()
+    discoveries = checker.discoveries()
+    props = []
+    violation = None
+    for p in model.properties():
+        found = p.name in discoveries
+        classification = (
+            checker.discovery_classification(p.name) if found else None
+        )
+        if found and classification == "counterexample" and violation is None:
+            violation = p.name
+        props.append({
+            "name": p.name,
+            "expectation": p.expectation.name,
+            "discovered": found,
+            "classification": classification,
+        })
+    return props, violation
+
+
 class Checker:
     """Base checker surface.  Reference: the ``Checker`` trait,
     src/checker.rs:294-578."""

@@ -1,0 +1,37 @@
+"""Incremental re-checking: a persistent, content-addressed verification
+store that makes near-identical re-checks cheap (ROADMAP item #5 —
+verification as CI, not batch).
+
+The warm-start story used to stop at *identical* resubmission (knob +
+program caches); real verification traffic is mostly *near*-identical —
+the same model with one property tweaked or one constant widened,
+re-checked on every commit.  This package keys a completed run's
+reachable set, row log, and verdict to per-component hashes of the model
+spec (incr/spec_hash.py), persists them in a directory store built on
+the tiered engine's ColdStore sorted-run format (incr/store.py), and on
+resubmission classifies the delta and picks the cheapest sound path
+(incr/recheck.py):
+
+- identical spec          -> journaled verdict + counterexample paths,
+                             O(1), no device dispatch;
+- property-only change    -> re-evaluate the new properties over the
+                             stored row log on device, no re-exploration;
+- constant widening       -> seed the frontier and hash set from the
+                             prior reachable set, explore only the new
+                             region;
+- anything else           -> degrade LOUDLY to a cold run, with the
+                             incompatibility reason journaled.
+
+docs/INCREMENTAL.md documents the store layout, the hash components,
+the four modes, and the soundness arguments.
+"""
+
+from .recheck import incremental_check
+from .spec_hash import SpecFingerprint
+from .store import VerificationStore
+
+__all__ = [
+    "SpecFingerprint",
+    "VerificationStore",
+    "incremental_check",
+]

@@ -1,0 +1,400 @@
+"""The four re-check modes behind one call: ``incremental_check``.
+
+Given a configured :class:`~..core.checker.CheckerBuilder` and a store
+directory, classify the spec delta against the store and run the
+cheapest sound path (incr/store.py documents the modes and the
+soundness gates).  Every decision journals an ``incr_*`` event
+(``incr_classified`` / ``incr_verdict_hit`` / ``incr_property_recheck``
+/ ``incr_seeded`` / ``incr_stored`` / ``incr_store_skipped`` — rendered
+by the ``watch`` verb and obs/report.py), so the journal answers "why
+was this re-check cheap (or not)" after the fact.
+
+The verdict-cache and property-re-eval paths return lightweight
+:class:`~..core.checker.Checker` implementations over the stored data —
+the full reporting surface (counts, discoveries with re-executed
+counterexample paths, assert helpers, VIOLATION_RC classification)
+works unchanged, with zero device dispatches for the verdict cache and
+zero exploration waves for the property re-eval.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.checker import Checker
+from ..core.model import Expectation
+from ..core.path import Path
+from .spec_hash import SpecFingerprint
+from .store import (
+    COLD, CONSTANT_WIDENING, IDENTICAL, PROPERTY_ONLY, StoreEntry,
+    VerificationStore,
+)
+
+NO_SLOT = 0xFFFFFFFF
+
+# Rows per device dispatch in the property re-eval (power of two; the
+# eval is a vmapped predicate over fixed-width rows, so the chunk only
+# trades dispatch count against padding waste).
+PROPEVAL_CHUNK = 1 << 12
+
+
+class StoredVerdictChecker(Checker):
+    """A completed verdict served from the store — the content-addressed
+    verdict cache (ROADMAP #3c).  Counts and per-property verdicts come
+    from the verdict record; discovery PATHS re-execute the host model
+    along the journaled fingerprint chains on first access (O(depth)
+    host work — no device exists in this path at all)."""
+
+    def __init__(self, model, entry: StoreEntry,
+                 recheck_mode: str = IDENTICAL,
+                 discoveries: Optional[Dict[str, Path]] = None):
+        super().__init__(model)
+        self._entry = entry
+        self._summary = entry.summary
+        self._recheck_mode = recheck_mode
+        self._paths = discoveries
+        self._lock = threading.Lock()
+
+    def state_count(self) -> int:
+        return int(self._summary.get("state_count", 0))
+
+    def unique_state_count(self) -> int:
+        return int(self._summary.get("unique_state_count", 0))
+
+    def max_depth(self) -> int:
+        return int(self._summary.get("max_depth", 0))
+
+    def discoveries(self) -> Dict[str, Path]:
+        with self._lock:
+            if self._paths is None:
+                self._paths = {
+                    name: Path.from_fingerprints(
+                        self._model, d["fingerprints"]
+                    )
+                    for name, d in self._summary.get(
+                        "discoveries", {}
+                    ).items()
+                }
+            return dict(self._paths)
+
+    def discovered_fingerprints(self) -> np.ndarray:
+        """The stored reachable set (ColdStore sorted runs) — same
+        contract as the engines', read off disk instead of the device."""
+        return self._entry.fingerprints()
+
+    def is_done(self) -> bool:
+        return True
+
+    def join(self) -> "StoredVerdictChecker":
+        return self
+
+    def metrics(self) -> dict:
+        out = super().metrics()
+        out.update(
+            engine="incr-verdict-cache",
+            recheck_mode=self._recheck_mode,
+            store_entry=self._entry.entry_id,
+        )
+        return out
+
+
+def _walk_parent_chain(model, cm, rows: np.ndarray, parents: np.ndarray,
+                       slot: int):
+    """Host-side analog of the engine's device chain walk: BFS
+    positions only ever point at earlier positions, so the chain is a
+    bounded backward scan over two numpy arrays."""
+    chain = []
+    s = int(slot)
+    while s != NO_SLOT and len(chain) <= parents.shape[0]:
+        chain.append(s)
+        s = int(parents[s])
+    chain.reverse()
+    fps = [model.fingerprint(cm.decode(rows[i])) for i in chain]
+    return Path.from_fingerprints(model, fps)
+
+
+def _property_recheck(spec: SpecFingerprint, entry: StoreEntry,
+                      journal) -> StoredVerdictChecker:
+    """Mode (b): evaluate the NEW property set over the stored row log
+    on device — batched ``property_conds`` over fixed-width rows, no
+    exploration.  Discovery semantics reproduce the engine's
+    first-writer-wins-in-position-order rule exactly: the engines
+    evaluate properties at expansion, expand positions in order, and
+    take the first triggering lane, so a cold run's discovery slot for
+    an ALWAYS/SOMETIMES property is the minimal triggering BFS position
+    — which is precisely what the chunked scan below finds."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.wave_common import cached_program
+    from ..parallel.wavefront import _PROGRAM_CACHE, _PROGRAM_CACHE_MAX
+
+    t0 = time.monotonic()
+    cm = spec.compiled
+    model = spec.model
+    props = model.properties()
+    w = cm.state_width
+    snap = np.load(entry.snapshot_path, allow_pickle=False)
+    tail = int(snap["tail"])
+    rows = np.asarray(snap["rows"])[: tail * w].reshape(tail, w)
+    parents = np.asarray(snap["parent"])[:tail]
+
+    chunk = PROPEVAL_CHUNK
+    key = ("incr-propeval", cm.cache_key(),
+           tuple((p.name, p.expectation) for p in props), chunk)
+
+    def build():
+        @jax.jit
+        def eval_chunk(rows_d):
+            return jax.vmap(cm.property_conds)(rows_d)  # [chunk, P]
+
+        return eval_chunk
+
+    eval_chunk = cached_program(
+        _PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build,
+        label="incr.propeval", journal=journal,
+        provenance={"model": spec.model_label, "rows": tail,
+                    "chunk": chunk},
+    )
+
+    pending = {
+        i: p for i, p in enumerate(props)
+        if p.expectation is not Expectation.EVENTUALLY
+    }
+    slots: Dict[str, int] = {}
+    dispatches = 0
+    for off in range(0, tail, chunk):
+        if not pending:
+            break
+        n = min(chunk, tail - off)
+        block = rows[off:off + n]
+        if n < chunk:
+            block = np.concatenate(
+                [block, np.zeros((chunk - n, w), np.uint32)]
+            )
+        conds = np.asarray(eval_chunk(jnp.asarray(block)))
+        dispatches += 1
+        for i in list(pending):
+            p = pending[i]
+            col = conds[:n, i]
+            hit = ~col if p.expectation is Expectation.ALWAYS else col
+            idx = np.flatnonzero(hit)
+            if idx.size:
+                slots[p.name] = off + int(idx[0])
+                del pending[i]
+
+    paths = {
+        name: _walk_parent_chain(model, cm, rows, parents, slot)
+        for name, slot in slots.items()
+    }
+    sec = time.monotonic() - t0
+    if journal is not None:
+        journal.append(
+            "incr_property_recheck",
+            entry=entry.entry_id,
+            rows=tail,
+            dispatches=dispatches,
+            discoveries=sorted(slots),
+            sec=round(sec, 4),
+        )
+    # The re-check result rides the stored COUNTS (the reachable set —
+    # and therefore state/unique/depth — is property-independent for
+    # rows-reusable entries, incr/store.py's gate) with the freshly
+    # computed discovery paths; every derived verdict-record field
+    # (per-property verdicts, violation, fingerprint chains) is built
+    # by the ONE summary builder when the entry is stored
+    # (store._summarize via record_derived), never hand-rolled here.
+    synthetic = StoreEntry(entry.path, dict(entry.record))
+    synthetic.record["summary"] = {
+        "state_count": entry.summary.get("state_count", 0),
+        "unique_state_count": entry.summary.get("unique_state_count", 0),
+        "max_depth": entry.summary.get("max_depth", 0),
+    }
+    return StoredVerdictChecker(
+        model, synthetic, recheck_mode=PROPERTY_ONLY, discoveries=paths,
+    )
+
+
+def _seeded_snapshot(entry: StoreEntry, out_path: str) -> int:
+    """Mode (c)'s snapshot surgery: rewrite the stored COMPLETED
+    snapshot so the whole reachable set becomes level 0 of a resumed
+    run — level_start 0, level_end tail, depth 0, discoveries cleared —
+    while the row log, parent links, and fingerprint table carry over
+    verbatim.  The resumed engine then re-expands every stored state:
+    successors inside the old set dedup against the carried table, and
+    only the newly-admitted region explores (docs/INCREMENTAL.md states
+    the completeness argument).  Returns the seeded state count."""
+    snap = np.load(entry.snapshot_path, allow_pickle=False)
+    data = {k: snap[k] for k in snap.files}
+    tail = int(data["tail"])
+    data["level_start"] = np.uint32(0)
+    data["level_end"] = np.uint32(tail)
+    data["depth"] = np.uint32(0)
+    data["disc"] = np.full_like(np.asarray(data["disc"]), NO_SLOT)
+    tmp = f"{out_path}.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(fh, **data)
+    os.replace(tmp, out_path)
+    return tail
+
+
+def _join_cancellable(checker, cancel, poll_interval: float = 0.05):
+    """Join an engine run while honoring a cooperative cancel event
+    (the serve scheduler's job.cancel): the engine winds down at its
+    next host-side check, the store's completeness gate then refuses
+    the partial verdict, and the caller sees ``stop_requested()``."""
+    import time as _time
+
+    if cancel is None:
+        return checker.join()
+    while not checker.is_done():
+        if cancel.is_set():
+            checker.request_stop()
+        _time.sleep(poll_interval)
+    return checker.join()
+
+
+def incremental_check(
+    builder,
+    store_dir: str,
+    engine_kwargs: Optional[dict] = None,
+    journal=None,
+    reuse: bool = True,
+    store_result: bool = True,
+    cancel=None,
+    on_spawn=None,
+) -> Tuple[Checker, dict]:
+    """Run one verification request through the store.
+
+    ``builder`` is a configured CheckerBuilder (model, symmetry,
+    bounds); ``engine_kwargs`` are the ``spawn_tpu`` knobs a cold or
+    seeded run spawns with.  ``reuse=False`` records without reusing
+    (the CLI's ``--store-dir`` without ``--incremental``);
+    ``store_result=False`` reuses without recording (bench's repeated
+    measurement legs).  ``cancel`` (a ``threading.Event``) makes the
+    cold/seeded device runs cooperatively cancellable — a fired event
+    stops the engine, the partial verdict is refused by the store's
+    completeness gate, and the returned checker reports
+    ``stop_requested()``.  ``on_spawn`` (a callable taking the checker)
+    fires right after a cold/seeded engine spawns — the serve
+    scheduler's hook for attaching live vitals to a RUNNING job.
+    Returns ``(checker, info)`` where ``info``
+    carries ``mode`` / ``reason`` / ``spec_key`` / ``sec`` — the
+    ``recheck_mode`` evidence the CLI prints and the serve scheduler
+    folds into job results.
+
+    The returned checker is JOINED: cache hits are done by
+    construction, and recording a run requires completion anyway.
+    """
+    from ..runtime.journal import as_journal
+
+    engine_kwargs = dict(engine_kwargs or {})
+    # The store owns journal/resume routing; a caller-supplied copy of
+    # either would silently fork the evidence trail (or fight the
+    # widening path's seeded resume).
+    for reserved in ("journal", "resume_from"):
+        engine_kwargs.pop(reserved, None)
+    journal = as_journal(journal)
+    store = VerificationStore(store_dir, journal=journal)
+    t0 = time.monotonic()
+    spec = SpecFingerprint.of_builder(
+        builder, engine="tpu", engine_kwargs=engine_kwargs,
+    )
+    delta = store.classify(spec) if reuse else None
+    mode = delta.mode if delta is not None else COLD
+    reason = (
+        delta.reason if delta is not None
+        else "store recording only (reuse disabled)"
+    )
+    entry = delta.entry if delta is not None else None
+    if journal is not None:
+        journal.append(
+            "incr_classified",
+            mode=mode,
+            reason=reason,
+            spec_key=spec.spec_key,
+            entry=entry.entry_id if entry is not None else None,
+            model=spec.model_label,
+        )
+
+    info = {
+        "mode": mode,
+        "reason": reason,
+        "spec_key": spec.spec_key,
+        "entry": entry.entry_id if entry is not None else None,
+    }
+
+    if mode == IDENTICAL:
+        checker = StoredVerdictChecker(builder.model, entry)
+        if journal is not None:
+            journal.append(
+                "incr_verdict_hit",
+                entry=entry.entry_id,
+                violation=entry.summary.get("violation"),
+                unique=entry.summary.get("unique_state_count"),
+            )
+        info["sec"] = round(time.monotonic() - t0, 4)
+        return checker, info
+
+    if mode == PROPERTY_ONLY:
+        checker = _property_recheck(spec, entry, journal)
+        info["sec"] = round(time.monotonic() - t0, 4)
+        if store_result:
+            store.record_derived(
+                spec, checker, entry, engine_kwargs=engine_kwargs,
+                elapsed_sec=info["sec"],
+            )
+        return checker, info
+
+    if mode == CONSTANT_WIDENING:
+        seed_path = os.path.join(
+            store.store_dir,
+            f"seed-{os.getpid()}-{threading.get_ident()}-"
+            f"{spec.spec_key[:8]}.npz",
+        )
+        seeded_states = _seeded_snapshot(entry, seed_path)
+        if journal is not None:
+            journal.append(
+                "incr_seeded",
+                entry=entry.entry_id,
+                seeded_states=seeded_states,
+            )
+        try:
+            checker = builder.spawn_tpu(
+                resume_from=seed_path, journal=journal, **engine_kwargs
+            )
+            if on_spawn is not None:
+                on_spawn(checker)
+            _join_cancellable(checker, cancel)
+        finally:
+            try:
+                os.remove(seed_path)
+            except OSError:
+                pass
+        info["seeded_states"] = seeded_states
+        info["sec"] = round(time.monotonic() - t0, 4)
+        if store_result:
+            store.record(
+                spec, checker, engine_kwargs=engine_kwargs,
+                recheck_mode=CONSTANT_WIDENING,
+                elapsed_sec=info["sec"], seeded=True,
+            )
+        return checker, info
+
+    # Cold: the ordinary engine run, journaled into the store.
+    checker = builder.spawn_tpu(journal=journal, **engine_kwargs)
+    if on_spawn is not None:
+        on_spawn(checker)
+    _join_cancellable(checker, cancel)
+    info["sec"] = round(time.monotonic() - t0, 4)
+    if store_result:
+        store.record(
+            spec, checker, engine_kwargs=engine_kwargs,
+            recheck_mode=COLD, elapsed_sec=info["sec"],
+        )
+    return checker, info

@@ -1,0 +1,318 @@
+"""Per-component spec hashing for the incremental verification store.
+
+A verification request is identified by FIVE separately hashed
+components, so the store can tell not just "same or different" but
+*which part* changed — the classification the re-check modes hang off
+(docs/INCREMENTAL.md):
+
+- ``codec``      — the transition machinery's CODE: packed layout widths
+                   and the bytecode digests of ``encode`` / ``step`` (and
+                   the two-phase / boundary hooks).  Two specs with equal
+                   codec hashes run the same kernels over the same row
+                   layout.
+- ``constants``  — the model's DATA (``CompiledModel.spec_constants``)
+                   plus the packed-init digest: what the code closes
+                   over.  Separated from the codec so "one constant
+                   bumped" is visible as exactly one changed component.
+- ``properties`` — property names, expectations, and the bytecode
+                   digests of both the host conditions and the device
+                   ``property_conds`` kernel.
+- ``symmetry``   — off, or the canon spec's digest.
+- ``bounds``     — exploration bounds that change what a "complete" run
+                   means: target depth/state count and the finish_when
+                   policy.
+
+An ``engine`` hash (engine name + kwargs) is recorded as evidence but
+deliberately EXCLUDED from every matching decision: the engines pin
+discovery-set invariance across geometry (capacity, frontier, rungs,
+mesh size — tests/test_sort_rung.py, test_tpu_sharded.py, test_tiered.py),
+so a geometry-only change still hits the verdict cache.
+
+Determinism is a hard requirement (the hashes persist across processes
+and must survive a fresh ``PYTHONHASHSEED``): everything routes through
+sha256 over canonically ordered bytes — no ``hash()``, no dict-order
+dependence (pinned by the subprocess test in tests/test_incr.py).
+Bytecode digests are interpreter-build-scoped, so the store version
+string folds in ``sys.implementation.cache_tag``: a store written by
+one Python reads as cold (never as wrong) under another.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+from typing import Optional
+
+from ..core.model import Expectation
+
+# Bump when the hash recipe changes: old entries then classify as cold
+# (a different spec_key), never as a false hit.
+HASH_VERSION = "incr-spec-v1"
+
+
+def _stable_repr(v) -> str:
+    """A PYTHONHASHSEED-independent rendering of one constant-ish
+    value.  Sets/frozensets iterate in hash order, so a plain ``repr``
+    of a set literal inside a property condition would digest
+    differently per process — they fold sorted.  Opaque objects (e.g. a
+    model instance a lambda closed over) fold as their TYPE only: their
+    DATA is the constants component's job (``spec_constants``), and an
+    identity repr would leak a memory address into the digest."""
+    if isinstance(v, (frozenset, set)):
+        return "{" + ",".join(sorted(_stable_repr(x) for x in v)) + "}"
+    if isinstance(v, tuple):
+        return "(" + ",".join(_stable_repr(x) for x in v) + ")"
+    if isinstance(v, dict):
+        return "{" + ",".join(sorted(
+            f"{_stable_repr(k)}:{_stable_repr(x)}" for k, x in v.items()
+        )) + "}"
+    if v is None or isinstance(
+        v, (int, float, bool, str, bytes, complex)
+    ):
+        return repr(v)
+    if hasattr(v, "co_code"):  # nested code object (a nested lambda)
+        h = hashlib.sha256()
+        _code_digest(h, v)
+        return "code:" + h.hexdigest()
+    return f"<{type(v).__qualname__}>"
+
+
+def _code_digest(h, code) -> None:
+    """Fold one code object into ``h``: opcode stream, names, and
+    consts (recursing into nested code objects — property lambdas close
+    over helpers)."""
+    h.update(code.co_code)
+    h.update(repr(code.co_names).encode())
+    h.update(repr(code.co_varnames).encode())
+    for const in code.co_consts:
+        h.update(_stable_repr(const).encode())
+
+
+def code_digest(fn) -> str:
+    """Stable digest of a function's BEHAVIORAL identity: bytecode,
+    referenced names, default arguments, captured closure values, and —
+    one call-graph hop at a time, transitively — the code of
+    MODULE-LEVEL functions (and the values of module-level primitives)
+    it references by name.  A subclass that inherits a method digests
+    identically to its parent; an edited source line, default,
+    captured threshold, or shared module-level helper body — the
+    classic one-line model edits — all change the digest.
+
+    Known coarse spots, by design: helpers reached through ATTRIBUTE
+    lookup (``self._helper``, ``module.fn``) are not resolvable from a
+    name list and do not fold — the CompiledModel hooks the spec hash
+    cares about are each digested explicitly (spec components), and
+    model DATA lives in the constants component; closure cells and
+    globals holding opaque objects fold as their type only
+    (:func:`_stable_repr`)."""
+    h = hashlib.sha256()
+    _fold_function(h, fn, set())
+    return h.hexdigest()
+
+
+def _fold_function(h, fn, seen) -> None:
+    fn = getattr(fn, "__func__", fn)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # Builtins / partials with no code object: fall back to the
+        # qualified name (stable, just coarser).
+        h.update(repr(getattr(fn, "__qualname__", repr(fn))).encode())
+        return
+    if id(code) in seen:  # recursion/cycles among helpers
+        return
+    seen.add(id(code))
+    _code_digest(h, code)
+    h.update(_stable_repr(getattr(fn, "__defaults__", None)
+                          or ()).encode())
+    h.update(_stable_repr(getattr(fn, "__kwdefaults__", None)
+                          or {}).encode())
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            contents = cell.cell_contents
+        except ValueError:  # empty cell
+            h.update(b"<empty-cell>")
+            continue
+        if hasattr(contents, "__code__"):
+            _fold_function(h, contents, seen)
+        else:
+            h.update(_stable_repr(contents).encode())
+    # Referenced globals: plain functions fold their own code (so an
+    # edit to a shared module-level helper changes every caller's
+    # digest), primitive module constants fold their value.  Classes,
+    # modules, and other opaque globals are skipped — the names
+    # themselves already rode in via co_names.
+    g = getattr(fn, "__globals__", None)
+    if g is not None:
+        for name in sorted(set(code.co_names)):
+            if name not in g:
+                continue
+            v = g[name]
+            if callable(v) and hasattr(v, "__code__"):
+                _fold_function(h, v, seen)
+            elif v is None or isinstance(
+                v, (int, float, bool, str, bytes, complex, tuple,
+                    frozenset)
+            ):
+                h.update(_stable_repr(v).encode())
+
+
+def _hexdigest(*parts: str) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _method_digests(cm, names) -> str:
+    out = []
+    for name in names:
+        fn = getattr(type(cm), name, None)
+        out.append(f"{name}={'-' if fn is None else code_digest(fn)}")
+    return ";".join(out)
+
+
+class SpecFingerprint:
+    """The hashed identity of one verification request.
+
+    Attributes:
+        components: component name -> hex digest.
+        spec_key: content address of the FULL spec (engine excluded).
+        family_key: codec+symmetry+bounds — the grouping under which
+            property-only and constant-widening relatives are sought.
+        constants: the raw ``spec_constants()`` dict (None when the
+            model declares no stable constants — every reuse path then
+            refuses loudly).
+        property_names / expectations: the model's property list, in
+            order (the device property vector's order).
+        has_eventually: whether any property is EVENTUALLY — the re-eval
+            and seeding modes refuse those (their verdicts depend on
+            path structure, not per-state predicates alone).
+        snapshot_key: the engine-snapshot compatibility key a stored
+            run must carry for its snapshot to be seedable here.
+    """
+
+    def __init__(self, model, compiled=None, symmetry: bool = False,
+                 target_max_depth: Optional[int] = None,
+                 target_state_count: Optional[int] = None,
+                 finish_when=None, engine: str = "tpu",
+                 engine_kwargs: Optional[dict] = None):
+        from ..parallel.compiled import compiled_model_for
+        from ..parallel.wavefront import snapshot_engine_key
+
+        cm = compiled or compiled_model_for(model)
+        self.model = model
+        self.compiled = cm
+        self.model_label = type(cm).__qualname__
+        props = model.properties()
+        self.property_names = [p.name for p in props]
+        self.expectations = [p.expectation.name for p in props]
+        self.has_eventually = any(
+            p.expectation is Expectation.EVENTUALLY for p in props
+        )
+        self.symmetry = bool(symmetry)
+        self.engine = engine
+
+        codec = _hexdigest(
+            HASH_VERSION,
+            sys.implementation.cache_tag or "py",
+            str(cm.state_width),
+            str(cm.max_actions),
+            str(cm.fp_words or 0),
+            str(bool(getattr(cm, "step_flags", False))),
+            _method_digests(
+                cm,
+                ("encode", "step", "step_valid", "step_lane", "boundary"),
+            ),
+        )
+
+        self.constants = cm.spec_constants()
+        import numpy as np
+
+        init_digest = hashlib.sha256(
+            np.ascontiguousarray(cm.init_packed()).tobytes()
+        ).hexdigest()
+        if self.constants is None:
+            constants = _hexdigest("unstable", init_digest)
+        else:
+            constants = _hexdigest(
+                json.dumps(
+                    {str(k): str(v) for k, v in self.constants.items()},
+                    sort_keys=True,
+                ),
+                init_digest,
+            )
+
+        properties = _hexdigest(
+            json.dumps(
+                [
+                    {"name": p.name, "expectation": p.expectation.name,
+                     "condition": code_digest(p.condition)}
+                    for p in props
+                ]
+            ),
+            _method_digests(cm, ("property_conds",)),
+        )
+
+        if not symmetry:
+            sym = _hexdigest("off")
+        else:
+            spec = cm.canon_spec() if hasattr(cm, "canon_spec") else None
+            sym = _hexdigest(
+                "on",
+                repr(spec),
+                _method_digests(cm, ("canon_rows",)),
+            )
+
+        fw_kind = getattr(finish_when, "_kind", "all")
+        fw_names = sorted(getattr(finish_when, "_names", ()) or ())
+        bounds = _hexdigest(
+            str(target_max_depth or 0),
+            str(target_state_count or 0),
+            fw_kind,
+            json.dumps(fw_names),
+        )
+        self.target_max_depth = target_max_depth
+        self.target_state_count = target_state_count
+
+        eng = _hexdigest(
+            engine,
+            json.dumps(
+                {str(k): repr(v) for k, v in (engine_kwargs or {}).items()},
+                sort_keys=True,
+            ),
+        )
+
+        self.components = {
+            "codec": codec,
+            "constants": constants,
+            "properties": properties,
+            "symmetry": sym,
+            "bounds": bounds,
+            "engine": eng,
+        }
+        self.spec_key = _hexdigest(
+            codec, constants, properties, sym, bounds
+        )
+        self.family_key = _hexdigest(codec, sym, bounds)
+        self.snapshot_key = snapshot_engine_key(cm, props, symmetry)
+
+    @classmethod
+    def of_builder(cls, builder, compiled=None, engine: str = "tpu",
+                   engine_kwargs: Optional[dict] = None
+                   ) -> "SpecFingerprint":
+        """Fingerprint a configured :class:`~..core.checker.
+        CheckerBuilder` — the one construction path the CLI, the serve
+        scheduler, and the tests all share, so the hashed bounds can
+        never drift from what the spawned engine would actually run."""
+        return cls(
+            builder.model,
+            compiled=compiled,
+            symmetry=builder._symmetry is not None,
+            target_max_depth=builder._target_max_depth,
+            target_state_count=builder._target_state_count,
+            finish_when=builder._finish_when,
+            engine=engine,
+            engine_kwargs=engine_kwargs,
+        )

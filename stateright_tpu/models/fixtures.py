@@ -231,6 +231,17 @@ class TrapCounterCompiled(CompiledModel):
 
         return CanonSpec(n=0)
 
+    def spec_constants(self):
+        """TrapCounter is not a dataclass, so the incremental store's
+        default constants derivation (parallel/compiled.py) cannot see
+        ``limit``/``trap_at`` — declared explicitly so trap-counter
+        entries participate in the store instead of degrading to
+        "no stable constants"."""
+        return {
+            "limit": repr(self.model.limit),
+            "trap_at": repr(self.model.trap_at),
+        }
+
 
 def cli_spec():
     """CLI/workload spec for :class:`TrapCounter` — the smallest
@@ -257,6 +268,157 @@ def main(argv=None) -> int:
     from ..cli import example_main
 
     return example_main(cli_spec(), argv)
+
+
+@dataclass(frozen=True)
+class GridWalk(Model):
+    """Monotone walk on the integer grid ``[0, bound]²`` — the fixture
+    for the incremental store's CONSTANT-WIDENING mode (incr/,
+    docs/INCREMENTAL.md): the packed encoding is bound-independent
+    (x and y each ride a 16-bit lane), the transition function emits
+    the same candidate successors at every bound, and ``bound`` only
+    prunes via the boundary — so raising it is a declared monotone
+    reachable-set widening (``spec_widens``), exactly the "one constant
+    bumped" re-check the store seeds from the prior reachable set.
+    ``(bound+1)²`` unique states at depth ``2·bound``.  The always
+    property never violates, so a completed run is exhaustive (every
+    state stays awaited — the store's row-reuse witness)."""
+
+    bound: int = 4
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.append("right")
+        actions.append("up")
+
+    def next_state(self, state, action):
+        x, y = state
+        return (x + 1, y) if action == "right" else (x, y + 1)
+
+    def within_boundary(self, state):
+        x, y = state
+        return x <= self.bound and y <= self.bound
+
+    def properties(self):
+        return [
+            Property.always(
+                "in bounds",
+                lambda m, s: 0 <= s[0] <= m.bound and 0 <= s[1] <= m.bound,
+            ),
+            Property.sometimes(
+                "reaches corner",
+                lambda m, s: s[0] == m.bound and s[1] == m.bound,
+            ),
+        ]
+
+    def compiled(self):
+        return GridWalkCompiled(self)
+
+
+class GridWalkCompiled(CompiledModel):
+    state_width = 1
+    max_actions = 2
+
+    def __init__(self, model: GridWalk):
+        if not 0 <= model.bound < (1 << 15):
+            raise ValueError("GridWalk bound must fit a 16-bit lane")
+        self.model = model
+
+    def encode(self, state):
+        x, y = state
+        return np.array([x | (y << 16)], np.uint32)
+
+    def decode(self, words):
+        w = int(words[0])
+        return (w & 0xFFFF, w >> 16)
+
+    def step(self, state):
+        import jax.numpy as jnp
+
+        w = state[0]
+        right = jnp.stack([w + jnp.uint32(1)])
+        up = jnp.stack([w + jnp.uint32(1 << 16)])
+        nexts = jnp.stack([right, up])
+        valid = jnp.ones((2,), jnp.bool_)
+        return nexts, valid
+
+    def boundary(self, state):
+        import jax.numpy as jnp
+
+        w = state[0]
+        b = jnp.uint32(self.model.bound)
+        return ((w & jnp.uint32(0xFFFF)) <= b) & ((w >> jnp.uint32(16)) <= b)
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        w = state[0]
+        b = jnp.uint32(self.model.bound)
+        x = w & jnp.uint32(0xFFFF)
+        y = w >> jnp.uint32(16)
+        return jnp.stack([(x <= b) & (y <= b), (x == b) & (y == b)])
+
+    def spec_widens(self, old_constants: dict) -> bool:
+        """Raising ``bound`` only ever ADDS reachable states: every old
+        state keeps its packed row, its candidate successors, and its
+        in-old-bounds successors, and the boundary admits a superset —
+        the store's constant-widening contract."""
+        try:
+            old_bound = int(str(old_constants["bound"]))
+        except (KeyError, TypeError, ValueError):
+            return False
+        return set(old_constants) == {"bound"} and (
+            old_bound <= self.model.bound
+        )
+
+
+class TwoPhaseEdited:
+    """The "one-line model edit" fixture for the incremental store's
+    PROPERTY-ONLY mode: two-phase commit with one property appended —
+    codec, constants, and symmetry hash identical to the stock model
+    (the subclasses below inherit ``encode``/``step`` unchanged, so the
+    code digests match), only the property component differs.  Used by
+    tests/test_incr.py, the CI incremental smoke, and bench.py's
+    ``recheck`` phase as the canonical near-identical resubmission."""
+
+    @staticmethod
+    def build(rm_count: int) -> Model:
+        from dataclasses import dataclass as _dc
+
+        from .twophase import PREPARED, TwoPhaseSys
+        from .twophase_compiled import TwoPhaseCompiled, _U32
+
+        class _EditedCompiled(TwoPhaseCompiled):
+            def property_conds(self, state):
+                import jax.numpy as jnp
+
+                base = TwoPhaseCompiled.property_conds(self, state)
+                n = self.n
+                w0 = state[0]
+                some_prepared = jnp.zeros((), jnp.bool_)
+                for rm in range(n):
+                    rs = (w0 >> _U32(2 * rm)) & _U32(3)
+                    some_prepared |= rs == _U32(PREPARED)
+                return jnp.concatenate([base, some_prepared[None]])
+
+        @_dc(frozen=True)
+        class _Edited(TwoPhaseSys):
+            def properties(self):
+                return TwoPhaseSys.properties(self) + [
+                    Property.sometimes(
+                        "some rm prepared",
+                        lambda _m, s: any(
+                            r == PREPARED for r in s.rm_state
+                        ),
+                    ),
+                ]
+
+            def compiled(self):
+                return _EditedCompiled(self)
+
+        return _Edited(rm_count=rm_count)
 
 
 class FnModel(Model):

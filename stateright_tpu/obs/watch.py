@@ -182,6 +182,19 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
         if len(tail_retries) >= SORT_THRASH_RETRIES:
             out["rung_thrash"] = True
             out["warnings"].append("rung-thrash")
+    # Incremental re-checking (incr/, docs/INCREMENTAL.md): the latest
+    # classification's mode is the one-word answer to "did this
+    # re-check reuse anything", plus the cumulative verdict-cache hits.
+    incr_modes = [
+        e.get("mode") for e in events
+        if e.get("event") == "incr_classified" and e.get("mode")
+    ]
+    if incr_modes:
+        out["recheck"] = incr_modes[-1]
+    hits = sum(1 for e in events if e.get("event") == "incr_verdict_hit")
+    if hits:
+        out["verdict_hits"] = hits
+
     kinds = {e.get("event") for e in events}
     if "engine_done" in kinds or "supervisor_done" in kinds:
         out["done"] = True
@@ -224,6 +237,10 @@ def render_line(s: dict) -> str:
             parts.append(f"waves={s['waves']}")
         if s.get("grows"):
             parts.append(f"grows={s['grows']}")
+    if "recheck" in s:
+        parts.append(f"recheck={s['recheck']}")
+    if s.get("verdict_hits"):
+        parts.append(f"verdict_hits={s['verdict_hits']}")
     if s.get("compiles"):
         parts.append(f"compiles={s['compiles']}")
     if s.get("done"):

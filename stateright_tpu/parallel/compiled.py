@@ -138,13 +138,50 @@ class CompiledModel:
         compiled models with equal keys must trace identical programs.
         The default covers models whose ``repr`` captures their full
         configuration (e.g. frozen dataclasses); others get per-instance
-        keys (correct, just no sharing)."""
+        keys (correct, just no sharing).  In-process only — the
+        PERSISTENT spec identity lives in :mod:`..incr.spec_hash`, which
+        deliberately never uses ``hash()`` or ``id()``-flavored reprs."""
         return (
             type(self).__qualname__,
             self.state_width,
             self.max_actions,
             repr(self.model),
         )
+
+    # --- persistent spec identity (incr/spec_hash.py) -------------------------
+
+    def spec_constants(self) -> Optional[dict]:
+        """The model's CONSTANTS as a flat name -> repr dict — the
+        "constants" component of the persistent spec hash
+        (incr/spec_hash.py): the data the transition function closes
+        over, separated from its CODE so the incremental store can
+        classify "same model, one constant changed" without re-running
+        anything.  The default reads dataclass fields (deterministic
+        and ``PYTHONHASHSEED``-independent for the int/str/bool fields
+        real models use); non-dataclass models return None — "no stable
+        constants declaration" — and the store then refuses every reuse
+        path LOUDLY rather than risk two differently-parameterized
+        models hashing alike (docs/INCREMENTAL.md)."""
+        import dataclasses
+
+        if dataclasses.is_dataclass(self.model):
+            return {
+                f.name: repr(getattr(self.model, f.name))
+                for f in dataclasses.fields(self.model)
+            }
+        return None
+
+    def spec_widens(self, old_constants: dict) -> bool:
+        """Does THIS model's constant set describe a monotone
+        reachable-set WIDENING of ``old_constants`` (a prior run of the
+        same codec — e.g. a boundary bound raised, with the packed
+        encoding and transition semantics of every old state
+        unchanged)?  When True, the incremental store may seed a
+        re-check's frontier and hash set from the prior reachable set
+        and explore only the new region (docs/INCREMENTAL.md states the
+        soundness argument).  Default False: widening is a per-model
+        semantic claim and must never be inferred structurally."""
+        return False
 
 def compiled_model_for(model: Model) -> CompiledModel:
     """Resolve the compiled form of ``model``.

@@ -149,6 +149,39 @@ def _resize_flat(arr, new_len: int, fill):
     out = jnp.full((new_len,), fill, arr.dtype)
     return jax.lax.dynamic_update_slice(out, arr, (0,))
 
+def snapshot_engine_key(cm, properties, symmetric: bool) -> str:
+    """Process-stable compatibility key for engine snapshots.
+    Deliberately avoids ``cache_key()`` (whose default embeds
+    ``repr(model)``, which is identity-based for some models and would
+    spuriously reject resumes in a new process); the packed init states
+    hash in the model configuration instead.  Table/log geometry is NOT
+    part of the key — a resume adopts the snapshot's persisted sizes
+    (which may have been auto-tuned mid-run past the spawn arguments).
+    Module-level (rather than a checker method) so the incremental
+    store (incr/) can pre-check that a stored snapshot is seedable for
+    a new spec WITHOUT spawning a checker that would die loudly on the
+    mismatch."""
+    import hashlib
+
+    init_digest = hashlib.sha256(
+        cm.init_packed().tobytes()
+    ).hexdigest()[:16]
+    return repr(
+        (
+            "rowlog-v3",  # flat row log + decoupled log_capacity (r4)
+            type(cm).__qualname__,
+            cm.state_width,
+            cm.max_actions,
+            tuple(p.name for p in properties),
+            init_digest,
+        )
+        # A symmetry run's table holds CANONICAL fingerprints — not
+        # resumable as a plain run (or vice versa).  Appended only
+        # when on, so existing non-sym snapshots stay valid.
+        + (("sym",) if symmetric else ())
+    )
+
+
 # Compiled device programs shared across checker instances (keyed by
 # CompiledModel.cache_key() + engine shape knobs): re-tracing and re-jitting
 # per spawn_tpu() call would otherwise dominate wall-clock.  Bounded FIFO:
@@ -1864,32 +1897,8 @@ class TpuChecker(Checker):
         return max(self._max_frontier, u)
 
     def _snapshot_key(self) -> str:
-        """Process-stable compatibility key for snapshots.  Deliberately
-        avoids ``cache_key()`` (whose default embeds ``repr(model)``, which
-        is identity-based for some models and would spuriously reject
-        resumes in a new process); the packed init states hash in the model
-        configuration instead.  Table/log geometry is NOT part of the key —
-        a resume adopts the snapshot's persisted sizes (which may have been
-        auto-tuned mid-run past the spawn arguments)."""
-        import hashlib
-
-        cm = self._compiled
-        init_digest = hashlib.sha256(
-            cm.init_packed().tobytes()
-        ).hexdigest()[:16]
-        return repr(
-            (
-                "rowlog-v3",  # flat row log + decoupled log_capacity (r4)
-                type(cm).__qualname__,
-                cm.state_width,
-                cm.max_actions,
-                tuple(p.name for p in self._properties),
-                init_digest,
-            )
-            # A symmetry run's table holds CANONICAL fingerprints — not
-            # resumable as a plain run (or vice versa).  Appended only
-            # when on, so existing non-sym snapshots stay valid.
-            + (("sym",) if self._canon is not None else ())
+        return snapshot_engine_key(
+            self._compiled, self._properties, self._canon is not None
         )
 
     def save_snapshot(self, path: str) -> None:
@@ -2183,11 +2192,22 @@ class TpuChecker(Checker):
         need = self._max_depth + 2
         length = 1 << max(4, (need - 1).bit_length())
         parent_dev, rows_dev = self._tables_dev
-        chain_fn = self._chain_program(length)
-        ch, rows_l = chain_fn(parent_dev, rows_dev, jnp.uint32(slot))
-        ch = np.asarray(ch)
-        rows_l = np.asarray(rows_l)
-        chain = [i for i, s in enumerate(ch) if s != NO_SLOT_HOST]
+        n = self._log_capacity + self._block_pad()
+        while True:
+            chain_fn = self._chain_program(length)
+            ch, rows_l = chain_fn(parent_dev, rows_dev, jnp.uint32(slot))
+            ch = np.asarray(ch)
+            rows_l = np.asarray(rows_l)
+            chain = [i for i, s in enumerate(ch) if s != NO_SLOT_HOST]
+            if len(chain) < length or length >= n:
+                break
+            # Every buffer lane came back valid: the chain may be
+            # TRUNCATED.  The run's own max_depth under-estimates chain
+            # length when the parent links predate this run — a seeded
+            # incremental re-check (incr/recheck.py) carries a completed
+            # store's parents, whose chains span the ORIGINAL run's
+            # depth, not the seeded run's.  Double and re-walk.
+            length *= 2
         chain.reverse()
         fps = [
             self._model.fingerprint(self._compiled.decode(rows_l[i]))

@@ -27,7 +27,12 @@ docs/ACTORS.md): ``chaos_start``, ``chaos_drop``, ``chaos_duplicate``,
 ``chaos_reorder``, ``chaos_delay``, ``chaos_partition``, ``orl_give_up``,
 ``audit``.  Service events (``serve/``, see docs/SERVING.md):
 ``service_start``/``service_stop``, the ``job_*`` lifecycle family, and
-``job_span`` per-job duration spans.
+``job_span`` per-job duration spans.  Incremental-store events
+(``incr/``, see docs/INCREMENTAL.md): ``incr_classified`` (delta mode +
+reason), ``incr_verdict_hit``, ``incr_property_recheck``,
+``incr_seeded``, ``incr_stored``, ``incr_store_skipped`` — rendered by
+the ``watch`` verb and obs/report.py's "Incremental re-checking"
+section.
 """
 
 from __future__ import annotations

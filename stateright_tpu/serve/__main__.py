@@ -2,12 +2,16 @@
 
     python -m stateright_tpu.serve [HOST:PORT]
         [--journal PATH] [--journal-max-mb MB] [--knob-cache DIR]
-        [--workers N]
+        [--workers N] [--store-dir DIR]
 
 ``--journal-max-mb`` size-caps the journal into rotated segments
 (``journal.jsonl.1..N``, runtime/journal.py) so a long-lived daemon
 cannot grow one unbounded file; readers (``report``, read_journal)
-merge segments transparently.
+merge segments transparently.  ``--store-dir`` enables the persistent
+verification store for jobs submitted with ``store: true``
+(docs/INCREMENTAL.md): identical resubmissions short-circuit to the
+journaled verdict, near-identical ones take the cheapest sound
+re-check path.
 
 Serves until interrupted.  docs/SERVING.md documents the endpoints,
 the job lifecycle, and the journal layout.
@@ -29,6 +33,7 @@ def main(argv=None) -> int:
     journal = None
     journal_max_mb = None
     knob_cache = None
+    store_dir = None
     workers = 1
     positional = []
     i = 0
@@ -57,6 +62,12 @@ def main(argv=None) -> int:
                 print("--knob-cache requires a directory", file=sys.stderr)
                 return 2
             knob_cache = args[i]
+        elif a == "--store-dir":
+            i += 1
+            if i >= len(args):
+                print("--store-dir requires a directory", file=sys.stderr)
+                return 2
+            store_dir = args[i]
         elif a == "--workers":
             i += 1
             try:
@@ -102,7 +113,7 @@ def main(argv=None) -> int:
     )
     serve(
         (host, port), block=True, journal=journal,
-        knob_cache_dir=knob_cache, workers=workers,
+        knob_cache_dir=knob_cache, workers=workers, store_dir=store_dir,
     )
     return 0
 

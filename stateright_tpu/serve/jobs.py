@@ -62,6 +62,7 @@ class JobSpec:
         "workload", "n", "network", "engine", "engine_kwargs", "symmetry",
         "target_max_depth", "target_state_count", "timeout", "finish_when",
         "seed", "threads", "priority", "portfolio", "use_knob_cache",
+        "store",
     )
 
     def __init__(
@@ -81,6 +82,7 @@ class JobSpec:
         priority: int = 0,
         portfolio: Optional[dict] = None,
         use_knob_cache: bool = True,
+        store: bool = False,
     ):
         if not workload or not isinstance(workload, str):
             raise ValueError("workload must be a nonempty string")
@@ -114,6 +116,21 @@ class JobSpec:
                 f"engine {engine!r} takes no engine_kwargs "
                 "(host-engine tuning is the threads field)"
             )
+        if store:
+            # The verification store journals single-chip wavefront
+            # runs (docs/INCREMENTAL.md): a portfolio's diversified
+            # members explore property-dependently, and other engines
+            # don't produce the store's snapshot format — silently
+            # running them un-stored would make `store: true` a lie.
+            if engine != "tpu":
+                raise ValueError(
+                    "store requires engine 'tpu' (the verification "
+                    "store journals single-chip wavefront runs)"
+                )
+            if portfolio is not None:
+                raise ValueError(
+                    "store does not combine with portfolio jobs"
+                )
         self.workload = workload
         self.n = None if n is None else int(n)
         self.network = network
@@ -133,6 +150,7 @@ class JobSpec:
         self.priority = int(priority)
         self.portfolio = None if portfolio is None else dict(portfolio)
         self.use_knob_cache = bool(use_knob_cache)
+        self.store = bool(store)
 
     @classmethod
     def from_dict(cls, data: dict) -> "JobSpec":

@@ -272,24 +272,15 @@ def checker_summary(checker) -> dict:
     """The common result shape for one finished checker: counts, per-
     property verdicts, encoded discoveries, and the first failure-
     classified discovery (in the model's property order — the
-    deterministic 'violation' the portfolio race keys on)."""
+    deterministic 'violation' the portfolio race keys on).  The
+    verdict/violation computation is the shared
+    core/checker.property_verdicts — the incremental store's records
+    (incr/store.py) use the same one."""
+    from ..core.checker import property_verdicts
+
     model = checker.model()
     discoveries = checker.discoveries()
-    props = []
-    violation = None
-    for p in model.properties():
-        found = p.name in discoveries
-        classification = (
-            checker.discovery_classification(p.name) if found else None
-        )
-        if found and classification == "counterexample" and violation is None:
-            violation = p.name
-        props.append({
-            "name": p.name,
-            "expectation": p.expectation.name,
-            "discovered": found,
-            "classification": classification,
-        })
+    props, violation = property_verdicts(checker)
     return {
         "state_count": checker.state_count(),
         "unique_state_count": checker.unique_state_count(),

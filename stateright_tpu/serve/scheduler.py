@@ -52,6 +52,7 @@ class Scheduler:
         workers: int = 1,
         poll_interval: float = 0.02,
         retain_checkers: int = 4,
+        store_dir: Optional[str] = None,
     ):
         """``retain_checkers`` caps how many completed jobs keep their
         checker alive for Explorer attach: a finished wavefront checker
@@ -62,6 +63,12 @@ class Scheduler:
         self.store = store
         self.journal = journal
         self.knob_cache_dir = knob_cache_dir
+        # The persistent verification store (incr/, docs/INCREMENTAL.md)
+        # jobs opt into with ``store: true``: identical resubmissions
+        # short-circuit to the journaled verdict, near-identical ones
+        # take the cheapest sound re-check path.  The recheck-mode
+        # counters below are the /.metrics evidence.
+        self.store_dir = store_dir
         self._retain = max(0, retain_checkers)
         self._retained: List[Job] = []  # oldest first
         self._retain_lock = threading.Lock()
@@ -69,6 +76,8 @@ class Scheduler:
             jobs_submitted=0, jobs_completed=0, jobs_failed=0,
             jobs_cancelled=0, knob_cache_hits=0, knob_cache_misses=0,
             portfolio_wins=0, violations_found=0, unique_states_total=0,
+            verdict_cache_hits=0, recheck_property_only=0,
+            recheck_constant_widening=0, recheck_cold=0,
         )
         self._poll = poll_interval
         self._cond = threading.Condition()
@@ -334,6 +343,8 @@ class Scheduler:
 
     def _run_single(self, job: Job, _retry: bool = False) -> dict:
         spec = job.spec
+        if spec.store:
+            return self._run_stored(job)
         model, cli, builder, n = self._make_builder(
             spec, spec.engine, spec.symmetry
         )
@@ -442,6 +453,113 @@ class Scheduler:
                     unique=summary["unique_state_count"],
                     depth=summary["max_depth"], source=f"serve:{job.id}",
                 )
+        return summary
+
+    # -- verification-store jobs (incr/, docs/INCREMENTAL.md) -----------------
+
+    def _run_stored(self, job: Job) -> dict:
+        """One ``store: true`` job: classify the spec against the
+        persistent verification store and take the cheapest sound path.
+        An identical resubmission is the SCHEDULER SHORT-CIRCUIT — the
+        journaled verdict + counterexample paths come back with zero
+        device dispatches (the content-addressed verdict cache, ROADMAP
+        #3c); property-only edits re-evaluate over the stored row log;
+        declared constant widenings explore only the new region;
+        anything else runs cold with the reason journaled AND surfaced
+        in the job result (``recheck_mode`` / ``recheck_reason``)."""
+        from ..incr.recheck import StoredVerdictChecker, incremental_check
+
+        spec = job.spec
+        if self.store_dir is None:
+            raise ValueError(
+                "job requested the verification store (store: true), "
+                "but this service was started without one (serve "
+                "--store-dir DIR)"
+            )
+        _model, cli, builder, n = self._make_builder(
+            spec, spec.engine, spec.symmetry
+        )
+        # Same kwargs layering as _run_single: workload defaults <
+        # cached tuned knobs < explicit request overrides.  Engine
+        # geometry is excluded from spec matching (incr/spec_hash.py),
+        # so the knob cache's warm start composes freely with the
+        # store: a cold-classified repeat of a once-seen workload still
+        # skips the auto-tune growth pauses.
+        engine_kwargs = dict(cli.tpu_kwargs)
+        cache_key = None
+        cache_hit = False
+        if spec.use_knob_cache and self.knob_cache_dir is not None:
+            cache_key = knob_key(workload_label(
+                spec.workload, n, spec.network, spec.symmetry
+            ))
+            cached = load_knobs(self.knob_cache_dir, cache_key)
+            if cached is not None:
+                engine_kwargs.update(cached)
+                cache_hit = True
+                self.metrics.inc("knob_cache_hits")
+            else:
+                self.metrics.inc("knob_cache_misses")
+        engine_kwargs.update(spec.engine_kwargs)
+
+        def attach(ck):
+            # Live vitals for RUNNING store jobs, same as _run_single's
+            # at-spawn attach (jobs.py reads checker.metrics() for the
+            # /jobs/{id} vitals key).
+            job.checker = ck
+
+        checker, info = incremental_check(
+            builder,
+            self.store_dir,
+            engine_kwargs=engine_kwargs,
+            journal=self.journal,
+            reuse=True,
+            cancel=job.cancel,
+            on_spawn=attach,
+        )
+        if job.cancel.is_set():
+            # Same contract as every other job path: a cancelled run
+            # reports its partial counts as CANCELLED (the store's
+            # completeness gate already refused the partial verdict).
+            raise JobCancelled(partial=checker_summary(checker))
+        counter = {
+            "identical": "verdict_cache_hits",
+            "property_only": "recheck_property_only",
+            "constant_widening": "recheck_constant_widening",
+            "cold": "recheck_cold",
+        }.get(info["mode"])
+        if counter:
+            self.metrics.inc(counter)
+        # Cache-served checkers hold no device state worth exploring;
+        # retaining them would only shadow the retention cap.
+        if not isinstance(checker, StoredVerdictChecker):
+            job.checker = checker
+        summary = checker_summary(checker)
+        # Persist a cold run's FINAL geometry on a knob-cache miss,
+        # exactly like _run_single: the next cold-classified job of
+        # this workload then spawns right-sized AND reproduces the
+        # compiled-program cache keys.
+        if (
+            info["mode"] == "cold"
+            and cache_key is not None
+            and not cache_hit
+            and not spec.engine_kwargs
+            and job.checker is not None
+        ):
+            knobs = self._final_geometry(job.checker)
+            if knobs:
+                store_knobs(
+                    self.knob_cache_dir, cache_key, knobs,
+                    unique=summary["unique_state_count"],
+                    depth=summary["max_depth"],
+                    source=f"serve:{job.id}:store",
+                )
+        summary["engine"] = spec.engine
+        summary["n"] = n
+        summary["knob_cache_hit"] = cache_hit
+        summary["recheck_mode"] = info["mode"]
+        summary["recheck_reason"] = info["reason"]
+        if "seeded_states" in info:
+            summary["recheck_seeded_states"] = info["seeded_states"]
         return summary
 
     @staticmethod

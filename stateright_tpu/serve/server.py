@@ -50,6 +50,7 @@ class CheckService:
         knob_cache_dir: Optional[str] = None,
         workers: int = 1,
         retain_checkers: int = 4,
+        store_dir: Optional[str] = None,
     ):
         self.journal = as_journal(journal)
         self.store = JobStore(journal=self.journal)
@@ -59,7 +60,9 @@ class CheckService:
             knob_cache_dir=knob_cache_dir,
             workers=workers,
             retain_checkers=retain_checkers,
+            store_dir=store_dir,
         )
+        self.store_dir = store_dir
         self.started_at = time.time()
         self.workers = max(1, workers)
         self.http_server = None
@@ -68,11 +71,20 @@ class CheckService:
             self.journal.append(
                 "service_start", workers=self.workers,
                 knob_cache_dir=knob_cache_dir,
+                store_dir=store_dir,
             )
 
     def submit(self, spec) -> "object":
         if isinstance(spec, dict):
             spec = JobSpec.from_dict(spec)
+        if spec.store and self.store_dir is None:
+            # Submit-time, like every other invalid spec (HTTP 400) —
+            # never a job that queues only to fail on a worker.
+            raise ValueError(
+                "store: true requires a service started with a "
+                "verification store (serve --store-dir DIR; "
+                "docs/INCREMENTAL.md)"
+            )
         return self.scheduler.submit(spec)
 
     def cancel(self, job_id: str) -> bool:
@@ -123,6 +135,7 @@ class CheckService:
             "workers": self.workers,
             "jobs": self.store.counts(),
             "workloads": workload_names(),
+            "store_dir": self.store_dir,
         }
 
     def explore(self, job, port: int = 0):
@@ -163,14 +176,16 @@ def serve(
     knob_cache_dir: Optional[str] = None,
     workers: int = 1,
     retain_checkers: int = 4,
+    store_dir: Optional[str] = None,
 ) -> CheckService:
     """Start the checking service on ``address`` ((host, port); port 0
     binds an ephemeral one).  ``block=False`` serves on a background
     thread and returns the service immediately (``service.address``
-    carries the bound port)."""
+    carries the bound port).  ``store_dir`` enables the persistent
+    verification store for ``store: true`` jobs (docs/INCREMENTAL.md)."""
     service = CheckService(
         journal=journal, knob_cache_dir=knob_cache_dir, workers=workers,
-        retain_checkers=retain_checkers,
+        retain_checkers=retain_checkers, store_dir=store_dir,
     )
 
     class Handler(BaseHTTPRequestHandler):

@@ -26,9 +26,12 @@ docs/TIERED.md) so a killed run resumes with its tiers intact.
 from __future__ import annotations
 
 import os
+import re
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+_RUN_FILE = re.compile(r"^cold_run_(\d+)\.npy$")
 
 
 class ColdStore:
@@ -41,7 +44,24 @@ class ColdStore:
         self._paths: List[Optional[str]] = []  # disk backing, when spilled
         self.spill_dir = spill_dir
         self.max_runs = max_runs
-        self._seq = 0  # monotonic file-name counter (never reused)
+        # Monotonic file-name counter (never reused).  Seeded PAST any
+        # run files already in ``spill_dir``: a fresh store (or a
+        # ``from_arrays`` resume) pointed at a directory a previous
+        # process spilled into must never overwrite a prior run's
+        # ``.npy`` — a half-overwritten file is exactly the torn-run
+        # state the disk tier promises not to have.
+        self._seq = self._scan_seq(spill_dir)
+
+    @staticmethod
+    def _scan_seq(spill_dir: Optional[str]) -> int:
+        if spill_dir is None or not os.path.isdir(spill_dir):
+            return 0
+        seqs = [
+            int(m.group(1))
+            for m in (_RUN_FILE.match(f) for f in os.listdir(spill_dir))
+            if m
+        ]
+        return max(seqs, default=0)
 
     # -- read surface ---------------------------------------------------------
 
@@ -108,7 +128,16 @@ class ColdStore:
             os.makedirs(self.spill_dir, exist_ok=True)
             self._seq += 1
             path = os.path.join(self.spill_dir, f"cold_run_{self._seq}.npy")
-            np.save(path, fps)
+            # Torn-run proofing: write + fsync a temp file, then rename
+            # it into place.  A process killed mid-spill leaves either
+            # the complete old state or a stray ``.tmp`` (ignored by the
+            # name scan), never a half-written run a resume would mmap.
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as fh:
+                np.save(fh, fps)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
             # Reopen memory-mapped: the RAM copy is released and probe
             # windows fault in only the pages they touch.
             self._runs.append(np.load(path, mmap_mode="r"))
@@ -116,6 +145,40 @@ class ColdStore:
         else:
             self._runs.append(fps)
             self._paths.append(None)
+
+    def close(self) -> None:
+        """Release every memory map (the run FILES stay on disk).  A
+        long-lived process holding many finished stores — the
+        incremental verification store keeps one per entry
+        (incr/store.py) — would otherwise pin a descriptor and address
+        mapping per run forever.  The store is empty afterwards; reopen
+        the directory with :meth:`open` to read it again."""
+        self._runs = []
+        self._paths = []
+
+    @classmethod
+    def open(
+        cls, spill_dir: str, max_runs: int = 8
+    ) -> "ColdStore":
+        """Open a directory of previously spilled runs (memory-mapped,
+        in spill order) WITHOUT rewriting them — the read-only reopen
+        path for persisted stores (incr/store.py's fingerprint sets;
+        post-mortem inspection of a tiered run's disk tier)."""
+        store = cls(spill_dir=spill_dir, max_runs=max_runs)
+        if not os.path.isdir(spill_dir):
+            return store
+        named = sorted(
+            (int(m.group(1)), f)
+            for m, f in (
+                (_RUN_FILE.match(f), f) for f in os.listdir(spill_dir)
+            )
+            if m
+        )
+        for _seq, fname in named:
+            path = os.path.join(spill_dir, fname)
+            store._runs.append(np.load(path, mmap_mode="r"))
+            store._paths.append(path)
+        return store
 
     def _drop_files(self) -> None:
         # Unlinking while a memory map still references the file is fine
@@ -147,11 +210,30 @@ class ColdStore:
     def from_arrays(
         cls, fps: np.ndarray, lens: np.ndarray,
         spill_dir: Optional[str] = None, max_runs: int = 8,
+        clean_stale: bool = True,
     ) -> "ColdStore":
+        """Rebuild a store from its snapshot arrays.  With ``spill_dir``
+        set, the restored runs are re-spilled under fresh sequence
+        numbers (the counter scans past existing files, so a prior
+        process's runs are never clobbered) and — with ``clean_stale``
+        (default) — run files the restore did NOT claim are unlinked:
+        the snapshot is authoritative, and leaving the dead process's
+        duplicates behind would leak one directory's worth of disk per
+        crash-resume cycle."""
         store = cls(spill_dir=spill_dir, max_runs=max_runs)
         off = 0
         for n in np.asarray(lens, np.int64):
             n = int(n)
             store._append(np.asarray(fps[off:off + n], np.uint64))
             off += n
+        if clean_stale and spill_dir is not None and os.path.isdir(spill_dir):
+            claimed = {
+                os.path.basename(p) for p in store._paths if p is not None
+            }
+            for fname in os.listdir(spill_dir):
+                if _RUN_FILE.match(fname) and fname not in claimed:
+                    try:
+                        os.remove(os.path.join(spill_dir, fname))
+                    except OSError:
+                        pass
         return store

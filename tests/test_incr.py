@@ -1,0 +1,723 @@
+"""Incremental re-checking: the persistent verification store (incr/).
+
+Covers the acceptance gates of docs/INCREMENTAL.md:
+
+- verdict cache: identical spec -> journaled verdict + counterexample
+  path, zero device dispatches, zero waves;
+- property-only re-check: zero exploration waves, verdict identical to
+  a from-scratch run of the edited model;
+- constant widening: seeded run's discovered_fingerprints() bit-equal
+  to the unconstrained cold run;
+- the DEGRADATION MATRIX: codec change, constant narrowing, property
+  change with EVENTUALLY, symmetry toggle, bounds change, missing
+  exhaustiveness witness — each lands in its documented mode with the
+  reason journaled; engine-geometry-only changes still hit the cache;
+- spec-hash determinism across processes (fresh PYTHONHASHSEED);
+- ColdStore disk-tier lifecycle (no clobber / no orphan / open /
+  close / torn-run-proof append);
+- the serve surface (JobSpec.store, scheduler short-circuit, metrics).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from stateright_tpu.incr import (
+    SpecFingerprint, VerificationStore, incremental_check,
+)
+from stateright_tpu.incr.store import (
+    COLD, CONSTANT_WIDENING, IDENTICAL, PROPERTY_ONLY,
+)
+from stateright_tpu.models.fixtures import (
+    GridWalk, TrapCounter, TwoPhaseEdited,
+)
+from stateright_tpu.models.twophase import TwoPhaseSys
+from stateright_tpu.runtime.journal import read_journal
+from stateright_tpu.tiered.cold_store import ColdStore
+
+GRID_KW = dict(capacity=1 << 12, max_frontier=1 << 6)
+TP_KW = dict(capacity=1 << 13, max_frontier=1 << 7)
+
+
+@pytest.fixture()
+def store_dir(tmp_path):
+    return str(tmp_path / "store")
+
+
+def _journal(store_dir):
+    return os.path.join(store_dir, "journal.jsonl")
+
+
+def _waves(store_dir) -> int:
+    path = _journal(store_dir)
+    if not os.path.exists(path):
+        return 0
+    return sum(1 for e in read_journal(path) if e.get("event") == "wave")
+
+
+def _check(model, store_dir, reuse=True, store_result=True, builder=None,
+           **kw):
+    return incremental_check(
+        builder if builder is not None else model.checker(),
+        store_dir,
+        engine_kwargs=kw or dict(GRID_KW),
+        journal=_journal(store_dir),
+        reuse=reuse,
+        store_result=store_result,
+    )
+
+
+# --- spec hashing -------------------------------------------------------------
+
+
+def test_spec_components_distinguish_deltas():
+    base = SpecFingerprint(GridWalk(bound=4))
+    widened = SpecFingerprint(GridWalk(bound=6))
+    assert base.spec_key != widened.spec_key
+    assert base.family_key == widened.family_key
+    assert base.components["codec"] == widened.components["codec"]
+    assert base.components["properties"] == widened.components["properties"]
+    assert base.components["constants"] != widened.components["constants"]
+
+    edited = SpecFingerprint(TwoPhaseEdited.build(3))
+    stock = SpecFingerprint(TwoPhaseSys(rm_count=3))
+    assert edited.components["codec"] == stock.components["codec"]
+    assert edited.components["constants"] == stock.components["constants"]
+    assert edited.components["properties"] != stock.components["properties"]
+
+    # Engine geometry never enters the spec key (results are pinned
+    # geometry-invariant by the engine test suites).
+    small = SpecFingerprint(
+        GridWalk(bound=4), engine_kwargs={"capacity": 1 << 10}
+    )
+    big = SpecFingerprint(
+        GridWalk(bound=4), engine_kwargs={"capacity": 1 << 20}
+    )
+    assert small.spec_key == big.spec_key
+    assert small.components["engine"] != big.components["engine"]
+
+    sym = SpecFingerprint(TwoPhaseSys(rm_count=3), symmetry=True)
+    assert sym.spec_key != stock.spec_key
+    assert sym.components["symmetry"] != stock.components["symmetry"]
+
+
+def test_spec_hash_stable_across_processes():
+    """The persistence contract: component digests, spec key, and the
+    snapshot key must survive a fresh interpreter with a DIFFERENT
+    PYTHONHASHSEED (no ``hash()``/dict-order dependence anywhere in the
+    recipe), and so must the knob-cache key format."""
+    script = (
+        "import json\n"
+        "from stateright_tpu.incr import SpecFingerprint\n"
+        "from stateright_tpu.models.fixtures import GridWalk\n"
+        "from stateright_tpu.runtime.knob_cache import knob_key\n"
+        "s = SpecFingerprint(GridWalk(bound=5))\n"
+        "print(json.dumps({'components': s.components,"
+        " 'spec_key': s.spec_key, 'family_key': s.family_key,"
+        " 'snapshot_key': s.snapshot_key,"
+        " 'knob_key': knob_key('incr-test')}))\n"
+    )
+
+    def run(seed):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = seed
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, env=env, timeout=180,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    a, b = run("1"), run("31337")
+    assert a == b
+    here = SpecFingerprint(GridWalk(bound=5))
+    assert a["spec_key"] == here.spec_key
+    assert a["components"] == here.components
+    assert a["snapshot_key"] == here.snapshot_key
+
+
+# --- the four modes -----------------------------------------------------------
+
+
+def test_verdict_cache_round_trip(store_dir):
+    ck, info = _check(GridWalk(bound=4), store_dir)
+    assert info["mode"] == COLD
+    assert ck.unique_state_count() == 25
+    waves_cold = _waves(store_dir)
+    assert waves_cold > 0
+
+    ck2, info2 = _check(GridWalk(bound=4), store_dir)
+    assert info2["mode"] == IDENTICAL
+    assert _waves(store_dir) == waves_cold  # zero new waves
+    assert ck2.unique_state_count() == ck.unique_state_count()
+    assert ck2.state_count() == ck.state_count()
+    assert ck2.max_depth() == ck.max_depth()
+    assert sorted(ck2.discoveries()) == sorted(ck.discoveries())
+    # The cached path re-executes to the same discovery.
+    assert (
+        ck2.discoveries()["reaches corner"]
+        == ck.discoveries()["reaches corner"]
+    )
+    assert np.array_equal(
+        ck2.discovered_fingerprints(), ck.discovered_fingerprints()
+    )
+    events = read_journal(_journal(store_dir))
+    assert any(e["event"] == "incr_verdict_hit" for e in events)
+
+
+def test_verdict_cache_serves_violations(store_dir):
+    """A stored VIOLATING verdict replays with the counterexample path
+    and the counterexample classification intact."""
+    ck, info = _check(TrapCounter(limit=5), store_dir,
+                      capacity=1 << 10, max_frontier=1 << 5)
+    assert info["mode"] == COLD
+    assert "reaches limit" in ck.discoveries()
+
+    ck2, info2 = _check(TrapCounter(limit=5), store_dir,
+                        capacity=1 << 10, max_frontier=1 << 5)
+    assert info2["mode"] == IDENTICAL
+    assert ck2.discovery_classification("reaches limit") == "counterexample"
+    assert (
+        ck2.discoveries()["reaches limit"]
+        == ck.discoveries()["reaches limit"]
+    )
+
+
+def test_property_only_recheck_zero_waves_verdict_equal(store_dir):
+    _, info = _check(TwoPhaseSys(rm_count=3), store_dir, **TP_KW)
+    assert info["mode"] == COLD
+    waves_cold = _waves(store_dir)
+
+    ref = TwoPhaseEdited.build(3).checker().spawn_tpu(**TP_KW).join()
+    ck, info2 = _check(TwoPhaseEdited.build(3), store_dir, **TP_KW)
+    assert info2["mode"] == PROPERTY_ONLY
+    assert _waves(store_dir) == waves_cold, "re-eval dispatched waves"
+    # Verdict equality vs the from-scratch run of the edited model:
+    # same discoveries, same paths, same counts.
+    assert sorted(ck.discoveries()) == sorted(ref.discoveries())
+    for name, path in ref.discoveries().items():
+        assert ck.discoveries()[name] == path, name
+    assert ck.unique_state_count() == ref.unique_state_count()
+    assert ck.state_count() == ref.state_count()
+    assert ck.max_depth() == ref.max_depth()
+    events = read_journal(_journal(store_dir))
+    assert any(
+        e["event"] == "incr_property_recheck" for e in events
+    )
+
+    # The edited spec's verdict was itself stored: an identical
+    # resubmission of the EDITED model is now an O(1) verdict hit.
+    ck3, info3 = _check(TwoPhaseEdited.build(3), store_dir, **TP_KW)
+    assert info3["mode"] == IDENTICAL
+    assert sorted(ck3.discoveries()) == sorted(ref.discoveries())
+
+
+def test_constant_widening_fingerprint_bit_equal(store_dir):
+    _, info = _check(GridWalk(bound=4), store_dir)
+    assert info["mode"] == COLD
+
+    ck, info2 = _check(GridWalk(bound=7), store_dir)
+    assert info2["mode"] == CONSTANT_WIDENING
+    assert info2["seeded_states"] == 25
+    assert ck.unique_state_count() == 64
+    cold = GridWalk(bound=7).checker().spawn_tpu(**GRID_KW).join()
+    assert np.array_equal(
+        ck.discovered_fingerprints(), cold.discovered_fingerprints()
+    )
+    events = read_journal(_journal(store_dir))
+    seeded = [e for e in events if e["event"] == "incr_seeded"]
+    assert seeded and seeded[-1]["seeded_states"] == 25
+    # The engine journaled a seeded-frontier resume, not a fresh seed.
+    assert any(e["event"] == "resume" for e in events)
+
+    # The widened run re-stored: widening again chains off the NEW set.
+    ck2, info3 = _check(GridWalk(bound=8), store_dir)
+    assert info3["mode"] == CONSTANT_WIDENING
+    assert info3["seeded_states"] == 64
+    cold2 = GridWalk(bound=8).checker().spawn_tpu(**GRID_KW).join()
+    assert np.array_equal(
+        ck2.discovered_fingerprints(), cold2.discovered_fingerprints()
+    )
+
+
+# --- the degradation matrix ---------------------------------------------------
+
+
+def _classified(store_dir):
+    """The journaled (mode, reason) trail."""
+    return [
+        (e.get("mode"), e.get("reason", ""))
+        for e in read_journal(_journal(store_dir))
+        if e.get("event") == "incr_classified"
+    ]
+
+
+def test_degradation_constant_narrowing(store_dir):
+    _check(GridWalk(bound=6), store_dir)
+    _, info = _check(GridWalk(bound=3), store_dir)
+    assert info["mode"] == COLD
+    assert "widening" in info["reason"]
+    assert _classified(store_dir)[-1][0] == COLD
+
+
+def test_degradation_codec_change(store_dir):
+    _check(GridWalk(bound=4), store_dir)
+    # A different model entirely: no shared codec — loud cold.
+    _, info = _check(TwoPhaseSys(rm_count=3), store_dir, **TP_KW)
+    assert info["mode"] == COLD
+    assert "empty store" in info["reason"] or "component" in info["reason"]
+
+
+def test_degradation_codec_change_same_family(store_dir):
+    """rm_count changes the PACKED LAYOUT (action arity), so 2pc(3) vs
+    2pc(4) is a codec change, never a widening."""
+    _check(TwoPhaseSys(rm_count=3), store_dir, **TP_KW)
+    _, info = _check(TwoPhaseSys(rm_count=4), store_dir, **TP_KW)
+    assert info["mode"] == COLD
+    assert "codec" in info["reason"]
+
+
+def test_degradation_symmetry_toggle(store_dir):
+    _check(TwoPhaseSys(rm_count=3), store_dir, **TP_KW)
+    builder = TwoPhaseSys(rm_count=3).checker().symmetry()
+    _, info = _check(
+        TwoPhaseSys(rm_count=3), store_dir, builder=builder, **TP_KW
+    )
+    assert info["mode"] == COLD
+    assert "symmetry" in info["reason"]
+
+
+def test_degradation_bounds_change(store_dir):
+    _check(GridWalk(bound=4), store_dir)
+    builder = GridWalk(bound=4).checker().target_max_depth(3)
+    _, info = _check(GridWalk(bound=4), store_dir, builder=builder,
+                     **GRID_KW)
+    assert info["mode"] == COLD
+    assert "bounds" in info["reason"]
+
+
+def test_degradation_eventually_properties_refused(store_dir):
+    """TrapCounter's delta would classify property-only (constants
+    equal), but the new set contains EVENTUALLY properties — refused
+    with the documented reason, degraded to cold."""
+    kw = dict(capacity=1 << 10, max_frontier=1 << 5)
+    _check(TrapCounter(limit=5), store_dir, **kw)
+
+    # The "edit": drop the sometimes property (host and device sides in
+    # step), leaving the two EVENTUALLY properties.
+    from stateright_tpu.models.fixtures import TrapCounterCompiled
+
+    class TrapEditedCompiled(TrapCounterCompiled):
+        def property_conds(self, state):
+            return TrapCounterCompiled.property_conds(self, state)[:2]
+
+    class TrapEdited(TrapCounter):
+        def properties(self):
+            return TrapCounter.properties(self)[:2]
+
+        def compiled(self):
+            return TrapEditedCompiled(self)
+
+    _, info = _check(TrapEdited(limit=5), store_dir, **kw)
+    assert info["mode"] == COLD
+    assert "EVENTUALLY" in info["reason"]
+
+
+def test_degradation_no_exhaustiveness_witness(store_dir):
+    """A model whose EVERY property gets discovered stores a
+    verdict-cache-only entry: the awaiting gate may have pruned, so a
+    property edit must NOT reuse its row log."""
+    from dataclasses import dataclass
+
+    from stateright_tpu.models.fixtures import GridWalkCompiled
+
+    @dataclass(frozen=True)
+    class CornerOnly(GridWalk):
+        def properties(self):
+            return [GridWalk.properties(self)[1]]  # sometimes only
+
+        def compiled(self):
+            return CornerOnlyCompiled(self)
+
+    class CornerOnlyCompiled(GridWalkCompiled):
+        def property_conds(self, state):
+            return GridWalkCompiled.property_conds(self, state)[1:]
+
+    ck, info = _check(CornerOnly(bound=4), store_dir)
+    assert info["mode"] == COLD
+    store = VerificationStore(store_dir)
+    entry = store.lookup(SpecFingerprint(CornerOnly(bound=4)))
+    assert entry is not None
+    assert not entry.rows_reusable
+    assert "every property discovered" in entry.record["rows_reason"]
+
+    # The verdict cache still serves it...
+    _, info2 = _check(CornerOnly(bound=4), store_dir)
+    assert info2["mode"] == IDENTICAL
+
+    # ...but a widening re-check refuses the rows, loudly.
+    _, info3 = _check(CornerOnly(bound=6), store_dir)
+    assert info3["mode"] == COLD
+    assert "not reusable" in info3["reason"]
+
+
+def test_engine_geometry_change_still_hits(store_dir):
+    """Engine knobs are evidence, not identity: the pinned
+    geometry-invariance of the engines means a capacity change alone
+    still returns the cached verdict."""
+    _check(GridWalk(bound=4), store_dir)
+    _, info = _check(
+        GridWalk(bound=4), store_dir,
+        capacity=1 << 14, max_frontier=1 << 8,
+    )
+    assert info["mode"] == IDENTICAL
+
+
+def test_unstable_constants_degrade_loudly(store_dir):
+    """A model with neither dataclass fields nor a spec_constants()
+    override must never take a reuse path."""
+
+    class Opaque(TrapCounter):
+        def compiled(self):
+            from stateright_tpu.models.fixtures import TrapCounterCompiled
+
+            cm = TrapCounterCompiled(self)
+            cm.spec_constants = lambda: None
+            return cm
+
+    kw = dict(capacity=1 << 10, max_frontier=1 << 5)
+    _check(Opaque(limit=5), store_dir, **kw)
+    _, info = _check(Opaque(limit=6), store_dir, **kw)
+    assert info["mode"] == COLD
+    assert "spec_constants" in info["reason"]
+
+
+def test_partial_run_never_enters_verdict_cache(store_dir):
+    """A truncated run (target_state_count here; the same gate covers
+    wall timeouts and cooperative stops) must NOT store a verdict: its
+    "nothing found" claims cover only the explored prefix, and the
+    truncating knob is deliberately outside the spec hash."""
+    builder = GridWalk(bound=6).checker().target_state_count(10)
+    ck, info = _check(GridWalk(bound=6), store_dir, builder=builder,
+                      **GRID_KW)
+    assert info["mode"] == COLD
+    assert ck.unique_state_count() < 49  # genuinely truncated
+    store = VerificationStore(store_dir)
+    assert store.entries() == []
+    events = read_journal(_journal(store_dir))
+    skips = [e for e in events if e["event"] == "incr_store_skipped"]
+    assert skips and "partial" in skips[-1]["reason"]
+
+
+def test_code_digest_sees_defaults_closures_and_sets():
+    """The one-line edits code_digest must catch beyond co_code: a
+    changed default argument and a changed captured value; and set
+    literals must digest PYTHONHASHSEED-independently (sorted fold,
+    not hash-ordered repr)."""
+    from stateright_tpu.incr.spec_hash import code_digest
+
+    def mk_default(k=5):
+        def cond(_m, s, bound=k):
+            return s <= bound
+
+        return cond
+
+    assert code_digest(mk_default(5)) == code_digest(mk_default(5))
+    assert code_digest(mk_default(5)) != code_digest(mk_default(4))
+
+    def mk_closure(k):
+        return lambda _m, s: s <= k
+
+    assert code_digest(mk_closure(5)) == code_digest(mk_closure(5))
+    assert code_digest(mk_closure(5)) != code_digest(mk_closure(4))
+
+    def set_cond(_m, s):
+        return s in {"a", "b", "c"}
+
+    script = (
+        "from stateright_tpu.incr.spec_hash import code_digest\n"
+        "def set_cond(_m, s):\n"
+        "    return s in {'a', 'b', 'c'}\n"
+        "print(code_digest(set_cond))\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = "424242"
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    assert out.stdout.strip() == code_digest(set_cond)
+
+
+def test_code_digest_folds_module_level_helpers():
+    """Editing a shared module-level helper a condition CALLS is a
+    one-line model edit too: the digest folds referenced globals'
+    code, so the edit is visible even though the caller's own bytecode
+    is unchanged."""
+    from stateright_tpu.incr.spec_hash import code_digest
+
+    def make(delta):
+        mod = {}
+        exec(
+            "def helper(s):\n"
+            f"    return s + {delta}\n"
+            "def cond(_m, s):\n"
+            "    return helper(s) > 3\n",
+            mod,
+        )
+        return mod["cond"]
+
+    assert code_digest(make(1)) == code_digest(make(1))
+    assert code_digest(make(1)) != code_digest(make(2))
+
+
+def _grid_variant(name, props_fn, conds_slice):
+    """A GridWalk property variant: same codec+constants, edited
+    property set (device side sliced to match)."""
+    from dataclasses import dataclass
+
+    from stateright_tpu.models.fixtures import GridWalkCompiled
+
+    class _Compiled(GridWalkCompiled):
+        def property_conds(self, state):
+            return GridWalkCompiled.property_conds(self, state)[conds_slice]
+
+    @dataclass(frozen=True)
+    class _Variant(GridWalk):
+        def properties(self):
+            return props_fn(self)
+
+        def compiled(self):
+            return _Compiled(self)
+
+    _Variant.__qualname__ = name
+    return _Variant
+
+
+def test_classify_tries_older_relatives_past_ineligible_newest(store_dir):
+    """A NEWER sibling whose rows are ineligible (every property
+    discovered — no exhaustiveness witness) must not shadow an older
+    reusable entry: classification walks relatives newest-first until
+    one passes the gate."""
+    CornerOnly = _grid_variant(
+        "CornerOnly", lambda m: [GridWalk.properties(m)[1]], slice(1, 2)
+    )
+    BoundsOnly = _grid_variant(
+        "BoundsOnly", lambda m: [GridWalk.properties(m)[0]], slice(0, 1)
+    )
+    # Older reusable entry (A), then a newer non-reusable sibling (B).
+    _check(GridWalk(bound=4), store_dir, reuse=False)
+    _check(CornerOnly(bound=4), store_dir, reuse=False)
+    store = VerificationStore(store_dir)
+    by_reusable = {
+        e.rows_reusable: e for e in store.entries()
+    }
+    assert set(by_reusable) == {True, False}
+
+    ck, info = _check(BoundsOnly(bound=4), store_dir)
+    assert info["mode"] == PROPERTY_ONLY, info
+    assert info["entry"] == by_reusable[True].entry_id
+    assert ck.discoveries() == {}  # the always property holds
+
+
+def test_reuse_disabled_records_only(store_dir):
+    _check(GridWalk(bound=4), store_dir, reuse=False)
+    _, info = _check(GridWalk(bound=4), store_dir, reuse=False)
+    assert info["mode"] == COLD
+    assert "reuse disabled" in info["reason"]
+    # The entries are there: turning reuse on hits immediately.
+    _, info2 = _check(GridWalk(bound=4), store_dir)
+    assert info2["mode"] == IDENTICAL
+
+
+# --- ColdStore lifecycle (satellite: disk-tier reuse) -------------------------
+
+
+def test_cold_store_no_clobber_on_existing_dir(tmp_path):
+    d = str(tmp_path / "cold")
+    a = ColdStore(spill_dir=d)
+    a.add_run(np.array([1, 2, 3], np.uint64))
+    first = sorted(os.listdir(d))
+    # A SECOND store on the same directory continues the sequence
+    # instead of overwriting cold_run_1.npy.
+    b = ColdStore(spill_dir=d)
+    b.add_run(np.array([7, 8], np.uint64))
+    assert sorted(os.listdir(d)) > first
+    assert first[0] in os.listdir(d)
+    np.testing.assert_array_equal(
+        np.load(os.path.join(d, first[0])), [1, 2, 3]
+    )
+
+
+def test_cold_store_from_arrays_cleans_stale(tmp_path):
+    d = str(tmp_path / "cold")
+    a = ColdStore(spill_dir=d)
+    a.add_run(np.array([1, 2, 3], np.uint64))
+    a.add_run(np.array([9], np.uint64))
+    fps, lens = a.to_arrays()
+    a.close()
+    b = ColdStore.from_arrays(fps, lens, spill_dir=d)
+    # The restored runs hold the same data under fresh names; the dead
+    # process's files are gone (no orphan accumulation across resumes).
+    assert b.run_count == 2
+    assert b.entries == 4
+    on_disk = [f for f in os.listdir(d) if f.endswith(".npy")]
+    assert len(on_disk) == 2
+    hit = b.contains(np.array([1, 9, 5], np.uint64))
+    np.testing.assert_array_equal(hit, [True, True, False])
+
+
+def test_cold_store_open_and_close(tmp_path):
+    d = str(tmp_path / "cold")
+    a = ColdStore(spill_dir=d)
+    a.add_run(np.array([4, 5], np.uint64))
+    a.add_run(np.array([1], np.uint64))
+    a.close()
+    assert a.run_count == 0  # maps released
+    b = ColdStore.open(d)
+    assert b.run_count == 2
+    assert b.entries == 3
+    hit = b.contains(np.array([5, 2], np.uint64))
+    np.testing.assert_array_equal(hit, [True, False])
+    # No stray temp files from the fsync'd append path.
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+# --- serve surface ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_store_jobs(tmp_path):
+    from stateright_tpu.serve.server import CheckService
+
+    store_dir = str(tmp_path / "store")
+    svc = CheckService(
+        journal=str(tmp_path / "journal.jsonl"), store_dir=store_dir,
+        knob_cache_dir=str(tmp_path / "knobs"),
+    )
+    try:
+        j1 = svc.submit({"workload": "twophase", "n": 3, "store": True})
+        assert j1.wait(300) and j1.state == "done", (j1.state, j1.error)
+        assert j1.result["recheck_mode"] == "cold"
+        assert j1.result["unique_state_count"] == 288
+        # The knob cache composes with the store: the cold run's final
+        # geometry was persisted for the next cold-classified repeat.
+        assert j1.result["knob_cache_hit"] is False
+        from stateright_tpu.runtime.knob_cache import knob_key, load_knobs
+        from stateright_tpu.serve.workloads import workload_label
+
+        key = knob_key(workload_label("twophase", 3, None, False))
+        assert load_knobs(str(tmp_path / "knobs"), key)
+
+        j2 = svc.submit({"workload": "twophase", "n": 3, "store": True})
+        assert j2.wait(60) and j2.state == "done", (j2.state, j2.error)
+        assert j2.result["recheck_mode"] == "identical"
+        assert j2.result["unique_state_count"] == 288
+
+        m = svc.metrics()
+        assert m["verdict_cache_hits"] == 1
+        assert m["recheck_cold"] == 1
+
+        with pytest.raises(ValueError):
+            svc.submit({
+                "workload": "twophase", "store": True,
+                "portfolio": {"size": 2},
+            })
+        with pytest.raises(ValueError):
+            svc.submit({
+                "workload": "twophase", "store": True, "engine": "bfs",
+            })
+    finally:
+        svc.scheduler.shutdown()
+
+
+def test_store_requires_store_dir(tmp_path):
+    """A store job against a service started without --store-dir is
+    rejected at SUBMIT time (HTTP 400 through the server), and the
+    scheduler-level belt fails loudly too instead of silently running
+    un-stored."""
+    from stateright_tpu.serve.jobs import JobSpec, JobStore
+    from stateright_tpu.serve.scheduler import Scheduler
+    from stateright_tpu.serve.server import CheckService
+
+    svc = CheckService()
+    try:
+        with pytest.raises(ValueError, match="store-dir"):
+            svc.submit({"workload": "fixtures", "n": 3, "store": True})
+    finally:
+        svc.scheduler.shutdown()
+
+    sched = Scheduler(JobStore())
+    try:
+        job = sched.submit(JobSpec(workload="fixtures", n=3, store=True))
+        assert job.wait(120)
+        assert job.state == "failed"
+        assert "store" in (job.error or "")
+    finally:
+        sched.shutdown()
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def test_cli_store_flags_validation(capsys):
+    from stateright_tpu.models.twophase import main as tp_main
+
+    assert tp_main(["check-tpu", "3", "--incremental"]) == 2
+    assert "--store-dir" in capsys.readouterr().err
+    assert tp_main(["check", "3", "--store-dir", "/tmp/x"]) == 2
+    assert "check-tpu" in capsys.readouterr().err
+    assert tp_main(
+        ["check-tpu", "3", "--store-dir", "/tmp/x", "--tiered"]
+    ) == 2
+    assert "does not combine" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_cli_incremental_end_to_end(tmp_path, capsys):
+    from stateright_tpu.models.fixtures import main as fx_main
+    from stateright_tpu.runtime.supervisor import VIOLATION_RC
+
+    store = str(tmp_path / "store")
+    # TrapCounter violates: the verdict (and its VIOLATION_RC exit)
+    # must survive the cache round trip.
+    rc1 = fx_main(["check-tpu", "5", "--store-dir", store, "--incremental"])
+    out1 = capsys.readouterr().out
+    assert rc1 == VIOLATION_RC
+    line1 = [ln for ln in out1.splitlines() if ln.startswith("recheck: ")]
+    assert json.loads(line1[-1][len("recheck: "):])["mode"] == "cold"
+
+    rc2 = fx_main(["check-tpu", "5", "--store-dir", store, "--incremental"])
+    out2 = capsys.readouterr().out
+    assert rc2 == VIOLATION_RC
+    line2 = [ln for ln in out2.splitlines() if ln.startswith("recheck: ")]
+    assert (
+        json.loads(line2[-1][len("recheck: "):])["mode"] == "identical"
+    )
+
+
+# --- watch / report rendering -------------------------------------------------
+
+
+def test_watch_and_report_render_incr_events(store_dir):
+    _check(GridWalk(bound=4), store_dir)
+    _check(GridWalk(bound=4), store_dir)
+    from stateright_tpu.obs.report import analyze_journal, render_markdown
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = read_journal(_journal(store_dir))
+    s = summarize_events(events)
+    assert s["recheck"] == IDENTICAL
+    assert s["verdict_hits"] == 1
+    assert "recheck=identical" in render_line(s)
+    report = analyze_journal(_journal(store_dir))
+    incr = report["incremental"]
+    assert incr["modes"] == {"cold": 1, "identical": 1}
+    assert incr["verdict_hits"] == 1
+    assert "Incremental re-checking" in render_markdown(report)
