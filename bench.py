@@ -35,9 +35,12 @@ it; every earlier line is a valid fallback record from an earlier phase):
            `exchange_occupancy`, `denominator_native` (VERDICT r5 weak
            #6/#9, docs/OBSERVABILITY.md) — come from phase_trace,
            phase_sharded_smoke, and phase_denominator_native; the
-           `dedup_share`/`bytes_dedup` regression gauge (the sort-rung
-           ladder, ISSUE 12) from phase_dedup, rung folded through the
-           knob cache.  The reference suite re-emits after EVERY
+           `dedup_share`/`bytes_dedup` regression gauge (sortless
+           claim-plane election vs the ISSUE-12 sort-rung fallback, at
+           both densities) from phase_dedup and the
+           `step_share`/`bytes_step` gauge (the frontier-sized step
+           rung, ISSUE 14) from phase_step, both rungs folded through
+           the knob cache.  The reference suite re-emits after EVERY
            workload child, so a deadline kill mid-suite keeps the
            completed workloads in the artifact.  Discovered tuned_kwargs
            persist in a knob cache (.bench_knobs/, runtime/knob_cache.py)
@@ -812,21 +815,22 @@ def phase_trace(record: dict, tuned: dict) -> None:
 
 
 def phase_dedup(record: dict) -> None:
-    """Dedup-sort rung regression phase (ISSUE 12): `paxos check 2`
-    traced twice at the same engine sizes — once PINNED to the full
-    worst-case sort buffer (sort_lanes past U clamps to the pre-ladder
-    geometry and disarms the density tuner), once at the adaptive rung
-    warm-started from the knob cache — both golden-gated at 16,668 and
-    verdict-equality-gated against each other.  Reported: the traced
-    `wave_breakdown` dedup share and modeled `bytes.dedup` for both
-    legs, the discovered rung (folded back through the knob cache for
-    the next round), and the byte ratio.  The top-level `dedup_share` /
-    `bytes_dedup` keys are what the trajectory report tracks per round.
-
-    The legs run at the headline's buffer geometry scaled to paxos2 (the
-    c=3 traced run is minutes on a tunneled device and phase_trace
-    already pays it once); the rung-vs-full DELTA is what this phase
-    gauges, and the byte model makes it deterministic."""
+    """Dedup-path regression phase (ISSUE 12's rung ladder, re-gauged
+    for ISSUE 14's sortless election): each gauge workload traced twice
+    at the same engine sizes — once on the SORT-rung fallback path
+    (`sortless=False`, rung warm-started from the knob cache: exactly
+    the r08 configuration), once on the sortless claim-plane election
+    (the default) — golden-gated per workload and fingerprint-equality-
+    gated against each other.  TWO densities on purpose: 2pc(4) is the
+    low-density gauge (most candidate lanes fresh, where the rung
+    ladder already won 12.5×) and paxos2 the duplicate-heavy one where
+    the sort itself stayed the bottleneck (r08: `bytes.dedup` only
+    0.59× at the rung).  The claim election carries NO sort term at
+    all, so the `bytes.dedup` drop must show at BOTH ends — that is
+    the density-insensitivity claim this phase gates.  The top-level
+    `dedup_share`/`bytes_dedup` trajectory keys carry the paxos2
+    sortless numbers (comparable round over round against r08's
+    sort-rung 608,862,208)."""
     import numpy as np
 
     if budget_remaining() < 420.0:
@@ -835,75 +839,182 @@ def phase_dedup(record: dict) -> None:
         )
         log(f"dedup: {record['dedup_skipped']}")
         return
-    base = dict(capacity=1 << 16, max_frontier=1 << 11)
-    key = _knob_key("paxos_check_2_dedup_rung")
+    gauges = (
+        # (label, model factory, reference golden, engine sizes)
+        ("twophase_check_4", lambda: _twophase(4), 1_568,
+         dict(capacity=1 << 13, max_frontier=1 << 10)),
+        ("paxos_check_2", lambda: paxos_model(2), SMOKE_UNIQUE,
+         dict(capacity=1 << 16, max_frontier=1 << 11)),
+    )
+    out = {}
+    for name, mk, golden, base in gauges:
+        key = _knob_key(f"{name}_dedup_rung")
+        cached = load_knobs(KNOB_CACHE_DIR, key) or {}
+
+        def spawn(mk=mk, base=base, **extra):
+            return mk().checker().spawn_tpu(trace=True, **base, **extra)
+
+        def traced_leg(name=name, golden=golden, spawn=spawn, **extra):
+            run_device(lambda: spawn(**extra))  # warm the phase programs
+            ck, dt = run_device_timed(lambda: spawn(**extra))
+            unique = ck.unique_state_count()
+            assert unique == golden, (
+                f"dedup phase golden mismatch ({name}): "
+                f"unique={unique} != {golden}"
+            )
+            return ck, dt
+
+        sort_kw = {"sortless": False}
+        if cached.get("sort_lanes"):
+            sort_kw["sort_lanes"] = cached["sort_lanes"]
+        sort_ck, sort_dt = traced_leg(**sort_kw)
+        sl_ck, sl_dt = traced_leg()  # the sortless default path
+        assert np.array_equal(
+            sort_ck.discovered_fingerprints(),
+            sl_ck.discovered_fingerprints(),
+        ), f"{name}: sortless diverged from the sort-path discovery set"
+        # Persist the sort path's PINNED rung only (sort_lanes_rung;
+        # 0 = never tuned off the full buffer) so the fallback leg
+        # stays warm round over round.
+        discovered = int(sort_ck.metrics().get("sort_lanes_rung", 0) or 0)
+        if discovered:
+            store_knobs(
+                KNOB_CACHE_DIR, key, {"sort_lanes": discovered},
+                golden_unique=golden,
+            )
+        else:
+            discovered = int(sort_ck.metrics()["sort_lanes"])
+        s_sort = sort_ck.trace_summary()
+        s_sl = sl_ck.trace_summary()
+        share_sort = s_sort["wave_breakdown_frac"].get("dedup", 0.0)
+        share_sl = s_sl["wave_breakdown_frac"].get("dedup", 0.0)
+        bytes_sort = s_sort["bytes"]["dedup"]
+        bytes_sl = s_sl["bytes"]["dedup"]
+        assert bytes_sl <= bytes_sort, (
+            f"{name}: bytes.dedup did not drop under the sortless "
+            f"election: {bytes_sl} vs {bytes_sort}"
+        )
+        out[name] = {
+            "sort_lanes_rung": discovered,
+            "rung_cached": "sort_lanes" in cached,
+            "dedup_share_sort": round(share_sort, 4),
+            "dedup_share_sortless": round(share_sl, 4),
+            "bytes_dedup_sort": int(bytes_sort),
+            "bytes_dedup_sortless": int(bytes_sl),
+            "bytes_dedup_ratio": round(bytes_sl / max(1, bytes_sort), 4),
+            "bottleneck_sort": s_sort["bottleneck_phase"],
+            "bottleneck_sortless": s_sl["bottleneck_phase"],
+            "sec_sort": round(sort_dt, 2),
+            "sec_sortless": round(sl_dt, 2),
+        }
+        log(
+            f"dedup: {name} sort-rung={discovered} share "
+            f"{share_sort:.3f} -> sortless {share_sl:.3f}, bytes.dedup "
+            f"{bytes_sort} -> {bytes_sl} "
+            f"({out[name]['bytes_dedup_ratio']}x), bottleneck "
+            f"{s_sort['bottleneck_phase']} -> {s_sl['bottleneck_phase']}"
+        )
+    record["dedup_phase"] = out
+    # Trajectory keys (obs/report.py picks dedup_share off the round):
+    # the duplicate-heavy gauge's sortless numbers.
+    record["dedup_share"] = out["paxos_check_2"]["dedup_share_sortless"]
+    record["bytes_dedup"] = out["paxos_check_2"]["bytes_dedup_sortless"]
+
+
+def phase_step(record: dict) -> None:
+    """Step-geometry rung regression phase (ISSUE 14): 2pc(4) — the
+    LOW-density gauge, where the candidate-lane scan over the
+    worst-case ``B = max_frontier × max_actions`` was 56% of wave time
+    after r08 moved the bottleneck off dedup — traced twice on the
+    sortless default at a deliberately worst-case-sized chunk (the
+    production stance: buffers sized for the biggest level, live
+    levels a fraction of it): once PINNED past the full chunk
+    (step_lanes past max_frontier clamps to the pre-ladder full-width
+    scan and disarms the frontier tuner), once at the adaptive step
+    rung warm-started from the knob cache — both golden-gated at 1,568
+    and fingerprint-equality-gated against each other.  Reported: the
+    traced `wave_breakdown` step share and modeled `bytes.step` for
+    both legs, the discovered rung (folded back through the knob cache
+    for the next round), and the byte ratio.  The top-level
+    `step_share`/`bytes_step` keys are what the trajectory report
+    tracks per round."""
+    import numpy as np
+
+    golden = 1_568  # 2pc(4), pinned by tests/test_tpu_wavefront.py
+    if budget_remaining() < 420.0:
+        record["step_skipped"] = (
+            f"global time budget too low ({budget_remaining():.0f}s left)"
+        )
+        log(f"step: {record['step_skipped']}")
+        return
+    base = dict(capacity=1 << 13, max_frontier=1 << 12)
+    key = _knob_key("twophase_check_4_step_rung")
     cached = load_knobs(KNOB_CACHE_DIR, key) or {}
 
-    def spawn(sort_lanes):
+    def spawn(step_lanes):
         kw = dict(base)
-        if sort_lanes is not None:
-            kw["sort_lanes"] = sort_lanes
-        return paxos_model(2).checker().spawn_tpu(trace=True, **kw)
+        if step_lanes is not None:
+            kw["step_lanes"] = step_lanes
+        return _twophase(4).checker().spawn_tpu(trace=True, **kw)
 
-    def traced_leg(sort_lanes):
-        run_device(lambda: spawn(sort_lanes))  # warm the phase programs
-        ck, dt = run_device_timed(lambda: spawn(sort_lanes))
+    def traced_leg(step_lanes):
+        run_device(lambda: spawn(step_lanes))  # warm the phase programs
+        ck, dt = run_device_timed(lambda: spawn(step_lanes))
         unique = ck.unique_state_count()
-        assert unique == SMOKE_UNIQUE, (
-            f"dedup phase golden mismatch: unique={unique} != "
-            f"{SMOKE_UNIQUE}"
+        assert unique == golden, (
+            f"step phase golden mismatch: unique={unique} != {golden}"
         )
         return ck, dt
 
-    full_ck, full_dt = traced_leg(1 << 30)  # clamps to the full buffer
-    rung_ck, rung_dt = traced_leg(cached.get("sort_lanes"))
+    full_ck, full_dt = traced_leg(1 << 30)  # clamps to the full chunk
+    rung_ck, rung_dt = traced_leg(cached.get("step_lanes"))
     assert np.array_equal(
         full_ck.discovered_fingerprints(),
         rung_ck.discovered_fingerprints(),
-    ), "sort-rung run diverged from the fixed-geometry discovery set"
-    # Persist the PINNED rung only (sort_lanes_rung; 0 = the run never
-    # tuned off the full buffer — caching the full width would pin the
+    ), "step-rung run diverged from the fixed-geometry discovery set"
+    # Persist the PINNED rung only (step_lanes_rung; 0 = the run never
+    # tuned off the full chunk — caching the full width would pin the
     # next round's adaptive leg and measure nothing).
-    discovered = int(rung_ck.metrics().get("sort_lanes_rung", 0) or 0)
+    discovered = int(rung_ck.metrics().get("step_lanes_rung", 0) or 0)
     if discovered:
         store_knobs(
-            KNOB_CACHE_DIR, key, {"sort_lanes": discovered},
-            golden_unique=SMOKE_UNIQUE,
+            KNOB_CACHE_DIR, key, {"step_lanes": discovered},
+            golden_unique=golden,
         )
     else:
-        discovered = int(rung_ck.metrics()["sort_lanes"])
+        discovered = int(rung_ck.metrics()["step_lanes"])
     s_full = full_ck.trace_summary()
     s_rung = rung_ck.trace_summary()
-    share_full = s_full["wave_breakdown_frac"].get("dedup", 0.0)
-    share_rung = s_rung["wave_breakdown_frac"].get("dedup", 0.0)
-    bytes_full = s_full["bytes"]["dedup"]
-    bytes_rung = s_rung["bytes"]["dedup"]
+    share_full = s_full["wave_breakdown_frac"].get("step", 0.0)
+    share_rung = s_rung["wave_breakdown_frac"].get("step", 0.0)
+    bytes_full = s_full["bytes"]["step"]
+    bytes_rung = s_rung["bytes"]["step"]
     assert bytes_rung <= bytes_full, (
-        f"bytes.dedup did not drop with the rung: {bytes_rung} vs "
+        f"bytes.step did not drop with the rung: {bytes_rung} vs "
         f"{bytes_full}"
     )
-    record["dedup_phase"] = {
-        "workload": "paxos_check_2",
-        "sort_lanes_full": int(full_ck.metrics()["sort_lanes"]),
-        "sort_lanes_rung": discovered,
-        "rung_cached": "sort_lanes" in cached,
-        "dedup_share_full": round(share_full, 4),
-        "dedup_share_rung": round(share_rung, 4),
-        "bytes_dedup_full": int(bytes_full),
-        "bytes_dedup_rung": int(bytes_rung),
-        "bytes_dedup_ratio": round(bytes_rung / max(1, bytes_full), 4),
+    record["step_phase"] = {
+        "workload": "twophase_check_4",
+        "step_lanes_full": int(full_ck.metrics()["step_lanes"]),
+        "step_lanes_rung": discovered,
+        "rung_cached": "step_lanes" in cached,
+        "step_share_full": round(share_full, 4),
+        "step_share_rung": round(share_rung, 4),
+        "bytes_step_full": int(bytes_full),
+        "bytes_step_rung": int(bytes_rung),
+        "bytes_step_ratio": round(bytes_rung / max(1, bytes_full), 4),
         "bottleneck_full": s_full["bottleneck_phase"],
         "bottleneck_rung": s_rung["bottleneck_phase"],
         "sec_full": round(full_dt, 2),
         "sec_rung": round(rung_dt, 2),
     }
-    # Trajectory keys (obs/report.py picks dedup_share off the round).
-    record["dedup_share"] = round(share_rung, 4)
-    record["bytes_dedup"] = int(bytes_rung)
+    # Trajectory keys (obs/report.py picks step_share off the round).
+    record["step_share"] = round(share_rung, 4)
+    record["bytes_step"] = int(bytes_rung)
     log(
-        f"dedup: paxos2 rung={discovered} share {share_full:.3f} -> "
-        f"{share_rung:.3f}, bytes.dedup {bytes_full} -> {bytes_rung} "
-        f"({record['dedup_phase']['bytes_dedup_ratio']}x), bottleneck "
+        f"step: 2pc(4) rung={discovered} share {share_full:.3f} -> "
+        f"{share_rung:.3f}, bytes.step {bytes_full} -> {bytes_rung} "
+        f"({record['step_phase']['bytes_step_ratio']}x), bottleneck "
         f"{s_full['bottleneck_phase']} -> {s_rung['bottleneck_phase']}"
     )
 
@@ -1441,6 +1552,7 @@ OPTIONAL_PHASES = (
     "tiered",
     "trace",
     "dedup",
+    "step",
     "symmetry",
     "ttfv",
     "sharded_smoke",
@@ -1508,6 +1620,7 @@ def main() -> None:
         "tiered": phase_tiered,
         "trace": lambda r: phase_trace(r, tuned),
         "dedup": phase_dedup,
+        "step": phase_step,
         "symmetry": phase_symmetry,
         "ttfv": lambda r: phase_ttfv(r, threads, tuned),
         "sharded_smoke": phase_sharded_smoke,

@@ -42,7 +42,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
         lines.append(f"  check-tpu [{n_meta}]{net}"
                      " [--supervise] [--checkpoint-dir DIR] [--resume]"
                      " [--trace] [--sharded[=SHARDS]] [--bucket-slack PCT]"
-                     " [--sort-lanes N]"
+                     " [--sort-lanes N] [--sortless|--no-sortless]"
+                     " [--step-lanes N]"
                      " [--tiered] [--memory-budget-mb MB]"
                      " [--store-dir DIR] [--incremental]")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
@@ -115,13 +116,16 @@ def _extract_runtime_flags(args):
     """Pull the supervised-run flags out of the positional stream (they
     may appear anywhere after the subcommand).  Returns
     ``(positional_args, supervise, checkpoint_dir, resume, trace,
-    sharded, bucket_slack, sort_lanes, tiered, memory_budget_mb,
-    store_dir, incremental)`` —
+    sharded, bucket_slack, sort_lanes, sortless, step_lanes, tiered,
+    memory_budget_mb, store_dir, incremental)`` —
     ``sharded`` is None (single-chip), 0 (mesh over every visible
     device), or a mesh width; ``bucket_slack`` is the sharded engine's
     exchange-bucket rung in percent; ``sort_lanes`` the dedup-sort
-    geometry rung (any device engine; docs/OBSERVABILITY.md "The
-    dedup-sort rung ladder"); ``tiered``/``memory_budget_mb`` select
+    geometry rung, ``sortless``/``--no-sortless`` the dedup-path
+    selection (claim-plane election vs the sorted fallback), and
+    ``step_lanes`` the frontier-sized chunk rung (any device engine;
+    docs/OBSERVABILITY.md "Sortless dedup and the rung ladders");
+    ``tiered``/``memory_budget_mb`` select
     the out-of-core engine under an HBM budget (docs/TIERED.md; the
     budget flag alone implies ``--tiered``); ``store_dir`` /
     ``incremental`` route the check through the persistent verification
@@ -135,6 +139,8 @@ def _extract_runtime_flags(args):
     sharded = None
     bucket_slack = None
     sort_lanes = None
+    sortless = None
+    step_lanes = None
     tiered = False
     memory_budget_mb = None
     store_dir = None
@@ -234,6 +240,26 @@ def _extract_runtime_flags(args):
                 ) from None
             if sort_lanes < 1:
                 raise ValueError("--sort-lanes must be >= 1")
+        elif a == "--sortless":
+            sortless = True
+        elif a == "--no-sortless":
+            sortless = False
+        elif a == "--step-lanes" or a.startswith("--step-lanes="):
+            if a == "--step-lanes":
+                i += 1
+                if i >= len(args):
+                    raise ValueError("--step-lanes requires a lane count")
+                val = args[i]
+            else:
+                val = a.split("=", 1)[1]
+            try:
+                step_lanes = int(val)
+            except ValueError:
+                raise ValueError(
+                    "--step-lanes requires an integer lane count"
+                ) from None
+            if step_lanes < 1:
+                raise ValueError("--step-lanes must be >= 1")
         elif a == "--checkpoint-dir":
             i += 1
             if i >= len(args):
@@ -253,7 +279,8 @@ def _extract_runtime_flags(args):
         i += 1
     return (
         out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-        sort_lanes, tiered, memory_budget_mb, store_dir, incremental,
+        sort_lanes, sortless, step_lanes, tiered, memory_budget_mb,
+        store_dir, incremental,
     )
 
 
@@ -663,7 +690,8 @@ def example_main(spec: CliSpec, argv=None) -> int:
     try:
         (
             args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
-            sort_lanes, tiered, memory_budget_mb, store_dir, incremental,
+            sort_lanes, sortless, step_lanes, tiered, memory_budget_mb,
+            store_dir, incremental,
         ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
@@ -706,6 +734,14 @@ def example_main(spec: CliSpec, argv=None) -> int:
         print(
             "--sort-lanes requires the check-tpu subcommand (it sizes "
             "the device engines' dedup-sort rung)",
+            file=sys.stderr,
+        )
+        return 2
+    if (sortless is not None or step_lanes is not None) and sub != "check-tpu":
+        print(
+            "--sortless/--no-sortless/--step-lanes require the "
+            "check-tpu subcommand (they select the device engines' "
+            "dedup path and chunk rung)",
             file=sys.stderr,
         )
         return 2
@@ -844,6 +880,13 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 # The dedup-sort geometry rung — a knob every device
                 # engine accepts (single-chip, sharded, tiered).
                 tpu_kwargs["sort_lanes"] = sort_lanes
+            if sortless is not None:
+                # Dedup-path selection: the claim-plane election
+                # (default) vs the sorted fallback rung.
+                tpu_kwargs["sortless"] = sortless
+            if step_lanes is not None:
+                # The frontier-sized chunk rung (the second ladder).
+                tpu_kwargs["step_lanes"] = step_lanes
             if sharded is not None:
                 # Multi-chip run over the first SHARDS visible devices
                 # (0 = all).  The spec's single-chip kwargs translate:

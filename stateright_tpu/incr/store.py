@@ -5,6 +5,11 @@ Layout (one directory per store, safe to rsync/commit as an artifact)::
     <store_dir>/
       journal.jsonl                 # incr_* events (+ engine events when
                                     #   the CLI routes runs through here)
+      index.json                    # entry index: every verdict record
+                                    #   sans summary + freshness stats;
+                                    #   rebuilt on any mismatch, so
+                                    #   classify() never re-parses
+                                    #   per-entry records as stores grow
       entries/<spec_key[:24]>/
         verdict.json                # the verdict record (see below)
         snapshot.npz                # engine snapshot: row log + parents +
@@ -52,6 +57,12 @@ from ..tiered.cold_store import ColdStore
 from .spec_hash import HASH_VERSION, SpecFingerprint
 
 STORE_FORMAT = 1
+# The entry index (ROADMAP #5 remainder): one JSON file beside the
+# entries holding every verdict record MINUS its summary block, plus a
+# per-entry [mtime_ns, size] freshness token.  classify() scales with
+# this file instead of re-parsing every verdict.json as stores grow;
+# any mismatch with the live directory (names or stats) rebuilds it.
+INDEX_FILE = "index.json"
 
 # Classification modes, in preference order (docs/INCREMENTAL.md).
 IDENTICAL = "identical"
@@ -80,11 +91,18 @@ class Delta(NamedTuple):
 
 
 class StoreEntry:
-    """One persisted run: the parsed verdict record + file handles."""
+    """One persisted run: the parsed verdict record + file handles.
 
-    def __init__(self, path: str, record: dict):
+    Entries served from the store's ``index.json`` carry the record
+    WITHOUT its (large) ``summary`` block; ``loader`` lazily fetches
+    the full ``verdict.json`` on first ``summary`` access, so the
+    classification family scan never parses per-entry records while
+    the one chosen donor still reads exactly one file."""
+
+    def __init__(self, path: str, record: dict, loader=None):
         self.path = path  # entry directory
         self.record = record
+        self._loader = loader  # lazy full-record fetch (index-backed)
 
     @property
     def entry_id(self) -> str:
@@ -104,6 +122,11 @@ class StoreEntry:
 
     @property
     def summary(self) -> dict:
+        if "summary" not in self.record and self._loader is not None:
+            full = self._loader(self.path)
+            self._loader = None
+            if full is not None:
+                self.record = full
         return self.record.get("summary", {})
 
     def fingerprints(self) -> np.ndarray:
@@ -167,24 +190,144 @@ class VerificationStore:
         self.entries_dir = os.path.join(self.store_dir, "entries")
         os.makedirs(self.entries_dir, exist_ok=True)
         self.journal = as_journal(journal)
+        # Per-entry verdict.json parses this instance performed — the
+        # observable evidence that classification scales with the
+        # INDEX, not the store (pinned in tests/test_incr.py): on an
+        # index hit, classify() parses zero per-entry records; only
+        # the chosen donor's lazy summary load (and the exact-match
+        # lookup) read one file each.
+        self.verdict_reads = 0
 
     # -- read surface ----------------------------------------------------------
 
+    def _read_verdict(self, entry_dir: str) -> Optional[dict]:
+        """Parse one entry's verdict.json (None on torn/missing —
+        invisible by design); the ONE place per-entry records are read,
+        so ``verdict_reads`` counts every such parse."""
+        try:
+            with open(
+                os.path.join(entry_dir, "verdict.json"),
+                "r", encoding="utf-8",
+            ) as fh:
+                record = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+        self.verdict_reads += 1
+        return record
+
+    def _index_path(self) -> str:
+        return os.path.join(self.store_dir, INDEX_FILE)
+
+    def _verdict_stat(self, entry_dir: str):
+        """Cheap freshness token for one entry's verdict.json: [mtime_ns,
+        size] (None when absent) — an os.stat, never a parse."""
+        try:
+            st = os.stat(os.path.join(entry_dir, "verdict.json"))
+            return [st.st_mtime_ns, st.st_size]
+        except OSError:
+            return None
+
+    def _load_index(self) -> dict:
+        """The entry index ``{entry_id: {"record": slim, "stat": ...}}``
+        (``index.json``; ``record`` is the verdict record WITHOUT its
+        ``summary`` block, None for torn entries), validated against
+        the live directory — name set plus per-entry verdict.json
+        stats, all via listdir/os.stat with zero JSON parses — and
+        REBUILT on any mismatch (missing/stale/foreign-writer index).
+        This is what keeps :meth:`classify`'s family scan O(index)
+        instead of O(store) as stores grow (ROADMAP #5 remainder)."""
+        names = sorted(
+            n for n in os.listdir(self.entries_dir)
+            if os.path.isdir(os.path.join(self.entries_dir, n))
+        )
+        try:
+            with open(self._index_path(), "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            data = None
+        if (
+            isinstance(data, dict)
+            and data.get("format") == STORE_FORMAT
+            and data.get("hash_version") == HASH_VERSION
+            and isinstance(data.get("entries"), dict)
+        ):
+            ent = data["entries"]
+            if sorted(ent) == names and all(
+                isinstance(v, dict)
+                and v.get("stat") == self._verdict_stat(
+                    os.path.join(self.entries_dir, n)
+                )
+                for n, v in ent.items()
+            ):
+                return ent
+        return self._rebuild_index(names)
+
+    def _rebuild_index(self, names: List[str]) -> dict:
+        """Scan every verdict.json once and persist the index (atomic
+        write + rename, like every store artifact).  Torn entries are
+        indexed with ``record: None`` so their presence alone does not
+        force a rebuild on every read."""
+        ent = {}
+        for name in names:
+            path = os.path.join(self.entries_dir, name)
+            record = self._read_verdict(path)
+            slim = (
+                None if record is None
+                else {k: v for k, v in record.items() if k != "summary"}
+            )
+            ent[name] = {"record": slim, "stat": self._verdict_stat(path)}
+        _atomic_write_json(self._index_path(), {
+            "format": STORE_FORMAT,
+            "hash_version": HASH_VERSION,
+            "entries": ent,
+        })
+        return ent
+
+    def _index_update(self, entry_dir: str, record: dict) -> None:
+        """Incrementally fold one just-written entry into the index
+        (called under the write lock).  A concurrent foreign writer may
+        race the whole-file write; the stat validation in
+        :meth:`_load_index` turns any lost update into a rebuild, never
+        a stale read."""
+        try:
+            with open(self._index_path(), "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            data = None
+        if not (
+            isinstance(data, dict)
+            and data.get("format") == STORE_FORMAT
+            and data.get("hash_version") == HASH_VERSION
+            and isinstance(data.get("entries"), dict)
+        ):
+            data = {
+                "format": STORE_FORMAT,
+                "hash_version": HASH_VERSION,
+                "entries": {},
+            }
+        data["entries"][os.path.basename(entry_dir)] = {
+            "record": {
+                k: v for k, v in record.items() if k != "summary"
+            },
+            "stat": self._verdict_stat(entry_dir),
+        }
+        _atomic_write_json(self._index_path(), data)
+
     def entries(self) -> List[StoreEntry]:
         out = []
-        for name in sorted(os.listdir(self.entries_dir)):
-            path = os.path.join(self.entries_dir, name)
-            record_path = os.path.join(path, "verdict.json")
-            try:
-                with open(record_path, "r", encoding="utf-8") as fh:
-                    record = json.load(fh)
-            except (OSError, json.JSONDecodeError):
+        idx = self._load_index()
+        for name in sorted(idx):
+            record = (idx[name] or {}).get("record")
+            if not isinstance(record, dict):
                 continue  # torn/in-progress entry: invisible by design
             if record.get("format") != STORE_FORMAT:
                 continue
             if record.get("hash_version") != HASH_VERSION:
                 continue
-            out.append(StoreEntry(path, record))
+            out.append(StoreEntry(
+                os.path.join(self.entries_dir, name), record,
+                loader=self._read_verdict,
+            ))
         return out
 
     def lookup(self, spec: SpecFingerprint) -> Optional[StoreEntry]:
@@ -194,12 +337,8 @@ class VerificationStore:
         (the family scan in :meth:`classify` still walks the entries;
         indexing that is a named ROADMAP follow-up)."""
         path = os.path.join(self.entries_dir, spec.spec_key[:24])
-        try:
-            with open(
-                os.path.join(path, "verdict.json"), "r", encoding="utf-8"
-            ) as fh:
-                record = json.load(fh)
-        except (OSError, json.JSONDecodeError):
+        record = self._read_verdict(path)
+        if record is None:
             return None
         if (
             record.get("format") != STORE_FORMAT
@@ -561,6 +700,7 @@ class VerificationStore:
             "summary": summary,
         }
         _atomic_write_json(os.path.join(entry_dir, "verdict.json"), record)
+        self._index_update(entry_dir, record)
         entry = StoreEntry(entry_dir, record)
         self._log(
             "incr_stored",

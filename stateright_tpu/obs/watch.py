@@ -5,9 +5,11 @@ rotating ones, whose segments :func:`~stateright_tpu.runtime.journal.
 read_journal_stats` merges — and renders a refreshing ONE-LINE progress
 view: wall clock, depth, unique states, a uniq/s EMA computed over the
 trailing wave events, hot-table load factor, measured valid density,
-the current dedup-sort rung (from the ``geometry`` events and rung-climb
-``grow`` notes), the bottleneck phase, and warning badges (recompile
-storms, sort-rung ladder thrash, torn lines, faults).  It reads the journal file only — never the engine — so it
+the current dedup-sort and step rungs plus the dedup path
+(``dedup=sortless|sort``, from the ``geometry`` events and rung-climb/
+fallback ``grow`` notes), the bottleneck phase, and warning badges
+(recompile storms, rung-ladder thrash, claim-election fallback thrash,
+torn lines, faults).  It reads the journal file only — never the engine — so it
 watches supervised children, serve daemons, and remote runs over any
 shared filesystem alike, mid-run or post-mortem.
 
@@ -151,28 +153,52 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
     if grows:
         out["grows"] = grows
 
-    # Current sort-geometry rung: the latest ``geometry`` event's
-    # sort_lanes (engines re-journal geometry on every tuner downshift),
+    # Current rungs and dedup path: the latest ``geometry`` event's
+    # sort_lanes/step_lanes/sortless (engines re-journal geometry on
+    # every tuner downshift, rung reset, and sortless fallback),
     # advanced by any LATER rung-climb grow events (their ``grown``
-    # notes carry "sort_lanes=N") — so the watched rung tracks both
-    # directions of the ladder.  Flag-4 rung retries inside the
-    # trailing window raise the ladder-thrash badge.
+    # notes carry "sort_lanes=N" / "step_lanes=N" / "sortless=0") — so
+    # the watched rungs track both directions of each ladder.  Flag-4
+    # rung retries inside the trailing window raise the ladder-thrash
+    # badge; repeated sortless→sort fallbacks inside the same window
+    # (a serve journal flip-flopping per job) raise the claim-election
+    # fallback-thrash badge.
     rung = None
+    step_rung = None
+    sortless = None
     rung_retry_times: List[float] = []
+    fallback_times: List[float] = []
     for e in events:
         ev = e.get("event")
-        if ev == "geometry" and e.get("sort_lanes") is not None:
-            rung = e.get("sort_lanes")
+        if ev == "geometry":
+            if e.get("sort_lanes") is not None:
+                rung = e.get("sort_lanes")
+            if e.get("step_lanes") is not None:
+                step_rung = e.get("step_lanes")
+            if e.get("sortless") is not None:
+                sortless = bool(e.get("sortless"))
         elif ev == "grow":
-            m = re.search(r"sort_lanes=(\d+)", str(e.get("grown", "")))
+            grown = str(e.get("grown", ""))
+            m = re.search(r"(?<!_)sort_lanes=(\d+)", grown)
             if m:
                 rung = int(m.group(1))
                 if int(e.get("flags", 0) or 0) & 4 and isinstance(
                     e.get("t"), (int, float)
                 ):
                     rung_retry_times.append(e["t"])
+            m = re.search(r"step_lanes=(\d+)", grown)
+            if m:
+                step_rung = int(m.group(1))
+            if "sortless=0" in grown:
+                sortless = False
+                if isinstance(e.get("t"), (int, float)):
+                    fallback_times.append(e["t"])
     if rung is not None:
         out["sort_rung"] = rung
+    if step_rung is not None:
+        out["step_rung"] = step_rung
+    if sortless is not None:
+        out["dedup"] = "sortless" if sortless else "sort"
     if times and rung_retry_times:
         tail_retries = [
             t for t in rung_retry_times
@@ -182,6 +208,15 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
         if len(tail_retries) >= SORT_THRASH_RETRIES:
             out["rung_thrash"] = True
             out["warnings"].append("rung-thrash")
+    if times and fallback_times:
+        tail_fb = [
+            t for t in fallback_times
+            if t >= max(times) - SORT_THRASH_WINDOW_SEC
+        ]
+        out["sortless_fallbacks"] = len(fallback_times)
+        if len(tail_fb) >= SORT_THRASH_RETRIES:
+            out["fallback_thrash"] = True
+            out["warnings"].append("dedup-fallback-thrash")
     # Incremental re-checking (incr/, docs/INCREMENTAL.md): the latest
     # classification's mode is the one-word answer to "did this
     # re-check reuse anything", plus the cumulative verdict-cache hits.
@@ -232,6 +267,10 @@ def render_line(s: dict) -> str:
         parts.append(f"density={_fmt(s.get('density'))}")
         if "sort_rung" in s:
             parts.append(f"sort_rung={_fmt(s.get('sort_rung'))}")
+        if "step_rung" in s:
+            parts.append(f"step_rung={_fmt(s.get('step_rung'))}")
+        if "dedup" in s:
+            parts.append(f"dedup={s['dedup']}")
         parts.append(f"bottleneck={_fmt(s.get('bottleneck'))}")
         if "waves" in s:
             parts.append(f"waves={s['waves']}")

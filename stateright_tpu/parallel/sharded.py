@@ -133,6 +133,8 @@ class ShardedTpuChecker(Checker):
         trace: bool = False,
         bucket_slack: Optional[int] = None,
         sort_lanes: Optional[int] = None,
+        sortless: Optional[bool] = None,
+        step_lanes: Optional[int] = None,
         waves_per_call: Optional[int] = None,
     ):
         """Same checkpoint/journal hooks as the single-chip engine
@@ -176,7 +178,25 @@ class ShardedTpuChecker(Checker):
         a wave whose valid candidates exceed the rung raises the
         non-committing flag 4 and the host retries one rung up.  The
         discovered rung rides the knob cache and snapshots exactly like
-        ``bucket_slack``."""
+        ``bucket_slack``.
+
+        ``sortless``: the dedup-path selection (wavefront.py documents
+        the contract; default = the claim-plane election unless an
+        explicit ``sort_lanes`` selects the sorted fallback).  On this
+        engine the election replaces the OWNER-SIDE insert's pre-dedup
+        sort; the local pre-exchange ``prededup`` sort survives on
+        meshes wider than one shard — the exchange ships only distinct
+        keys, and electing without a table to claim into would need a
+        scratch table per wave — but is skipped entirely on 1-shard
+        meshes, where the claim insert IS the global dedup.
+
+        ``step_lanes``: the frontier-sized chunk rung (wavefront.py's
+        knob, shared ladder in wave_loop.py) — the per-wave chunk slice,
+        candidate batch, compact buffers, and the exchange buckets
+        derived from them all span the rung instead of the worst-case
+        ``chunk_size`` width.  A shard whose remaining level exceeds
+        the rung raises the non-committing flag 128; the host climbs
+        one rung and re-runs."""
         super().__init__(options.model)
         import jax
 
@@ -277,7 +297,10 @@ class ShardedTpuChecker(Checker):
         # single-chip engine's knob, wavefront.py documents the
         # contract).  None = full worst-case buffer until the density
         # tuner has evidence; an explicit rung is a warm start.
-        from .wave_loop import SORT_RUNG_MIN, clamp_sort_lanes
+        from .wave_loop import (
+            SORT_RUNG_MIN, STEP_RUNG_MIN, clamp_sort_lanes,
+            clamp_step_lanes,
+        )
 
         self._sort_lanes = (
             None if sort_lanes is None else clamp_sort_lanes(sort_lanes)
@@ -289,6 +312,19 @@ class ShardedTpuChecker(Checker):
         self._sort_peak_valid = 0.0
         self._sort_quanta = 0
         self._sort_retries = 0  # flag-4 rung climbs this run
+        # Dedup-path selection + the frontier-sized step rung
+        # (wavefront.py's knobs; one shared ladder in wave_loop.py).
+        self._sortless = (
+            (sort_lanes is None) if sortless is None else bool(sortless)
+        )
+        self._step_lanes = (
+            None if step_lanes is None else clamp_step_lanes(step_lanes)
+        )
+        self._step_tune = step_lanes is None
+        self._step_rung_floor = STEP_RUNG_MIN
+        self._step_peak_frontier = 0.0
+        self._step_quanta = 0
+        self._step_retries = 0  # flag-128 rung climbs this run
         if waves_per_call is None:
             from .wave_common import default_waves_per_call
 
@@ -340,14 +376,24 @@ class ShardedTpuChecker(Checker):
 
     # --- exchange geometry ---------------------------------------------------
 
+    def _step_width(self) -> int:
+        """The EFFECTIVE per-wave chunk width in frontier lanes
+        (wavefront.py's `_step_width`, same contract): the step rung
+        capped at the live ``chunk_size``."""
+        full = self._chunk
+        if self._step_lanes is None:
+            return full
+        return min(self._step_lanes, full)
+
     def _u_sz(self) -> int:
         """Current compaction/dedup buffer width (hashset.py's single
-        definition), from the LIVE chunk/dedup knobs — auto-grow may have
-        relaxed them mid-run."""
+        definition), from the LIVE chunk/dedup knobs — auto-grow and
+        the step rung may have moved them mid-run."""
         from .hashset import unique_buffer_size
 
         return unique_buffer_size(
-            self._chunk * self._compiled.max_actions, self._dedup_factor
+            self._step_width() * self._compiled.max_actions,
+            self._dedup_factor,
         )
 
     def _sort_width(self) -> int:
@@ -412,7 +458,8 @@ class ShardedTpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import (
-            HashSet, compact_valid, insert_batch_compact, prededup,
+            HashSet, compact_valid, insert_batch_claim,
+            insert_batch_compact, prededup,
         )
         from .wave_common import make_finish_when_device, wave_eval
 
@@ -429,7 +476,11 @@ class ShardedTpuChecker(Checker):
             return device_fp64(rows_c[:, :fpw])
 
         a = cm.max_actions
-        f = self._chunk
+        f = self._chunk  # worst-case chunk (queue/seed geometry)
+        # The live step-geometry rung: the per-wave chunk slice; a
+        # shard whose remaining level exceeds it raises the
+        # non-committing flag 128 (compiled out at the top rung).
+        f_eff = self._step_width()
         n = self._n
         cap_s = self._cap_s
         qcap = cap_s
@@ -437,11 +488,19 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
+        # Dedup path (wavefront.py's contract): claim election on the
+        # owner-side insert by default; 1-shard meshes additionally
+        # skip the local prededup sort (the insert IS the global
+        # dedup there).
+        sortless = self._sortless
         # The live sort-geometry rung: the pre-exchange compact/dedup
         # buffers below span this width, so the owner argsort, bucket
-        # scatters, and exchange payload all follow it.
-        sort_lanes = self._sort_width()
-        b = f * a  # per-shard candidate lanes (pre-compaction)
+        # scatters, and exchange payload all follow it.  None = the
+        # worst-case buffer of the live batch (sortless default).
+        sort_lanes = (
+            None if self._sort_lanes is None else self._sort_width()
+        )
+        b = f_eff * a  # per-shard candidate lanes (pre-compaction)
         # Per-destination exchange bucket (wave_loop.exchange_bucket_lanes
         # via _bucket_lanes — the same number accounting() reports).
         bkt = self._bucket_lanes()
@@ -487,9 +546,9 @@ class ShardedTpuChecker(Checker):
             ) = carry
             me = jax.lax.axis_index("shards").astype(u)
 
-            count = jnp.minimum(level_end - level_start, u(f))
-            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
-            lane = jnp.arange(f, dtype=u)
+            count = jnp.minimum(level_end - level_start, u(f_eff))
+            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f_eff,))
+            lane = jnp.arange(f_eff, dtype=u)
             active = lane < count
             safe_slots = jnp.where(active, chunk, 0)
             states = store[safe_slots]
@@ -532,11 +591,19 @@ class ShardedTpuChecker(Checker):
                 )
                 step_flag = step_flag | jnp.any(lane_flags_v & v_act)
                 v_hi, v_lo = fp_of(rows_v)
-                u_hi, u_lo, u_origin0, u_valid, _never = prededup(
-                    v_hi, v_lo, v_act, dedup_factor=1
-                )
-                rows_u = rows_v[u_origin0]
-                orig_lane = v_orig[u_origin0]
+                if sortless and n == 1:
+                    # 1-shard sortless: no exchange to minimize, so the
+                    # claim insert below IS the global dedup — skip the
+                    # local prededup sort entirely.
+                    u_hi, u_lo, u_valid = v_hi, v_lo, v_act
+                    rows_u = rows_v
+                    orig_lane = v_orig
+                else:
+                    u_hi, u_lo, u_origin0, u_valid, _never = prededup(
+                        v_hi, v_lo, v_act, dedup_factor=1
+                    )
+                    rows_u = rows_v[u_origin0]
+                    orig_lane = v_orig[u_origin0]
             else:
                 flat = nexts.reshape(b, w)
                 hi, lo = fp_of(flat)
@@ -550,11 +617,16 @@ class ShardedTpuChecker(Checker):
                     hi, lo, flat_valid, dedup_factor,
                     sort_lanes=sort_lanes,
                 )
-                u_hi, u_lo, u_origin0, u_valid, _never = prededup(
-                    v_hi, v_lo, v_act, dedup_factor=1
-                )
-                orig_lane = v_orig[u_origin0]
-                rows_u = flat[orig_lane]
+                if sortless and n == 1:
+                    u_hi, u_lo, u_valid = v_hi, v_lo, v_act
+                    orig_lane = v_orig
+                    rows_u = flat[orig_lane]
+                else:
+                    u_hi, u_lo, u_origin0, u_valid, _never = prededup(
+                        v_hi, v_lo, v_act, dedup_factor=1
+                    )
+                    orig_lane = v_orig[u_origin0]
+                    rows_u = flat[orig_lane]
             u_sz = u_hi.shape[0]
             gid_u = my_gids[orig_lane // u(a)]
             eb_u = eb[orig_lane // u(a)]
@@ -570,6 +642,14 @@ class ShardedTpuChecker(Checker):
             # bucket_slack) and re-runs the exact same chunk with no
             # work lost and no table rebuild needed.
             g_lovf = any_shard(local_overflow)
+            # Step-rung clamp (flag 128, non-committing; compiled out
+            # at the top rung): any shard's remaining level exceeding
+            # the chunk rung aborts the wave mesh-wide — the host
+            # climbs one rung and re-runs.
+            g_sovf = (
+                any_shard(level_end - level_start > u(f_eff))
+                if f_eff < f else jnp.zeros((), jnp.bool_)
+            )
             if n == 1:
                 # One-shard mesh: every key's owner is self, so the whole
                 # bucket/sort/all_to_all exchange is an identity — elide
@@ -577,7 +657,7 @@ class ShardedTpuChecker(Checker):
                 # (this is most of the former 1-device overhead vs the
                 # single-chip engine).
                 g_bovf = jnp.zeros((), jnp.bool_)
-                commit = ~g_lovf
+                commit = ~(g_lovf | g_sovf)
                 rw, rg, reb = rows_u, gid_u, eb_u
                 rv = u_valid & commit
                 rhi, rlo = u_hi, u_lo
@@ -603,7 +683,7 @@ class ShardedTpuChecker(Checker):
                 # percent of even the slim bucket.  A destination count
                 # past the bucket raises flag 32; nothing commits.
                 g_bovf = any_shard(jnp.any(counts[:n] > u(bkt)))
-                commit = ~(g_lovf | g_bovf)
+                commit = ~(g_lovf | g_bovf | g_sovf)
                 offsets = jnp.concatenate(
                     [jnp.zeros((1,), u), jnp.cumsum(counts)[:-1]]
                 )
@@ -666,17 +746,32 @@ class ShardedTpuChecker(Checker):
             # the sizing rule ever changes, dropped received states must
             # be a loud error, never a silently wrong "verified" result
             # (the traced loop keeps the same invariant guard).
-            (
-                table, r_slot, r_new, r_origin, _r_active, probe_ok,
-                dd_overflow,
-            ) = insert_batch_compact(
-                HashSet(key_hi, key_lo), rhi, rlo, rv, dedup_factor=1
-            )
-            rows_r = rw[r_origin]
+            if sortless:
+                # Claim-plane election (hashset.insert_batch_claim):
+                # the receive batch probes directly, winners are the
+                # lowest receive lane of each key run, and r_origin is
+                # the identity map — the gathers below elide.
+                (
+                    table, r_slot, r_new, r_origin, _r_active, probe_ok,
+                    dd_overflow,
+                ) = insert_batch_claim(
+                    HashSet(key_hi, key_lo), rhi, rlo, rv
+                )
+                rows_r, rg_r, reb_r = rw, rg, reb
+            else:
+                (
+                    table, r_slot, r_new, r_origin, _r_active, probe_ok,
+                    dd_overflow,
+                ) = insert_batch_compact(
+                    HashSet(key_hi, key_lo), rhi, rlo, rv, dedup_factor=1
+                )
+                rows_r = rw[r_origin]
+                rg_r = rg[r_origin]
+                reb_r = reb[r_origin]
             sslot = jnp.where(r_new, r_slot, u(cap_s))
             store = store.at[sslot].set(rows_r, mode="drop")
-            parent = parent.at[sslot].set(rg[r_origin], mode="drop")
-            ebits = ebits.at[sslot].set(reb[r_origin], mode="drop")
+            parent = parent.at[sslot].set(rg_r, mode="drop")
+            ebits = ebits.at[sslot].set(reb_r, mode="drop")
             n_new = jnp.sum(r_new, dtype=u)
             unique_l = unique_l + n_new
             unique_g = unique_g + jax.lax.psum(n_new, "shards")
@@ -710,6 +805,7 @@ class ShardedTpuChecker(Checker):
             # host can retry because the aborted wave committed nothing.
             flags = flags | jnp.where(g_lovf, 4, 0).astype(u)
             flags = flags | jnp.where(g_bovf, 32, 0).astype(u)
+            flags = flags | jnp.where(g_sovf, 128, 0).astype(u)
             flags = flags | jnp.where(
                 any_shard(dd_overflow), 64, 0
             ).astype(u)
@@ -837,7 +933,9 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._sortless,  # the dedup path is a trace-time branch
             self._sort_width(),  # the live sort-geometry rung
+            self._step_width(),  # the live step-geometry rung
             self._bucket_slack,  # shapes the exchange buckets
             self._waves_per_call,  # baked into run() as a constant
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
@@ -868,7 +966,9 @@ class ShardedTpuChecker(Checker):
             "capacity_per_shard": self._cap_s,
             "chunk_size": self._chunk,
             "dedup_factor": self._dedup_factor,
+            "sortless": self._sortless,
             "sort_lanes": self._sort_width(),
+            "step_lanes": self._step_width(),
             "bucket_slack": self._bucket_slack,
             "waves_per_call": self._waves_per_call,
             "symmetry": self._canon is not None,
@@ -1028,7 +1128,9 @@ class ShardedTpuChecker(Checker):
             self._cap_s,
             self._chunk,
             self._dedup_factor,
+            self._sortless,  # the dedup path is a trace-time branch
             self._sort_width(),  # the live sort-geometry rung
+            self._step_width(),  # the live step-geometry rung
             self._bucket_slack,  # shapes the exchange buckets
             tuple((d.platform, d.id) for d in self._mesh.devices.flat),
             tuple(p.expectation for p in self._properties),
@@ -1057,7 +1159,8 @@ class ShardedTpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import (
-            HashSet, compact_valid_indices, insert_batch_compact, prededup,
+            HashSet, compact_valid_indices, insert_batch_claim,
+            insert_batch_compact, prededup,
         )
         from .wave_common import wave_eval
 
@@ -1071,7 +1174,7 @@ class ShardedTpuChecker(Checker):
             return device_fp64(rows_c[:, :fpw])
 
         a = cm.max_actions
-        f = self._chunk
+        f_eff = self._step_width()  # the live step-geometry rung
         n = self._n
         cap_s = self._cap_s
         qcap = cap_s
@@ -1079,8 +1182,11 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         ev_indices = self._ev_indices
         dedup_factor = self._dedup_factor
-        sort_lanes = self._sort_width()  # the live sort-geometry rung
-        b = f * a
+        sortless = self._sortless  # the dedup path (claim vs sort)
+        sort_lanes = (
+            None if self._sort_lanes is None else self._sort_width()
+        )
+        b = f_eff * a
         bkt = self._bucket_lanes()  # per-destination exchange bucket
         u = jnp.uint32
         shard = P("shards")
@@ -1098,9 +1204,9 @@ class ShardedTpuChecker(Checker):
             me = jax.lax.axis_index("shards").astype(u)
             level_start = ctrl[0, 0]
             level_end = ctrl[0, 1]
-            count = jnp.minimum(level_end - level_start, u(f))
-            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f,))
-            lane = jnp.arange(f, dtype=u)
+            count = jnp.minimum(level_end - level_start, u(f_eff))
+            chunk = jax.lax.dynamic_slice(queue, (level_start,), (f_eff,))
+            lane = jnp.arange(f_eff, dtype=u)
             active = lane < count
             safe_slots = jnp.where(active, chunk, 0)
             states = store[safe_slots]
@@ -1135,6 +1241,12 @@ class ShardedTpuChecker(Checker):
             return hi, lo
 
         def prededup_shard(hi, lo, rows_v, gid_v, eb_v, v_act):
+            if sortless and n == 1:
+                # 1-shard sortless: the claim insert IS the global
+                # dedup — this phase is the identity (≈0 s in the
+                # breakdown), exactly the fused body's elision.
+                n_cand = jnp.sum(v_act, dtype=u)
+                return hi, lo, rows_v, gid_v, eb_v, v_act, n_cand[None]
             # dd=1 over the already-compacted buffer, exactly the fused
             # body's local pre-dedup: representatives in sorted key
             # order, one lane per distinct local key.
@@ -1193,13 +1305,23 @@ class ShardedTpuChecker(Checker):
             )
 
         def insert_shard(key_hi, key_lo, rhi, rlo, rv):
-            (
-                table, r_slot, r_new, r_origin, _ra, probe_ok,
-                dd_overflow, rounds,
-            ) = insert_batch_compact(
-                HashSet(key_hi, key_lo), rhi, rlo,
-                rv.astype(jnp.bool_), dedup_factor=1, with_rounds=True,
-            )
+            if sortless:
+                (
+                    table, r_slot, r_new, r_origin, _ra, probe_ok,
+                    dd_overflow, rounds,
+                ) = insert_batch_claim(
+                    HashSet(key_hi, key_lo), rhi, rlo,
+                    rv.astype(jnp.bool_), with_rounds=True,
+                )
+            else:
+                (
+                    table, r_slot, r_new, r_origin, _ra, probe_ok,
+                    dd_overflow, rounds,
+                ) = insert_batch_compact(
+                    HashSet(key_hi, key_lo), rhi, rlo,
+                    rv.astype(jnp.bool_), dedup_factor=1,
+                    with_rounds=True,
+                )
             return (
                 table.key_hi, table.key_lo, r_slot, r_new, r_origin,
                 probe_ok[None], dd_overflow[None], rounds[None],
@@ -1241,24 +1363,35 @@ class ShardedTpuChecker(Checker):
         w = cm.state_width
         fpw = cm.fp_words or w
         n = self._n
-        f = self._chunk
-        b = f * cm.max_actions
+        f_eff = self._step_width()  # the live step rung (bytes.step)
+        b = f_eff * cm.max_actions
         # The LIVE sort rung, not the worst-case unique_buffer_size:
         # bytes.dedup drops in proportion to the rung — the ladder's
         # regression gauge (docs/OBSERVABILITY.md).
         u_sz = self._sort_width()
         bkt = self._bucket_lanes()
         recv = n * bkt if n > 1 else u_sz  # post-exchange insert lanes
-        step = copy_bytes(f, w) + b * 4 + copy_bytes(u_sz, w)
+        step = copy_bytes(f_eff, w) + b * 4 + copy_bytes(u_sz, w)
         if not two_phase:
             step += b * w * 4
         canon = (copy_bytes(u_sz, w) if self._canon is not None else 0)
         canon += u_sz * fpw * 4 + 2 * u_sz * 4
-        dedup = (
-            sort_bytes(u_sz, 3) + 4 * u_sz * 4 + copy_bytes(u_sz, w)
-            + sort_bytes(recv, 3)
-            + probe_bytes(recv, probe_rounds) + 4 * recv * 4
-        )
+        if self._sortless:
+            # Claim-path dedup: the owner-side insert probes (no sort);
+            # the local prededup sort survives only on n>1 meshes (the
+            # exchange ships distinct keys) and is elided at n == 1.
+            dedup = probe_bytes(recv, probe_rounds) + 2 * recv * 4
+            if n > 1:
+                dedup += (
+                    sort_bytes(u_sz, 3) + 4 * u_sz * 4
+                    + copy_bytes(u_sz, w)
+                )
+        else:
+            dedup = (
+                sort_bytes(u_sz, 3) + 4 * u_sz * 4 + copy_bytes(u_sz, w)
+                + sort_bytes(recv, 3)
+                + probe_bytes(recv, probe_rounds) + 4 * recv * 4
+            )
         exchange = 0
         if n > 1:
             # send-buffer scatter + the a2a move (in and out) of the
@@ -1292,6 +1425,7 @@ class ShardedTpuChecker(Checker):
         props = self._properties
         n = self._n
         f = self._chunk
+        f_eff = self._step_width()  # the live step-geometry rung
         cap_s = self._cap_s
         qcap = cap_s
         w = cm.state_width
@@ -1347,7 +1481,21 @@ class ShardedTpuChecker(Checker):
         while int((level_end - level_start).sum()) > 0:
             if target_depth and depth >= target_depth - 1:
                 break
-            counts = np.minimum(level_end - level_start, f)
+            if (
+                f_eff < f
+                and int((level_end - level_start).max()) > f_eff
+            ):
+                # Step-rung clamp (flag 128): pure host data here, so
+                # the climb happens BEFORE the wave is even dispatched
+                # — same non-committing semantics as the fused flag.
+                if self._grow_knobs(128) is None:
+                    raise RuntimeError(self._wl_overflow_message(128))
+                f_eff = self._step_width()
+                bkt = self._bucket_lanes()
+                progs = self._traced_programs()
+                vitals.record_overflow_recovery()
+                continue
+            counts = np.minimum(level_end - level_start, f_eff)
             ctrl = jax.device_put(
                 jnp.asarray(
                     np.stack([level_start, level_end], axis=1)
@@ -1402,6 +1550,7 @@ class ShardedTpuChecker(Checker):
                     )
                 disc = disc_before
                 f = self._chunk  # dedup growth may halve it
+                f_eff = self._step_width()
                 bkt = self._bucket_lanes()
                 progs = self._traced_programs()
                 vitals.record_overflow_recovery()
@@ -1543,12 +1692,22 @@ class ShardedTpuChecker(Checker):
             self._metrics.inc("device_call_sec_total", t7 - t0)
             self._metrics.inc("device_calls", 1)
 
-            # Density-driven sort-rung downshift, per committed wave
-            # (wave_loop.maybe_retune_sort); a rung change re-keys the
-            # phase programs and recomputes the rung-derived buckets.
-            from .wave_loop import maybe_retune_sort
+            # Density-driven sort-rung downshift and frontier-driven
+            # step-rung downshift, per committed wave (wave_loop's
+            # shared tuners); a rung change re-keys the phase programs
+            # and recomputes the rung-derived buckets.
+            from .wave_loop import maybe_retune_sort, maybe_retune_step
 
-            if maybe_retune_sort(self, vitals.last_density):
+            retuned = maybe_retune_sort(self, vitals.last_density)
+            # Per-shard evidence: the fullest shard's backlog is what
+            # the (per-shard) chunk rung must hold.  The fused loop
+            # feeds the global sum instead — an upper bound, so its
+            # downshifts are merely more conservative.
+            peak_backlog = int((level_end - level_start).max())
+            if maybe_retune_step(self, peak_backlog or None):
+                retuned = True
+            if retuned:
+                f_eff = self._step_width()
                 bkt = self._bucket_lanes()
                 progs = self._traced_programs()
 
@@ -1720,6 +1879,15 @@ class ShardedTpuChecker(Checker):
                 if saved_rung:
                     self._sort_lanes = saved_rung
                     self._sort_tune = False
+            if "sortless" in snap.files:
+                # Adopt the saved run's dedup path: a resume of a
+                # fallen-back run must not re-pay the fallback retry.
+                self._sortless = bool(int(snap["sortless"]))
+            if "step_lanes" in snap.files:
+                saved_step = int(snap["step_lanes"])
+                if saved_step:
+                    self._step_lanes = saved_step
+                    self._step_tune = False
             from .wavefront import _device_owned
 
             def up(x):
@@ -1890,6 +2058,20 @@ class ShardedTpuChecker(Checker):
         if getattr(self, "_run_fn", None) is not None:
             self._run_fn = self._programs()
 
+    def _wl_full_step_lanes(self) -> int:
+        return self._chunk
+
+    def _wl_apply_step_rung(self, rung: int) -> None:
+        """Apply a frontier-tuner downshift (wave_loop.
+        maybe_retune_step) — the step-ladder twin of the sort hook
+        above; same journal/recompile contract."""
+        self._step_lanes = int(rung)
+        self._step_quanta = 0
+        if self._journal:
+            self._journal.append("geometry", **self._wl_geometry())
+        if getattr(self, "_run_fn", None) is not None:
+            self._run_fn = self._programs()
+
     def _wl_geometry(self) -> dict:
         """The ``geometry`` journal event payload (wave_loop.
         journal_geometry) — the advisor's knob ground truth, incl. the
@@ -1901,7 +2083,9 @@ class ShardedTpuChecker(Checker):
             "capacity_per_shard": self._cap_s,
             "chunk_size": self._chunk,
             "dedup_factor": self._dedup_factor,
+            "sortless": self._sortless,
             "sort_lanes": self._sort_width(),
+            "step_lanes": self._step_width(),
             "bucket_slack": self._bucket_slack,
             "exchange_bucket_lanes": (
                 0 if self._n == 1 else self._bucket_lanes()
@@ -1961,12 +2145,12 @@ class ShardedTpuChecker(Checker):
 
     def _wl_retryable_flags(self) -> int:
         # 4 = pre-exchange compaction/dedup overflow, 32 = exchange
-        # bucket overflow: both are detected before any state mutation,
-        # so the aborted wave committed nothing and a grown re-run is
-        # exact.  Table (1) / queue (2) growth would change the gid
-        # encoding that parent links and snapshots bake in, so those
-        # stay loud errors on this engine.
-        return 4 | 32
+        # bucket overflow, 128 = step-rung clamp: all detected before
+        # any state mutation, so the aborted wave committed nothing and
+        # a grown re-run is exact.  Table (1) / queue (2) growth would
+        # change the gid encoding that parent links and snapshots bake
+        # in, so those stay loud errors on this engine.
+        return 4 | 32 | 128
 
     def _wl_overflow_message(self, flags: int) -> str:
         if flags & 16:
@@ -2010,6 +2194,12 @@ class ShardedTpuChecker(Checker):
                 "impossible by construction at dedup_factor=1 over the "
                 "receive batch; please report"
             )
+        if flags & 128:
+            return (
+                "the step-rung ladder clamped a wave at the full chunk "
+                "width — impossible by construction (the clamp is "
+                "compiled out at the top rung); please report"
+            )
         return f"sharded engine overflow flags={flags}"
 
     def _grow_knobs(self, flags: int):
@@ -2022,10 +2212,20 @@ class ShardedTpuChecker(Checker):
         Returns the grow-note string, or None when the tripped knob
         cannot grow."""
         from .wave_loop import (
-            log_grow, next_bucket_slack, relax_dedup_geometry,
+            climb_step_rung, log_grow, next_bucket_slack,
+            relax_dedup_geometry,
         )
 
         notes = []
+        if flags & 128:
+            # Step-rung clamp: the fullest shard's remaining level
+            # exceeded the chunk rung — climb one rung (shared ladder
+            # rule, wave_loop.climb_step_rung).
+            note = climb_step_rung(self, self._chunk)
+            if note is None:
+                return None
+            self._step_retries += 1
+            notes.append(note)
         if flags & 4:
             from .wave_loop import climb_sort_rung, reset_sort_rung_to_full
 
@@ -2132,10 +2332,13 @@ class ShardedTpuChecker(Checker):
             "waves": waves_total,
             "chunk_size": f,
             "exchange_lanes_per_shard": u_sz,
-            # The discovered sort-geometry rung + its retry count, the
+            # The discovered rungs + their retry counts, the
             # bucket_slack pattern (knob cache / warm-start evidence).
             "sort_lanes": u_sz,
             "sort_retries": self._sort_retries,
+            "sortless": int(self._sortless),
+            "step_lanes": self._step_width(),
+            "step_retries": self._step_retries,
             # The bucketed payload shape: each shard ships one
             # [bkt, W+3] bucket per destination per wave.
             "exchange_bucket_lanes": 0 if n == 1 else bkt,
@@ -2226,6 +2429,11 @@ class ShardedTpuChecker(Checker):
                 # rung (0 = running at the full buffer), so a resume
                 # skips the sort ladder's ramp too.
                 sort_lanes=self._sort_lanes or 0,
+                # The dedup path + step rung, same sentinel rules: a
+                # resume must not re-pay the sortless fallback or the
+                # step ladder's climb ramp.
+                sortless=int(self._sortless),
+                step_lanes=self._step_lanes or 0,
                 **arrays,
             )
         os.replace(tmp, path)
@@ -2254,12 +2462,26 @@ class ShardedTpuChecker(Checker):
             bucket_slack=self._bucket_slack,
             # The discovered sort rung (the second ladder the knob
             # cache persists — warm runs skip both ramps) — ONLY when
-            # one was actually pinned; persisting the full worst-case
-            # width would disarm every warm repeat's density tuner
-            # (wavefront.py's rule).
+            # one was actually pinned AND the run ended on the sort
+            # path; persisting the full worst-case width would disarm
+            # every warm repeat's density tuner, and a SORTLESS run's
+            # rung is the claim compaction buffer's tuner detail — an
+            # explicit sort_lanes under sortless means a fallback-
+            # forcing budget cap on the single-chip engine, so a warm
+            # repeat must re-arm the tuner instead (wavefront.py's and
+            # the serve scheduler's rule).
             **(
                 {"sort_lanes": self._sort_width()}
-                if self._sort_lanes is not None else {}
+                if self._sort_lanes is not None and not self._sortless
+                else {}
+            ),
+            # The discovered dedup path + step rung (wavefront.py's
+            # persistence rules: the path always, a rung only when
+            # pinned).
+            sortless=int(self._sortless),
+            **(
+                {"step_lanes": self._step_width()}
+                if self._step_lanes is not None else {}
             ),
         )
 
@@ -2285,7 +2507,7 @@ class ShardedTpuChecker(Checker):
             store[d, queue[d, : int(stats[d, S_TAIL])]] for d in range(n)
         ]
         return fingerprints_of_rows(
-            self._compiled, np.concatenate(rows, axis=0)
+            self._compiled, np.concatenate(rows, axis=0), self._canon
         )
 
     # --- Checker surface -----------------------------------------------------
@@ -2324,9 +2546,12 @@ class ShardedTpuChecker(Checker):
             capacity_per_shard=self._cap_s,
             chunk_size=self._chunk,
             dedup_factor=self._dedup_factor,
+            sortless=self._sortless,
             sort_lanes=self._sort_width(),
             # Pinned rung vs live width: wavefront.py's rule.
             sort_lanes_rung=self._sort_lanes or 0,
+            step_lanes=self._step_width(),
+            step_lanes_rung=self._step_lanes or 0,
             bucket_slack=self._bucket_slack,
             exchange_bucket_lanes=(
                 0 if self._n == 1 else self._bucket_lanes()

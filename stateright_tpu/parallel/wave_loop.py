@@ -105,46 +105,114 @@ SORT_RUNG_HEADROOM = 4.0
 # waves, so production runs reach the window almost immediately).
 SORT_TUNE_MIN_QUANTA = 8
 
+# --- step-geometry rung ladder (the frontier-sized step; ROADMAP #1) ---------
+#
+# The OTHER buffer-proportional full-width pass: the expansion kernel and
+# valid-lane compaction scan B = max_frontier × max_actions candidate
+# lanes every wave, while the live frontier level is often a fraction of
+# the chunk (56% of wave time on the post-PR-12 low-density gauge).
+# ``step_lanes`` is a power-of-two rung on the per-wave CHUNK width (in
+# frontier lanes): the chunk slice, the candidate batch (rung ×
+# max_actions lanes), the valid compaction, and the dedup buffers all
+# span the rung instead of the worst case.  A wave whose remaining level
+# exceeds the rung raises the non-committing flag 128 and the host
+# climbs one rung (×2, capped at max_frontier — where the flag cannot
+# fire and behavior is exactly pre-ladder); the frontier-size tuner
+# downshifts between committed quanta through the shared helpers below.
+# The discovered rung rides the knob cache / tuned_kwargs like the sort
+# rung.  Processing a level wider than max_frontier still chunks through
+# multiple waves, exactly as before — the ladder only removes the dead
+# lanes below the cap.
+STEP_RUNG_MIN = 256
+STEP_RUNG_HEADROOM = 4.0
+STEP_TUNE_MIN_QUANTA = 8
+
 
 def _pow2_ceil(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
+def clamp_rung(requested: int, min_rung: int) -> int:
+    """Normalize a requested rung onto a ladder: next power of two,
+    floored at ``min_rung``.  The full-buffer cap is applied live by the
+    engines (``min(rung, full)``) because auto-grow can move the full
+    width mid-run.  Shared by both ladders so they cannot drift."""
+    return max(min_rung, _pow2_ceil(max(1, int(requested))))
+
+
 def clamp_sort_lanes(requested: int) -> int:
-    """Normalize a requested rung onto the ladder: next power of two,
-    floored at ``SORT_RUNG_MIN``.  The full-buffer cap is applied live by
-    the engines (``min(rung, U)``) because auto-grow can move U mid-run."""
-    return max(SORT_RUNG_MIN, _pow2_ceil(max(1, int(requested))))
+    return clamp_rung(requested, SORT_RUNG_MIN)
+
+
+def clamp_step_lanes(requested: int) -> int:
+    return clamp_rung(requested, STEP_RUNG_MIN)
+
+
+def next_rung(cur: int, full: int, min_rung: int) -> Optional[int]:
+    """The next rung up (doubling, capped at ``full``), or None when the
+    rung already spans the full buffer — at which point the overflow
+    criterion is the pre-ladder condition and the remaining lever is the
+    ladder-specific relax/raise path.  Shared by both ladders."""
+    if cur >= full:
+        return None
+    return min(max(min_rung, cur * 2), full)
 
 
 def next_sort_lanes(cur: int, u_sz: int) -> Optional[int]:
-    """The next rung up (doubling, capped at the full ``u_sz`` buffer),
-    or None when the sort already spans the full buffer — at which point
-    the rung overflow criterion IS the pre-ladder dedup criterion and the
-    remaining growth lever is ``relax_dedup_geometry``."""
-    if cur >= u_sz:
-        return None
-    return min(max(SORT_RUNG_MIN, cur * 2), u_sz)
+    """The next sort rung up; None once the sort spans the full ``u_sz``
+    buffer (the rung overflow criterion then IS the pre-ladder dedup
+    criterion and the remaining growth lever is
+    ``relax_dedup_geometry``)."""
+    return next_rung(cur, u_sz, SORT_RUNG_MIN)
+
+
+def next_step_lanes(cur: int, full: int) -> Optional[int]:
+    """The next step rung up; None once the chunk spans the full
+    ``max_frontier`` (where the clamp flag cannot fire by construction)."""
+    return next_rung(cur, full, STEP_RUNG_MIN)
+
+
+def downshift_rung(
+    cur: int, full: int, floor: int, peak: float,
+    min_rung: int, headroom: float,
+) -> Optional[int]:
+    """The ONE downshift decision both ladders share, parameterized by
+    (min, headroom) with at-least-halving hysteresis: the rung that
+    holds the measured peak at ``headroom``× slack, or None when no
+    at-least-halving move exists.  ``floor`` is the overflow-proven
+    minimum (a rung this run already climbed past must never be
+    revisited — the ladder-thrash mode the watch verb badges)."""
+    want = max(
+        min_rung,
+        int(floor),
+        _pow2_ceil(max(1, int(peak * headroom) + 1)),
+    )
+    want = min(want, full)
+    if want * 2 <= cur:
+        return want
+    return None
 
 
 def downshift_sort_lanes(
     cur: int, u_sz: int, floor: int, peak_valid: float
 ) -> Optional[int]:
-    """Density-driven downshift decision: the rung that holds the
-    measured per-wave valid peak at ``SORT_RUNG_HEADROOM``× headroom,
-    or None when no at-least-halving move exists.  ``floor`` is the
-    overflow-proven minimum (a rung this run already climbed past must
-    never be revisited — that is the ladder-thrash mode the watch verb
-    badges)."""
-    want = max(
-        SORT_RUNG_MIN,
-        int(floor),
-        _pow2_ceil(max(1, int(peak_valid * SORT_RUNG_HEADROOM) + 1)),
+    """Density-driven sort-rung downshift (see :func:`downshift_rung`):
+    the rung holding the measured per-wave valid peak at
+    ``SORT_RUNG_HEADROOM``× headroom."""
+    return downshift_rung(
+        cur, u_sz, floor, peak_valid, SORT_RUNG_MIN, SORT_RUNG_HEADROOM
     )
-    want = min(want, u_sz)
-    if want * 2 <= cur:
-        return want
-    return None
+
+
+def downshift_step_lanes(
+    cur: int, full: int, floor: int, peak_frontier: float
+) -> Optional[int]:
+    """Frontier-size-driven step-rung downshift (see
+    :func:`downshift_rung`): the chunk rung holding the measured live
+    frontier peak at ``STEP_RUNG_HEADROOM``× headroom."""
+    return downshift_rung(
+        cur, full, floor, peak_frontier, STEP_RUNG_MIN, STEP_RUNG_HEADROOM
+    )
 
 
 def climb_sort_rung(eng, full: int) -> Optional[str]:
@@ -165,6 +233,41 @@ def climb_sort_rung(eng, full: int) -> Optional[str]:
     return f"sort_lanes={nxt}"
 
 
+def climb_step_rung(eng, full: int) -> Optional[str]:
+    """The flag-128 rung climb (the step ladder's analog of
+    :func:`climb_sort_rung`, shared by all three engines): climb one
+    chunk rung toward ``full`` (= ``max_frontier`` / ``chunk_size``),
+    record the overflow-proven floor and peak evidence, and return the
+    grow note.  None when the chunk already spans the full width — which
+    cannot be reached via flag 128 (the clamp flag is compiled out at
+    the top rung), so None here means a logic error surfacing loudly."""
+    cur = eng._step_width()
+    nxt = next_step_lanes(cur, full)
+    if nxt is None:
+        return None
+    eng._step_lanes = nxt
+    eng._step_rung_floor = nxt
+    # The clamp proved the live frontier exceeds the old rung.
+    eng._step_peak_frontier = max(eng._step_peak_frontier, cur)
+    return f"step_lanes={nxt}"
+
+
+def fall_back_to_sort(eng) -> str:
+    """The sortless → sort-rung fallback (the engines' flag dispatch
+    under ``sortless``): flip the dedup path to the sorted fallback rung
+    — the already-proven PR 12 ladder — re-journal the geometry event so
+    journal readers (`watch`'s ``dedup=`` field and fallback-thrash
+    badge) track the flip, and return the grow note.  Non-committing by
+    the same contract as every other ladder move: the flagged wave never
+    committed, so the re-run at the sorted program is exact.  The knob
+    cache persists the flipped mode (``tuned_kwargs()['sortless']``), so
+    the fallback is a per-workload selection, paid once."""
+    eng._sortless = False
+    if eng._journal:
+        eng._journal.append("geometry", **eng._wl_geometry())
+    return "sortless=0"
+
+
 def reset_sort_rung_to_full(eng, old_full: int) -> None:
     """The relax-path tail: a FULL-buffer flag-4 overflow relaxed
     dedup_factor, so the rung resets to the new (larger) full width and
@@ -179,36 +282,77 @@ def reset_sort_rung_to_full(eng, old_full: int) -> None:
         eng._journal.append("geometry", **eng._wl_geometry())
 
 
-def maybe_retune_sort(eng, density) -> bool:
-    """Shared density→rung downshift, called by every host loop after a
-    committed quantum (fused and traced alike; engines without the
-    ``_wl_apply_sort_rung`` hook are untouched).  Folds the quantum's
-    measured density into the engine's running valid peak, and applies a
-    downshift when :func:`downshift_sort_lanes` finds one.  Returns True
-    exactly when the rung changed — traced loops use it to refresh their
-    phase programs."""
-    apply = getattr(eng, "_wl_apply_sort_rung", None)
-    if apply is None or density is None:
+def _maybe_retune(eng, measured, ns: dict) -> bool:
+    """The ONE measured-evidence → rung-downshift tuner both ladders
+    share (parameterized by the attribute namespace ``ns`` — see
+    ``_SORT_NS``/``_STEP_NS`` below — so the two tuners cannot drift):
+    folds the quantum's measurement into the engine's running peak and
+    applies a downshift once enough committed quanta accumulated.
+    Returns True exactly when the rung changed — traced loops use it to
+    refresh their phase programs."""
+    apply = getattr(eng, ns["apply"], None)
+    if apply is None or measured is None:
         return False
-    if not getattr(eng, "_sort_tune", False):
-        # An EXPLICIT sort_lanes (warm start from the knob cache, or a
-        # pinned measurement leg) is the caller's rung: the tuner must
-        # not fight it.  The overflow ladder stays armed regardless —
-        # an explicit rung that proves too small still climbs.
+    if not getattr(eng, ns["tune"], False):
+        # An EXPLICIT rung (warm start from the knob cache, or a pinned
+        # measurement leg) is the caller's rung: the tuner must not
+        # fight it.  The overflow ladder stays armed regardless — an
+        # explicit rung that proves too small still climbs.
         return False
-    full = eng._wl_full_sort_lanes()
-    cur = eng._sort_width()
-    eng._sort_quanta += 1
-    eng._sort_peak_valid = max(eng._sort_peak_valid, density * full)
-    if eng._sort_quanta < SORT_TUNE_MIN_QUANTA:
+    full = getattr(eng, ns["full"])()
+    cur = getattr(eng, ns["width"])()
+    setattr(eng, ns["quanta"], getattr(eng, ns["quanta"]) + 1)
+    peak_obs = measured * full if ns["scale_by_full"] else measured
+    setattr(
+        eng, ns["peak"], max(getattr(eng, ns["peak"]), peak_obs)
+    )
+    if getattr(eng, ns["quanta"]) < ns["min_quanta"]:
         return False
-    want = downshift_sort_lanes(
-        cur, full, eng._sort_rung_floor, eng._sort_peak_valid
+    want = downshift_rung(
+        cur, full, getattr(eng, ns["floor"]), getattr(eng, ns["peak"]),
+        ns["min_rung"], ns["headroom"],
     )
     if want is None:
         return False
     apply(want)
     return True
+
+
+# The sort ladder's evidence is the measured valid DENSITY (a fraction
+# of the full buffer — scaled back to lanes here); the step ladder's is
+# the live frontier backlog, already in lanes.
+_SORT_NS = dict(
+    apply="_wl_apply_sort_rung", tune="_sort_tune",
+    full="_wl_full_sort_lanes", width="_sort_width",
+    quanta="_sort_quanta", peak="_sort_peak_valid",
+    floor="_sort_rung_floor", min_rung=SORT_RUNG_MIN,
+    headroom=SORT_RUNG_HEADROOM, min_quanta=SORT_TUNE_MIN_QUANTA,
+    scale_by_full=True,
+)
+_STEP_NS = dict(
+    apply="_wl_apply_step_rung", tune="_step_tune",
+    full="_wl_full_step_lanes", width="_step_width",
+    quanta="_step_quanta", peak="_step_peak_frontier",
+    floor="_step_rung_floor", min_rung=STEP_RUNG_MIN,
+    headroom=STEP_RUNG_HEADROOM, min_quanta=STEP_TUNE_MIN_QUANTA,
+    scale_by_full=False,
+)
+
+
+def maybe_retune_sort(eng, density) -> bool:
+    """Shared density→sort-rung downshift, called by every host loop
+    after a committed quantum (fused and traced alike; engines without
+    the ``_wl_apply_sort_rung`` hook are untouched)."""
+    return _maybe_retune(eng, density, _SORT_NS)
+
+
+def maybe_retune_step(eng, remaining) -> bool:
+    """Frontier→step-rung downshift, same cadence and hysteresis as the
+    sort tuner by construction (one shared helper): the evidence is the
+    committed quantum's remaining frontier backlog (an underestimate of
+    intra-quantum peaks, which the headroom absorbs — and an undersized
+    rung is the non-committing flag 128, never a wrong answer)."""
+    return _maybe_retune(eng, remaining, _STEP_NS)
 
 
 def relax_dedup_geometry(chunk, dedup_factor, lanes_of, lane_cap,
@@ -578,11 +722,15 @@ class FusedWaveLoop:
                 after_commit = getattr(eng, "_wl_after_commit", None)
                 if after_commit is not None:
                     carry = after_commit(carry, view) or carry
-                # Density-driven sort-rung downshift (engines with the
-                # hook only): the carry is rung-independent — only the
-                # per-wave scratch buffers reshape — so a retune is a
-                # program swap between calls, never a migration.
+                # Density-driven sort-rung downshift and frontier-driven
+                # step-rung downshift (engines with the hooks only): the
+                # carry is rung-independent — only the per-wave scratch
+                # buffers reshape — so a retune is a program swap
+                # between calls, never a migration.
                 maybe_retune_sort(eng, vitals.last_density)
+                # remaining == 0 means the run is about to break — a
+                # downshift there would recompile for zero waves.
+                maybe_retune_step(eng, view.remaining or None)
             if (
                 eng._checkpoint_path is not None
                 and view.flags == 0
@@ -657,20 +805,28 @@ def finalize_run(eng, carry_dict: dict) -> None:
         )
 
 
-def fingerprints_of_rows(cm, rows_np):
+def fingerprints_of_rows(cm, rows_np, canon=None):
     """Sorted uint64 fingerprints of a batch of packed state rows — the
     shared implementation behind both engines'
     ``discovered_fingerprints()``, so cross-engine discovery-set pins
-    compare one definition (the device fingerprint of the ORIGINAL row's
-    leading ``fp_words``, exactly what identifies a state everywhere
-    else in the engines)."""
+    compare one definition: the device fingerprint of the row's leading
+    ``fp_words``, through ``canon`` when symmetry is on — exactly what
+    identifies a state everywhere else in the engines (dedup keys,
+    shard routing, tiered cold keys).  Under symmetry the logged
+    ORIGINAL row is whichever orbit member the traversal reached first
+    — order-dependent by construction — so the identity (= canonical)
+    fingerprint is the only traversal-invariant discovery-set pin."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ..ops.device_fp import device_fp64
 
     fpw = cm.fp_words or cm.state_width
-    hi, lo = device_fp64(jnp.asarray(rows_np[:, :fpw]))
+    rows = jnp.asarray(rows_np)
+    if canon is not None:
+        rows = jax.vmap(canon)(rows)
+    hi, lo = device_fp64(rows[:, :fpw])
     fps = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
         lo
     ).astype(np.uint64)

@@ -207,6 +207,8 @@ class TpuChecker(Checker):
         # final geometry; batches under the 16K buffer floor never see it.
         dedup_factor: int = 8,
         sort_lanes: Optional[int] = None,
+        sortless: Optional[bool] = None,
+        step_lanes: Optional[int] = None,
         waves_per_call: Optional[int] = None,
         device=None,
         compiled: Optional[CompiledModel] = None,
@@ -238,16 +240,46 @@ class TpuChecker(Checker):
         model's layout cannot represent a reachable state.  Resumed runs
         adopt the snapshot's geometry and may auto-grow past it.
 
-        ``sort_lanes``: the adaptive sort-geometry rung (docs/
-        OBSERVABILITY.md "The dedup-sort rung ladder") — a power-of-two
-        width for the per-wave compact/dedup-sort buffers, replacing the
-        worst-case ``U = max(min(B, 16K), B/dedup_factor)``.  None (the
-        default) starts at the full buffer and lets the density-driven
-        tuner downshift mid-run; pass the knob-cache rung
-        (``tuned_kwargs()['sort_lanes']``) to warm-start past the ramp.
-        A wave whose valid candidates exceed the rung overflows (flag 4,
-        nothing commits) and the host retries one rung up — identical
-        discovery sets at every rung, by construction.
+        ``sortless``: select the dedup path (docs/OBSERVABILITY.md
+        "Sortless dedup and the rung ladders").  The default (None)
+        resolves to the SORTLESS claim-plane election
+        (hashset.insert_batch_claim — representatives elected inside
+        the probe rounds, no 3-plane co-sort, density-insensitive)
+        unless an explicit ``sort_lanes`` rung selects the sorted
+        fallback path.  Under sortless, a flag-4 overflow of a
+        TUNER-pinned compaction rung just climbs one rung (same as the
+        sort path — the tuner guessed small, the election is fine);
+        the FALLBACK to the sort-rung path fires when the claim
+        compaction buffer overflows at its full worst-case width (a
+        duplicate-heavy workload the election cannot represent at this
+        ``dedup_factor``) — non-committing, the wave re-runs, no work
+        lost — and ``tuned_kwargs()`` persists the flip, so the
+        selection is per-workload through the knob cache.  Passing
+        BOTH ``sortless=True`` and ``sort_lanes`` keeps the election
+        but makes the rung an explicit claim BUDGET: its overflow
+        falls back immediately — the forcing knob tests/CI use to
+        exercise the fallback on small models.
+
+        ``sort_lanes``: the sorted fallback path's adaptive rung (the
+        PR 12 ladder) — a power-of-two width for the per-wave
+        compact/dedup-sort buffers, replacing the worst-case ``U =
+        max(min(B, 16K), B/dedup_factor)``.  Passing it selects the
+        sort path (see ``sortless``) warm-started at the rung; on the
+        sort path with no rung the density tuner downshifts mid-run.
+        A wave whose valid candidates exceed the rung overflows
+        (flag 4, nothing commits) and the host retries one rung up —
+        identical discovery sets at every rung, by construction.
+
+        ``step_lanes``: the frontier-sized step rung (wave_loop.py's
+        second ladder) — a power-of-two per-wave CHUNK width replacing
+        ``max_frontier``, so the expansion kernel and valid-lane
+        compaction scan ``step_lanes × max_actions`` candidate lanes
+        instead of the full worst-case ``B``.  None starts at the full
+        chunk and lets the frontier tuner downshift; a wave whose
+        remaining level exceeds the rung raises the non-committing
+        flag 128 and the host climbs one rung (×2, capped at
+        ``max_frontier``).  The discovered rung rides the knob cache
+        exactly like ``sort_lanes``.
 
         ``journal`` (a :class:`~stateright_tpu.runtime.journal.Journal`
         or a path) streams wave-level telemetry — per-call frontier
@@ -334,7 +366,10 @@ class TpuChecker(Checker):
         # an explicit rung (a knob-cache warm start) skips the ramp.
         # Overflowing a rung is the non-committing flag 4: the host
         # climbs one rung and re-runs the chunk, no work lost.
-        from .wave_loop import SORT_RUNG_MIN, clamp_sort_lanes
+        from .wave_loop import (
+            SORT_RUNG_MIN, STEP_RUNG_MIN, clamp_sort_lanes,
+            clamp_step_lanes,
+        )
 
         self._sort_lanes = (
             None if sort_lanes is None else clamp_sort_lanes(sort_lanes)
@@ -346,6 +381,20 @@ class TpuChecker(Checker):
         self._sort_rung_floor = SORT_RUNG_MIN
         self._sort_peak_valid = 0.0
         self._sort_quanta = 0
+        # Dedup-path selection (the sortless claim-plane election is the
+        # default; an explicit sort_lanes rung selects the sorted
+        # fallback path — see the docstring).
+        self._sortless = (
+            (sort_lanes is None) if sortless is None else bool(sortless)
+        )
+        # Frontier-sized step rung (wave_loop.py's second ladder).
+        self._step_lanes = (
+            None if step_lanes is None else clamp_step_lanes(step_lanes)
+        )
+        self._step_tune = step_lanes is None
+        self._step_rung_floor = STEP_RUNG_MIN
+        self._step_peak_frontier = 0.0
+        self._step_quanta = 0
         self._auto_tune = bool(auto_tune)
         self._max_frontier = max_frontier
         # Spawn-time guard on the compact/dedup buffer width: configs past
@@ -477,7 +526,8 @@ class TpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import (
-            HashSet, compact_valid, insert_batch, insert_batch_compact,
+            HashSet, compact_valid, insert_batch, insert_batch_claim,
+            insert_batch_compact,
         )
         from .wave_common import wave_eval
 
@@ -496,16 +546,29 @@ class TpuChecker(Checker):
             rows_c = rows if canon is None else jax.vmap(canon)(rows)
             return device_fp64(rows_c[:, :fpw])
         a = cm.max_actions
-        f = self._max_frontier  # chunk size
+        f = self._max_frontier  # worst-case chunk (seed/pad geometry)
+        # The live step-geometry rung: the per-wave chunk width.  A wave
+        # whose remaining level exceeds it raises the non-committing
+        # flag 128 (compiled out at the top rung, where the clamp is
+        # impossible) and the host climbs one rung.
+        f_eff = self._step_width()
         cap = self._capacity
         qcap = self._log_capacity  # one row-log position per unique state
         pad = self._block_pad()  # append-block lanes past qcap
         dedup_factor = self._dedup_factor
+        # Dedup path: the sortless claim-plane election by default; the
+        # sorted fallback rung when selected (knob cache / explicit).
+        sortless = self._sortless
         # The live sort-geometry rung: the compact/dedup/insert buffers
         # below span this width; everything downstream (probe rounds,
         # result gathers, the append-block compaction source) follows
-        # the compacted buffer's shape automatically.
-        sort_lanes = self._sort_width()
+        # the compacted buffer's shape automatically.  None = the
+        # worst-case buffer of the LIVE (step-rung-sized) batch; pinned
+        # only when a rung exists (sort path, or a sortless forcing
+        # run capping the claim compaction buffer).
+        sort_lanes = (
+            None if self._sort_lanes is None else self._sort_width()
+        )
         props = self._properties
         n_props = len(props)
         ev_indices = self._ev_indices
@@ -544,14 +607,16 @@ class TpuChecker(Checker):
                 flags,
             ) = carry
 
-            count = jnp.minimum(level_end - level_start, jnp.uint32(f))
-            lane = jnp.arange(f, dtype=jnp.uint32)
+            count = jnp.minimum(level_end - level_start, jnp.uint32(f_eff))
+            lane = jnp.arange(f_eff, dtype=jnp.uint32)
             active = lane < count
             ids = level_start + lane  # BFS positions are the state ids
             states = jax.lax.dynamic_slice(
-                rows, (level_start * jnp.uint32(w),), (f * w,)
-            ).reshape(f, w)
-            eb_chunk = jax.lax.dynamic_slice(ebits, (level_start,), (f,))
+                rows, (level_start * jnp.uint32(w),), (f_eff * w,)
+            ).reshape(f_eff, w)
+            eb_chunk = jax.lax.dynamic_slice(
+                ebits, (level_start,), (f_eff,)
+            )
 
             disc_prev = disc
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
@@ -559,7 +624,7 @@ class TpuChecker(Checker):
                 allow_two_phase=True,
             )
 
-            flat_valid = valid.reshape(f * a)
+            flat_valid = valid.reshape(f_eff * a)
             if nexts is None:
                 # TWO-PHASE expansion: compact the ~5% valid lanes FIRST,
                 # then construct successors (word assembly + per-lane slot
@@ -584,7 +649,7 @@ class TpuChecker(Checker):
                 # Dedup + insert, in compact form: results come back
                 # U-sized (one lane per distinct key), so the append below
                 # costs O(distinct keys) instead of O(candidate lanes).
-                flat = nexts.reshape(f * a, w)
+                flat = nexts.reshape(f_eff * a, w)
                 hi_b, lo_b = fp_of(flat)
                 v_hi, v_lo, v_orig, v_act, v_overflow = compact_valid(
                     hi_b, lo_b, flat_valid, dedup_factor,
@@ -593,13 +658,26 @@ class TpuChecker(Checker):
                 hi, lo = v_hi, v_lo
                 compact_rows = None
                 compact_src = None
-            (
-                table, _u_slot, u_new, u_origin, _u_active, probe_ok,
-                dd_overflow,
-            ) = insert_batch_compact(
-                HashSet(key_hi, key_lo), hi, lo, v_act,
-                dedup_factor=1,
-            )
+            if sortless:
+                # SORTLESS default: claim-plane election inside the
+                # probe rounds (hashset.insert_batch_claim) — no
+                # 3-plane co-sort; representatives (lowest lane of each
+                # equal-key run) and the downstream indexing contract
+                # are identical (u_origin is the identity map).
+                (
+                    table, _u_slot, u_new, u_origin, _u_active, probe_ok,
+                    dd_overflow,
+                ) = insert_batch_claim(
+                    HashSet(key_hi, key_lo), hi, lo, v_act,
+                )
+            else:
+                (
+                    table, _u_slot, u_new, u_origin, _u_active, probe_ok,
+                    dd_overflow,
+                ) = insert_batch_compact(
+                    HashSet(key_hi, key_lo), hi, lo, v_act,
+                    dedup_factor=1,
+                )
             dd_overflow = dd_overflow | v_overflow
             n_new = jnp.sum(u_new, dtype=jnp.uint32)
 
@@ -618,6 +696,14 @@ class TpuChecker(Checker):
             ).astype(jnp.uint32)
             flags = flags | jnp.where(dd_overflow, 4, 0).astype(jnp.uint32)
             flags = flags | jnp.where(step_flag, 8, 0).astype(jnp.uint32)
+            if f_eff < f:
+                # Step-rung clamp (flag 128, non-committing): the
+                # remaining level exceeds the rung — the host climbs
+                # one rung and re-runs; compiled out at the top rung,
+                # where the clamp is impossible by construction.
+                flags = flags | jnp.where(
+                    level_end - level_start > jnp.uint32(f_eff), 128, 0
+                ).astype(jnp.uint32)
             commit = flags == 0
             n_new = jnp.where(commit, n_new, jnp.uint32(0))
             count = jnp.where(commit, count, jnp.uint32(0))
@@ -809,7 +895,9 @@ class TpuChecker(Checker):
             self._log_capacity,
             self._max_frontier,
             self._dedup_factor,
+            self._sortless,  # the dedup path is a trace-time branch
             self._sort_width(),  # the live sort-geometry rung
+            self._step_width(),  # the live step-geometry rung
             self._waves_per_call,  # baked into run() as a constant
             tuple(p.expectation for p in self._properties),
             (
@@ -838,7 +926,9 @@ class TpuChecker(Checker):
             "log_capacity": self._log_capacity,
             "max_frontier": self._max_frontier,
             "dedup_factor": self._dedup_factor,
+            "sortless": self._sortless,
             "sort_lanes": self._sort_width(),
+            "step_lanes": self._step_width(),
             "waves_per_call": self._waves_per_call,
             "symmetry": self._canon is not None,
         }
@@ -918,9 +1008,14 @@ class TpuChecker(Checker):
                 f"lower spawn_tpu(dedup_factor=...) (now "
                 f"{self._dedup_factor}; 1 is always safe)"
             ),
+            128: (
+                "the step-rung ladder clamped a wave at the full chunk "
+                "width — impossible by construction (the clamp flag is "
+                "compiled out at the top rung); please report"
+            ),
         }
         grown = []
-        for bit in (1, 2, 4):
+        for bit in (1, 2, 4, 128):
             if flags_h & bit:
                 if bit == 2 and self._log_capacity > qcap:
                     # A simultaneous table growth (bit 1, processed
@@ -991,29 +1086,61 @@ class TpuChecker(Checker):
             # and copy-growth transiently holds old + new logs at once.
             self._log_capacity = min(self._log_capacity * 2, log_cap_bound)
             return f"log_capacity={self._log_capacity}"
+        if flag & 128:
+            from .wave_loop import climb_step_rung
+
+            # Step-rung ladder: the live frontier level exceeded the
+            # chunk rung — climb one rung (×2, capped at max_frontier,
+            # where the clamp flag is compiled out); the climbed rung
+            # becomes the floor the frontier tuner may never revisit.
+            return climb_step_rung(self, self._max_frontier)
         if flag & 4:
             from .hashset import unique_buffer_size
             from .wave_loop import (
-                climb_sort_rung, relax_dedup_geometry,
+                climb_sort_rung, fall_back_to_sort, relax_dedup_geometry,
                 reset_sort_rung_to_full,
             )
 
-            # Sort-rung ladder first: when the compact/sort buffers run
-            # at a rung below the full U, a flag-4 overflow means the
-            # RUNG was too small, not the worst-case geometry — climb
+            # EXPLICIT claim-budget cap first: ``sortless=True`` with a
+            # caller-pinned ``sort_lanes`` is a budget ("elect within
+            # this compaction width or don't bother"), not a tuner
+            # guess — its overflow is the per-workload fallback signal,
+            # not a climb (the forcing knob tests/CI use, and the one
+            # spawn shape tuned_kwargs deliberately never emits: a
+            # sortless run's pinned rung is a tuner detail, so a warm
+            # repeat re-arms the tuner instead of inheriting a
+            # one-notch-tight explicit cap).
+            if self._sortless and not self._sort_tune:
+                return fall_back_to_sort(self)
+            # Compact-rung ladder next, on BOTH dedup paths: when the
+            # compact/claim buffers run at a TUNER-pinned rung below
+            # the full U, a flag-4 overflow means the rung was too
+            # small — the density tuner downshifted it past a growing
+            # level — not the path or the worst-case geometry.  Climb
             # one rung (×2, capped at U) and re-run; the climbed rung
-            # becomes the floor the density tuner may never revisit.
-            # Only once the rung spans the full buffer does the flag
-            # mean the pre-ladder condition, handled below.  The rule
-            # lives in wave_loop (climb_sort_rung), shared with the
-            # sharded engine so the two cannot drift.
-            full = unique_buffer_size(
-                self._max_frontier * self._compiled.max_actions,
-                self._dedup_factor,
-            )
+            # becomes the floor the tuner may never revisit.  A
+            # rung-level overflow must NOT abandon the claim election:
+            # the sorted path in the identical situation just climbs,
+            # and the sharded engine orders its flag-4 dispatch the
+            # same way (climb before relax) — the rule lives in
+            # wave_loop (climb_sort_rung), shared so the engines
+            # cannot drift.
+            full = self._wl_full_sort_lanes()
             note = climb_sort_rung(self, full)
             if note is not None:
                 return note
+            # SORTLESS fallback at the FULL buffer: the valid batch
+            # exceeded the claim compaction buffer at its worst-case
+            # width — the per-workload signal that the election cannot
+            # represent this (duplicate-heavy) batch at the current
+            # dedup_factor.  Flip to the sorted fallback rung
+            # (wave_loop.fall_back_to_sort; the flagged wave committed
+            # nothing, so the re-run at the sorted program is exact)
+            # and let ITS relax rules take over on subsequent
+            # overflows.  tuned_kwargs persists the flip, so the
+            # selection is per-workload through the knob cache.
+            if self._sortless:
+                return fall_back_to_sort(self)
             # Straight to the always-safe 1, not stepwise (the
             # intermediate dd=2-at-doubled-frontier stop measured as a
             # NEW worker-crash geometry on the 61.5M-state 2pc run),
@@ -1245,36 +1372,67 @@ class TpuChecker(Checker):
         return self._discovery_slots
 
     def _wl_cand_lanes(self) -> int:
-        """The worst-case compaction/dedup buffer width ``U`` — the
-        denominator of the density telemetry (wave_loop.LoopVitals):
-        measured valid candidates per wave over THIS is the fraction of
-        the sort/compact work that touches live lanes.  Deliberately
-        rung-INDEPENDENT (the sort rung is sized FROM density ×
-        worst-case U; a rung-relative density would be self-referential).
-        Queried per quantum because auto-grow may relax the geometry
-        mid-run."""
-        from .hashset import unique_buffer_size
-
-        return unique_buffer_size(
-            self._max_frontier * self._compiled.max_actions,
-            self._dedup_factor,
-        )
+        """The worst-case compaction/dedup buffer width ``U`` of the
+        LIVE (step-rung-sized) batch — the denominator of the density
+        telemetry (wave_loop.LoopVitals): measured valid candidates per
+        wave over THIS is the fraction of the compact/probe work that
+        touches live lanes.  Deliberately SORT-rung-independent (the
+        sort rung is sized FROM density × this width; a sort-rung-
+        relative density would be self-referential), but it follows the
+        step rung — a step-rung-sized wave generates proportionally
+        fewer candidates, and the sort tuner must size against the
+        buffer those waves actually fill.  Queried per quantum because
+        auto-grow and both ladders may move the geometry mid-run."""
+        return self._wl_full_sort_lanes()
 
     # --- sort-geometry rung (wave_loop.py's ladder) --------------------------
 
     def _sort_width(self) -> int:
         """The EFFECTIVE per-wave compact/sort buffer width: the
         requested rung capped at the live worst-case ``U`` (auto-grow
-        may move U mid-run), or ``U`` itself when no rung is set.  The
-        one number the device programs, cache keys, byte model, and
-        knob-cache entries all derive from."""
-        full = self._wl_cand_lanes()
+        and the step rung may move U mid-run), or ``U`` itself when no
+        rung is set.  The one number the device programs, cache keys,
+        byte model, and knob-cache entries all derive from."""
+        full = self._wl_full_sort_lanes()
         if self._sort_lanes is None:
             return full
         return min(self._sort_lanes, full)
 
     def _wl_full_sort_lanes(self) -> int:
-        return self._wl_cand_lanes()
+        from .hashset import unique_buffer_size
+
+        return unique_buffer_size(
+            self._step_width() * self._compiled.max_actions,
+            self._dedup_factor,
+        )
+
+    # --- step-geometry rung (wave_loop.py's second ladder) -------------------
+
+    def _step_width(self) -> int:
+        """The EFFECTIVE per-wave chunk width in frontier lanes: the
+        step rung capped at the live ``max_frontier`` (auto-grow may
+        halve it mid-run), or the full chunk when no rung is set."""
+        full = self._max_frontier
+        if self._step_lanes is None:
+            return full
+        return min(self._step_lanes, full)
+
+    def _wl_full_step_lanes(self) -> int:
+        return self._max_frontier
+
+    def _wl_apply_step_rung(self, rung: int) -> None:
+        """Apply a frontier-tuner downshift (wave_loop.
+        maybe_retune_step): swap the knob, re-journal the geometry
+        event, and — in fused mode — rebuild the run program at the new
+        shapes.  The loop carry is untouched: the rung only shapes
+        per-wave scratch buffers (the row log, table, and positions are
+        rung-independent)."""
+        self._step_lanes = int(rung)
+        self._step_quanta = 0
+        if self._journal:
+            self._journal.append("geometry", **self._wl_geometry())
+        if getattr(self, "_run_fn", None) is not None:
+            _seed, self._run_fn = self._programs()
 
     def _wl_apply_sort_rung(self, rung: int) -> None:
         """Apply a density-tuner downshift (wave_loop.maybe_retune_sort):
@@ -1302,7 +1460,9 @@ class TpuChecker(Checker):
             "log_capacity": self._log_capacity,
             "max_frontier": self._max_frontier,
             "dedup_factor": self._dedup_factor,
+            "sortless": self._sortless,
             "sort_lanes": self._sort_width(),
+            "step_lanes": self._step_width(),
             "u_lanes": self._wl_cand_lanes(),
             "waves_per_call": self._waves_per_call,
         }
@@ -1319,10 +1479,12 @@ class TpuChecker(Checker):
 
     def _wl_retryable_flags(self) -> int:
         # 1 = table overfull, 2 = row log full, 4 = dedup-buffer
-        # overflow: all grow in place (auto_tune off raises the loud
-        # per-knob message from _grow_on_flags instead).  8 (encoding
-        # overflow) is never retryable.
-        return 1 | 2 | 4
+        # overflow (sortless fallback / sort-rung climb / dd relax),
+        # 128 = step-rung clamp (climb one chunk rung): all grow in
+        # place (auto_tune off raises the loud per-knob message from
+        # _grow_on_flags instead).  8 (encoding overflow) is never
+        # retryable.
+        return 1 | 2 | 4 | 128
 
     def _wl_overflow_message(self, flags: int) -> str:
         if flags & 8:
@@ -1380,7 +1542,9 @@ class TpuChecker(Checker):
             self._canon is not None,
             self._max_frontier,
             self._dedup_factor,
+            self._sortless,  # the dedup path is a trace-time branch
             self._sort_width(),  # the live sort-geometry rung
+            self._step_width(),  # the live step-geometry rung
             self._block_pad(),
             tuple(p.expectation for p in self._properties),
         )
@@ -1407,7 +1571,8 @@ class TpuChecker(Checker):
 
         from ..ops.device_fp import device_fp64
         from .hashset import (
-            HashSet, compact_valid_indices, insert_batch_compact,
+            HashSet, compact_valid_indices, insert_batch_claim,
+            insert_batch_compact,
         )
         from .wave_common import compact, wave_eval
 
@@ -1416,28 +1581,33 @@ class TpuChecker(Checker):
         fpw = cm.fp_words or w
         canon = self._canon
         a = cm.max_actions
-        f = self._max_frontier
+        f_eff = self._step_width()  # the live step-geometry rung
         pad = self._block_pad()
         dedup_factor = self._dedup_factor
-        sort_lanes = self._sort_width()  # the live sort-geometry rung
+        sortless = self._sortless  # the dedup path (claim vs sort)
+        sort_lanes = (
+            None if self._sort_lanes is None else self._sort_width()
+        )
         props = self._properties
         ev_indices = self._ev_indices
 
         @jax.jit
         def t_step(rows, ebits, disc, level_start, level_end):
-            count = jnp.minimum(level_end - level_start, jnp.uint32(f))
-            lane = jnp.arange(f, dtype=jnp.uint32)
+            count = jnp.minimum(level_end - level_start, jnp.uint32(f_eff))
+            lane = jnp.arange(f_eff, dtype=jnp.uint32)
             active = lane < count
             ids = level_start + lane
             states = jax.lax.dynamic_slice(
-                rows, (level_start * jnp.uint32(w),), (f * w,)
-            ).reshape(f, w)
-            eb_chunk = jax.lax.dynamic_slice(ebits, (level_start,), (f,))
+                rows, (level_start * jnp.uint32(w),), (f_eff * w,)
+            ).reshape(f_eff, w)
+            eb_chunk = jax.lax.dynamic_slice(
+                ebits, (level_start,), (f_eff,)
+            )
             disc, eb, nexts, valid, generated, step_flag = wave_eval(
                 cm, props, ev_indices, states, active, ids, eb_chunk,
                 disc, allow_two_phase=True,
             )
-            flat_valid = valid.reshape(f * a)
+            flat_valid = valid.reshape(f_eff * a)
             v_orig, v_act, n_valid, v_overflow = compact_valid_indices(
                 flat_valid, dedup_factor, sort_lanes=sort_lanes
             )
@@ -1454,7 +1624,7 @@ class TpuChecker(Checker):
                 # Single-phase: compact the constructed rows.  Same keys
                 # and representatives as the fused compact_valid-on-keys
                 # order (compaction preserves lane order).
-                cand_rows = nexts.reshape(f * a, w)[v_orig]
+                cand_rows = nexts.reshape(f_eff * a, w)[v_orig]
                 cand_src = v_orig // jnp.uint32(a)
             return (
                 disc, eb, states, cand_rows, cand_src, v_act,
@@ -1470,13 +1640,22 @@ class TpuChecker(Checker):
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def t_insert(key_hi, key_lo, hi, lo, cand_act):
-            (
-                table, _u_slot, u_new, u_origin, _u_active, probe_ok,
-                dd_overflow, rounds,
-            ) = insert_batch_compact(
-                HashSet(key_hi, key_lo), hi, lo, cand_act,
-                dedup_factor=1, with_rounds=True,
-            )
+            if sortless:
+                (
+                    table, _u_slot, u_new, u_origin, _u_active, probe_ok,
+                    dd_overflow, rounds,
+                ) = insert_batch_claim(
+                    HashSet(key_hi, key_lo), hi, lo, cand_act,
+                    with_rounds=True,
+                )
+            else:
+                (
+                    table, _u_slot, u_new, u_origin, _u_active, probe_ok,
+                    dd_overflow, rounds,
+                ) = insert_batch_compact(
+                    HashSet(key_hi, key_lo), hi, lo, cand_act,
+                    dedup_factor=1, with_rounds=True,
+                )
             n_new = jnp.sum(u_new, dtype=jnp.uint32)
             return (
                 table.key_hi, table.key_lo, u_new, u_origin, n_new,
@@ -1511,33 +1690,42 @@ class TpuChecker(Checker):
         proportional, not count-proportional: the device streams full
         fixed-width buffers regardless of how many lanes are live, so
         charging the full widths is what matches what HBM actually
-        moves.  The compact/canon/dedup widths are the LIVE sort rung
-        (``_sort_width``), not the worst-case U — ``bytes.dedup`` drops
-        in proportion to the rung, which is exactly the regression gauge
-        the ladder is judged by (bench.py's dedup phase)."""
+        moves.  The chunk/candidate widths are the LIVE step rung
+        (``_step_width``) — ``bytes.step`` drops in proportion to it,
+        the step ladder's regression gauge (bench.py's step phase) —
+        and the compact/canon/dedup widths the LIVE compact width.  On
+        the sortless default path ``bytes.dedup`` carries NO sort term
+        at all (the claim election probes, it never sorts): that is the
+        density-insensitive drop bench's dedup phase gauges."""
         from ..obs.roofline import copy_bytes, probe_bytes, sort_bytes
 
         cm = self._compiled
         w = cm.state_width
         fpw = cm.fp_words or w
         a = cm.max_actions
-        f = self._max_frontier
-        b = f * a
+        f_eff = self._step_width()
+        b = f_eff * a
         u_sz = self._sort_width()
         pad = self._block_pad()
         # step: chunk read + candidate construction + the valid-lane
         # index compaction scan.  Two-phase constructs only U rows (and
         # gathers their U parents); single-phase materializes all B.
-        step = f * w * 4 + b * 4 + copy_bytes(u_sz, w)
+        step = f_eff * w * 4 + b * 4 + copy_bytes(u_sz, w)
         if not two_phase:
             step += b * w * 4
         canon = (copy_bytes(u_sz, w) if self._canon is not None else 0)
         canon += u_sz * fpw * 4 + 2 * u_sz * 4
-        dedup = (
-            sort_bytes(u_sz, 3)
-            + probe_bytes(u_sz, probe_rounds)
-            + 4 * u_sz * 4  # representative compaction planes
-        )
+        if self._sortless:
+            # Claim election: probe rounds over the compact width plus
+            # the claim-plane scatter/readback — no sort planes, no
+            # representative re-compaction.
+            dedup = probe_bytes(u_sz, probe_rounds) + 2 * u_sz * 4
+        else:
+            dedup = (
+                sort_bytes(u_sz, 3)
+                + probe_bytes(u_sz, probe_rounds)
+                + 4 * u_sz * 4  # representative compaction planes
+            )
         append = copy_bytes(pad, w) + 2 * copy_bytes(pad, 1) + u_sz * 4
         return {
             "step": step, "canon": canon, "dedup": dedup, "append": append,
@@ -1560,6 +1748,7 @@ class TpuChecker(Checker):
         cm = self._compiled
         props = self._properties
         f = self._max_frontier
+        f_eff = self._step_width()  # the live step-geometry rung
         cap = self._capacity
         qcap = self._log_capacity
         pad = self._block_pad()
@@ -1624,7 +1813,7 @@ class TpuChecker(Checker):
                     # reference counts-but-never-expands target-depth
                     # states (same gate as the fused wave_cond).
                     break
-                count = min(level_end - level_start, f)
+                count = min(level_end - level_start, f_eff)
                 t0 = _time.perf_counter()
                 disc_prev = disc  # t_step does not donate it
                 (
@@ -1663,6 +1852,11 @@ class TpuChecker(Checker):
                     flags |= 4
                 if bool(np.asarray(stepflag_d)):
                     flags |= 8
+                if f_eff < f and level_end - level_start > f_eff:
+                    # Step-rung clamp (the fused wave_body's flag 128,
+                    # host-computed here): the remaining level exceeds
+                    # the chunk rung — abort, climb, re-run.
+                    flags |= 128
                 disc_h = np.asarray(disc)
                 if visitor is not None and flags == 0:
                     states_h = np.asarray(states)
@@ -1724,6 +1918,7 @@ class TpuChecker(Checker):
                     )
                     cap = self._capacity
                     f = self._max_frontier  # dd growth may halve it
+                    f_eff = self._step_width()  # rung climbs move it
                     progs = self._traced_programs()
                     vitals.record_overflow_recovery()
                     continue
@@ -1794,12 +1989,17 @@ class TpuChecker(Checker):
                 self._metrics.inc("device_call_sec_total", t5 - t0)
                 self._metrics.inc("device_calls", 1)
 
-                # Density-driven sort-rung downshift, per committed wave
-                # (the traced analogue of the fused loop's between-quanta
-                # retune); a rung change re-keys the phase programs.
-                from .wave_loop import maybe_retune_sort
+                # Density-driven sort-rung downshift and frontier-driven
+                # step-rung downshift, per committed wave (the traced
+                # analogue of the fused loop's between-quanta retunes);
+                # a rung change re-keys the phase programs.
+                from .wave_loop import maybe_retune_sort, maybe_retune_step
 
-                if maybe_retune_sort(self, vitals.last_density):
+                retuned = maybe_retune_sort(self, vitals.last_density)
+                if maybe_retune_step(self, remaining or None):
+                    retuned = True
+                if retuned:
+                    f_eff = self._step_width()
                     progs = self._traced_programs()
 
                 # Shared termination tail (wave_loop.py): the same
@@ -1939,26 +2139,43 @@ class TpuChecker(Checker):
             log_capacity=u + max(64, u // 64),
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
-            # The discovered sort rung — ONLY when one was actually
-            # pinned (ladder climb, density tuner, or explicit spawn):
-            # a warm spawn from an explicit rung disarms the tuner, so
-            # persisting the full worst-case width from a run too short
-            # to tune would freeze that workload at full-U forever
-            # (the sharded snapshot's none-sentinel rule).
+            # The discovered dedup path: a sortless→sort fallback is a
+            # per-workload selection the knob cache must remember, so a
+            # warm repeat skips the fallback retry entirely.
+            sortless=int(self._sortless),
+            # The discovered rungs — ONLY when one was actually pinned
+            # (ladder climb, tuner, or explicit spawn): a warm spawn
+            # from an explicit rung disarms the tuner, so persisting
+            # the full worst-case width from a run too short to tune
+            # would freeze that workload at full width forever (the
+            # sharded snapshot's none-sentinel rule).  A SORTLESS run
+            # never persists its sort rung: under the election the
+            # rung is the claim compaction buffer's tuner detail, and
+            # an explicit rung under sortless is the fallback-forcing
+            # budget cap (_grow's flag-4 dispatch) — a warm repeat
+            # must re-arm the tuner, not inherit a one-notch-tight
+            # explicit cap that flips it onto the sort path.
             **(
                 {"sort_lanes": self._sort_width()}
-                if self._sort_lanes is not None else {}
+                if self._sort_lanes is not None and not self._sortless
+                else {}
+            ),
+            **(
+                {"step_lanes": self._step_width()}
+                if self._step_lanes is not None else {}
             ),
         )
 
     def discovered_fingerprints(self):
-        """Sorted uint64 fingerprints of every discovered unique state
-        (fingerprints of the ORIGINAL logged rows), for cross-engine
-        discovery-set comparison — the sharded engine must reproduce
-        this set bit-identically on every mesh size
-        (tests/test_tpu_sharded.py).  Pulls the committed row-log prefix
-        to the host; size it like a path reconstruction, not a hot
-        call."""
+        """Sorted uint64 IDENTITY fingerprints of every discovered
+        unique state (the dedup-key fingerprints: original rows, or
+        canonical rows under symmetry — wave_loop.fingerprints_of_rows
+        documents why), for cross-engine discovery-set comparison — the
+        sharded engine must reproduce this set bit-identically on every
+        mesh size (tests/test_tpu_sharded.py), and the sortless and
+        sort dedup paths on every geometry (tests/test_sortless.py).
+        Pulls the committed row-log prefix to the host; size it like a
+        path reconstruction, not a hot call."""
         self.join()
         if self._carry_dev is None:
             raise RuntimeError("no run state to fingerprint")
@@ -1969,7 +2186,7 @@ class TpuChecker(Checker):
         rows = np.asarray(self._carry_dev["rows"])[: tail * w].reshape(
             tail, w
         )
-        return fingerprints_of_rows(self._compiled, rows)
+        return fingerprints_of_rows(self._compiled, rows, self._canon)
 
     # --- Checker surface -----------------------------------------------------
 
@@ -1998,11 +2215,14 @@ class TpuChecker(Checker):
             log_capacity=self._log_capacity,
             max_frontier=self._max_frontier,
             dedup_factor=self._dedup_factor,
+            sortless=self._sortless,
             sort_lanes=self._sort_width(),
-            # The PINNED rung (0 = running at the full buffer with the
+            # The PINNED rungs (0 = running at the full buffer with the
             # tuner armed) — what warm-start stores persist, vs the
-            # live width above (what the programs actually compiled).
+            # live widths (what the programs actually compiled).
             sort_lanes_rung=self._sort_lanes or 0,
+            step_lanes=self._step_width(),
+            step_lanes_rung=self._step_lanes or 0,
         )
         snap = self._metrics.snapshot()
         # Table load factor: mid-run it is the loop's already-synced

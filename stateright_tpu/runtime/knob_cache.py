@@ -38,19 +38,22 @@ KNOBS_FILE = "knobs.json"
 # changed when the exchange went bucketed — its entries now carry the
 # discovered ``bucket_slack`` rung (parallel/wave_loop.py), so a warm
 # start skips the bucket overflow-retry ramp as well as auto-tune;
-# pre-bucketing entries have no rung and must not shadow that.  All
-# three tags bumped again for the adaptive sort-geometry ladder: v2
-# entries carry the discovered ``sort_lanes`` rung, and a pre-ladder
-# entry without it would warm-start at the full worst-case sort buffer
-# — not wrong, but it forfeits exactly the 2× the ladder exists for.
-SINGLE_CHIP_ENGINE = "tpu-wavefront-v2"
-SHARDED_ENGINE = "tpu-sharded-bucketed-v2"
+# pre-bucketing entries have no rung and must not shadow that.  Bumped
+# to v2 for the adaptive sort-geometry ladder (entries carry the
+# discovered ``sort_lanes`` rung), and to v3 for the sortless default +
+# step ladder: v3 entries carry the discovered dedup path
+# (``sortless`` 0/1 — a fallen-back workload must warm-start on the
+# sort path without re-paying the fallback retry) and the ``step_lanes``
+# rung; a v2 entry with an explicit ``sort_lanes`` would silently force
+# every warm repeat onto the sort path and forfeit the election.
+SINGLE_CHIP_ENGINE = "tpu-wavefront-v3"
+SHARDED_ENGINE = "tpu-sharded-bucketed-v3"
 # Tiered entries persist the budget-derived capacity (tiered/engine.py
 # pins it — the in-HBM right-sizing rule would silently un-tier a
 # warm-started repeat), so they must never shadow single-chip entries;
 # the serve scheduler additionally keys their LABEL by the job's
 # memory_budget_mb so entries never shadow each other across budgets.
-TIERED_ENGINE = "tpu-tiered-v2"
+TIERED_ENGINE = "tpu-tiered-v3"
 
 # Serializes read-merge-write cycles within this process (two service
 # jobs storing knobs for different workloads must both survive).

@@ -599,13 +599,25 @@ class Scheduler:
                       "chunk_size", "dedup_factor", "bucket_slack")
             if k in m
         }
-        # The sort rung persists ONLY when the run actually pinned one
-        # (sort_lanes_rung; 0 = full buffer, tuner armed): storing the
-        # live full width from a too-short-to-tune run would spawn
-        # every warm repeat with an explicit rung and disarm its tuner.
+        # The rungs persist ONLY when the run actually pinned one
+        # (sort_lanes_rung/step_lanes_rung; 0 = full buffer, tuner
+        # armed): storing the live full width from a too-short-to-tune
+        # run would spawn every warm repeat with an explicit rung and
+        # disarm its tuner.  The dedup PATH persists always — a
+        # sortless→sort fallback is a per-workload selection a warm
+        # repeat must not re-discover with another aborted wave.
+        # ...and the sort rung NEVER persists off a sortless run: there
+        # it is the claim compaction buffer's tuner detail, and an
+        # explicit sort_lanes under sortless is the fallback-forcing
+        # budget cap — a warm repeat must re-arm the tuner instead.
         rung = int(m.get("sort_lanes_rung", 0) or 0)
-        if rung:
+        if rung and not m.get("sortless"):
             out["sort_lanes"] = rung
+        step_rung = int(m.get("step_lanes_rung", 0) or 0)
+        if step_rung:
+            out["step_lanes"] = step_rung
+        if "sortless" in m:
+            out["sortless"] = int(bool(m["sortless"]))
         return out
 
     def _poll_to_completion(self, job: Job, checker) -> None:

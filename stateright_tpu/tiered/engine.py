@@ -201,11 +201,10 @@ class TieredTpuChecker(TpuChecker):
 
         from ..parallel.wave_common import cached_program
 
-        # The query buffers span the live sort rung (the insert's
-        # compact width), not the worst-case U.
+        # The query buffers span the live insert compact width.
         u_sz = self._sort_width()
         chunk = self._cold_chunk
-        key = ("tiered-cold", u_sz, chunk)
+        key = ("tiered-cold-v2", u_sz, chunk)
 
         def build():
             sent = jnp.uint32(0xFFFFFFFF)
@@ -214,12 +213,24 @@ class TieredTpuChecker(TpuChecker):
             def query(hi, lo, u_new, u_origin):
                 q_hi = jnp.where(u_new, hi[u_origin], sent)
                 q_lo = jnp.where(u_new, lo[u_origin], sent)
-                u = u_new.shape[0]
-                # Keys arrive in sorted order (prededup), so the first/
-                # last new lanes carry the min/max new key.
-                i0 = jnp.argmax(u_new)
-                i1 = u - 1 - jnp.argmax(u_new[::-1])
-                return q_hi, q_lo, q_hi[i0], q_lo[i0], q_hi[i1], q_lo[i1]
+                # Lexicographic min/max of the new keys by MASKED
+                # two-stage reductions: the sortless claim election
+                # (hashset.insert_batch_claim, the default dedup path)
+                # returns winners in LANE order, so the sorted-buffer
+                # first/last-lane trick no longer applies; the
+                # reductions are order-independent and cover the sorted
+                # fallback path identically.
+                mn_hi = jnp.min(jnp.where(u_new, q_hi, sent))
+                mn_lo = jnp.min(
+                    jnp.where(u_new & (q_hi == mn_hi), q_lo, sent)
+                )
+                mx_hi = jnp.max(jnp.where(u_new, q_hi, jnp.uint32(0)))
+                mx_lo = jnp.max(
+                    jnp.where(
+                        u_new & (q_hi == mx_hi), q_lo, jnp.uint32(0)
+                    )
+                )
+                return q_hi, q_lo, mn_hi, mn_lo, mx_hi, mx_lo
 
             @partial(jax.jit, donate_argnums=(0,))
             def probe(found, q_hi, q_lo, c_hi, c_lo):
@@ -335,8 +346,8 @@ class TieredTpuChecker(TpuChecker):
             self._t_cold_last = None
             return carry
         progs = self._traced_programs()
-        f = self._max_frontier
-        count = min(self._t_level_end - self._t_level_start, f)
+        f_eff = self._step_width()  # the live step-geometry rung
+        count = min(self._t_level_end - self._t_level_start, f_eff)
         disc_prev = self._t_disc  # t_step does not donate it
         (
             disc, eb, _states, cand_rows, cand_src, cand_act,
@@ -361,6 +372,13 @@ class TieredTpuChecker(TpuChecker):
             flags |= 4
         if bool(np.asarray(stepflag_d)):
             flags |= 8
+        if (
+            f_eff < self._max_frontier
+            and self._t_level_end - self._t_level_start > f_eff
+        ):
+            # Step-rung clamp (flag 128, non-committing): climb one
+            # chunk rung and re-run — the base engine's contract.
+            flags |= 128
 
         cold = None
         fresh, n_fresh = u_new, n_new_hot
@@ -569,7 +587,7 @@ class TieredTpuChecker(TpuChecker):
         key_hi, key_lo, rows, parent, ebits = carry
         notes = []
         spill = False
-        for bit in (2, 4):
+        for bit in (2, 4, 128):
             if flags & bit:
                 g = self._grow(bit) if self._auto_tune else None
                 if g is None:

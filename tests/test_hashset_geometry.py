@@ -334,3 +334,135 @@ def test_load_factor_readback():
     )
     assert int(jnp.sum(is_new)) == 0
     assert t.load_factor() == pytest.approx(128 / 1024)
+
+
+# --- insert_batch_claim: the sortless claim-plane election -------------------
+
+
+def _claim_insert(vals, active=None, capacity=1 << 8):
+    from stateright_tpu.parallel.hashset import insert_batch_claim
+
+    hi, lo = _keys(vals)
+    n = len(vals)
+    act = (
+        jnp.ones((n,), jnp.bool_) if active is None
+        else jnp.asarray(np.asarray(active, bool))
+    )
+    return insert_batch_claim(make_hashset(capacity), hi, lo, act)
+
+
+def test_claim_election_all_duplicates_batch():
+    # Every lane the same key: exactly one winner, and it is lane 0 —
+    # the lowest lane of the (single) equal-key run.
+    t, slot, new, origin, act, ok, ovf = _claim_insert([7] * 64)
+    new = np.asarray(new)
+    assert bool(ok) and not bool(ovf)
+    assert new.sum() == 1 and new[0]
+    # origin is the identity map (the sorted path's indexing contract).
+    assert np.array_equal(np.asarray(origin), np.arange(64))
+
+
+def test_claim_election_zero_valid_wave():
+    t, slot, new, origin, act, ok, ovf = _claim_insert(
+        [1, 2, 3, 4], active=[False] * 4
+    )
+    assert bool(ok)
+    assert int(np.asarray(new).sum()) == 0
+    assert t.load_factor() == 0.0
+
+
+def test_claim_election_capacity_full_table():
+    # More distinct keys than table slots: probing exhausts and the
+    # call reports failure (probe_ok False) — the engines' flag-1
+    # dispatch falls back to the sort path before growing the table.
+    from stateright_tpu.parallel.hashset import insert_batch_claim
+
+    vals = np.arange(1, 65, dtype=np.uint64)
+    hi, lo = _keys(vals)
+    t, _s, _n, _o, _a, ok, _ovf = insert_batch_claim(
+        make_hashset(32), hi, lo, jnp.ones((64,), jnp.bool_)
+    )
+    assert not bool(ok)
+
+
+def test_claim_election_colliding_fingerprint_lanes():
+    # A tiny table forces distinct keys to contend for the same probe
+    # slots (hash collisions): every distinct key must still land, the
+    # winner of each equal-key run must still be its lowest lane, and
+    # duplicates of different keys must never cross-resolve.
+    rng = np.random.default_rng(7)
+    vals = rng.integers(1, 40, size=200).astype(np.uint64)
+    t, slot, new, origin, act, ok, ovf = _claim_insert(
+        vals, capacity=1 << 7
+    )
+    new = np.asarray(new)
+    slot = np.asarray(slot)
+    assert bool(ok) and not bool(ovf)
+    first = {}
+    for i, v in enumerate(vals.tolist()):
+        first.setdefault(v, i)
+    assert {i for i in range(200) if new[i]} == set(first.values())
+    # Winner slots hold exactly the winner's key.
+    kh = np.asarray(t.key_hi)
+    kl = np.asarray(t.key_lo)
+    for i in range(200):
+        if new[i]:
+            key = (int(kh[slot[i]]) << 32) | int(kl[slot[i]])
+            assert key == int(vals[i])
+
+
+def test_claim_election_matches_prededup_representatives():
+    # Election-vs-prededup representative equality: the claim winners
+    # are exactly prededup's lowest-lane representatives, and the
+    # resulting tables hold the identical key set.
+    from stateright_tpu.parallel.hashset import (
+        insert_batch_claim, insert_batch_compact,
+    )
+
+    rng = np.random.default_rng(3)
+    vals = rng.integers(1, 500, size=1024).astype(np.uint64)
+    active = rng.random(1024) > 0.4
+    hi, lo = _keys(vals)
+    act = jnp.asarray(active)
+
+    tc, c_slot, c_new, c_origin, _ca, c_ok, c_ovf = insert_batch_claim(
+        make_hashset(1 << 11), hi, lo, act
+    )
+    ts, _s, u_new, u_origin, u_active, s_ok, s_ovf = insert_batch_compact(
+        make_hashset(1 << 11), hi, lo, act, dedup_factor=1
+    )
+    assert bool(c_ok) and bool(s_ok)
+    claim_reps = {int(i) for i in np.where(np.asarray(c_new))[0]}
+    sorted_reps = {
+        int(o) for o, n in zip(
+            np.asarray(u_origin).tolist(), np.asarray(u_new).tolist()
+        ) if n
+    }
+    assert claim_reps == sorted_reps
+    k_claim = set(
+        zip(np.asarray(tc.key_hi).tolist(), np.asarray(tc.key_lo).tolist())
+    )
+    k_sort = set(
+        zip(np.asarray(ts.key_hi).tolist(), np.asarray(ts.key_lo).tolist())
+    )
+    assert k_claim == k_sort
+
+
+def test_claim_election_straggler_tail_batch():
+    # Batches past the 16K straggler threshold route unresolved lanes
+    # through the tail buffer; representatives stay the lowest lanes.
+    from stateright_tpu.parallel.hashset import insert_batch_claim
+
+    rng = np.random.default_rng(11)
+    n = (1 << 14) + 256
+    vals = rng.integers(1, 3000, size=n).astype(np.uint64)
+    hi, lo = _keys(vals)
+    t, slot, new, _o, _a, ok, _ovf = insert_batch_claim(
+        make_hashset(1 << 13), hi, lo, jnp.ones((n,), jnp.bool_)
+    )
+    assert bool(ok)
+    new = np.asarray(new)
+    first = {}
+    for i, v in enumerate(vals.tolist()):
+        first.setdefault(v, i)
+    assert {i for i in range(n) if new[i]} == set(first.values())

@@ -536,6 +536,50 @@ def test_reuse_disabled_records_only(store_dir):
     assert info2["mode"] == IDENTICAL
 
 
+def test_classify_serves_from_index_without_reparsing_verdicts(store_dir):
+    """The entry index (index.json, ISSUE 14 / ROADMAP #5 remainder):
+    classification scales with the INDEX, not the store.  On an index
+    hit, classify() parses ZERO per-entry verdict.json records for the
+    family scan — only the exact-match lookup (identical hit) costs one
+    parse — pinned via the store's ``verdict_reads`` counter."""
+    _check(GridWalk(bound=4), store_dir, reuse=False)
+    _check(GridWalk(bound=5), store_dir, reuse=False)
+
+    # Fresh instance, warm index: a family (widening) classification
+    # walks the entries entirely from index.json.
+    store = VerificationStore(store_dir)
+    delta = store.classify(SpecFingerprint(
+        GridWalk(bound=6), engine_kwargs=dict(GRID_KW),
+    ))
+    assert delta.mode == CONSTANT_WIDENING
+    assert store.verdict_reads == 0, (
+        "family scan re-parsed per-entry verdict.json despite the index"
+    )
+    # The identical hit is the one documented per-entry parse (the
+    # content-addressed exact-match lookup).
+    delta = store.classify(SpecFingerprint(
+        GridWalk(bound=5), engine_kwargs=dict(GRID_KW),
+    ))
+    assert delta.mode == IDENTICAL
+    assert store.verdict_reads == 1
+
+    # A missing/foreign index rebuilds ONCE (one parse per entry), then
+    # serves from the rebuilt index again.
+    os.remove(os.path.join(store_dir, "index.json"))
+    store2 = VerificationStore(store_dir)
+    delta = store2.classify(SpecFingerprint(
+        GridWalk(bound=6), engine_kwargs=dict(GRID_KW),
+    ))
+    assert delta.mode == CONSTANT_WIDENING
+    assert store2.verdict_reads == 2  # the rebuild's one-scan, 2 entries
+    assert os.path.exists(os.path.join(store_dir, "index.json"))
+    store3 = VerificationStore(store_dir)
+    store3.classify(SpecFingerprint(
+        GridWalk(bound=6), engine_kwargs=dict(GRID_KW),
+    ))
+    assert store3.verdict_reads == 0
+
+
 # --- ColdStore lifecycle (satellite: disk-tier reuse) -------------------------
 
 

@@ -374,9 +374,12 @@ def test_auto_tune_dedup_growth_clamps_frontier():
     ck._log_capacity_explicit = False
     ck._dedup_factor = 4
     ck._max_frontier = 1 << 15
-    # Default sort-rung state (the full buffer): the flag-4 growth goes
-    # straight to the dd relax, not a rung climb.
+    # Default sort-rung state (the full buffer) on the SORT path: the
+    # flag-4 growth goes straight to the dd relax, not a rung climb
+    # (and not the sortless fallback, which fires first when armed).
+    ck._sortless = False
     ck._sort_lanes = None
+    ck._step_lanes = None
     ck._sort_peak_valid = 0.0
     ck._journal = None  # the relax tail re-journals geometry when set
     msg = ck._grow(4)
@@ -414,7 +417,9 @@ def test_grow_refuses_when_floor_frontier_still_over_budget():
     ck._log_capacity_explicit = False
     ck._dedup_factor = 4
     ck._max_frontier = 1 << 15
+    ck._sortless = False  # sort path: no fallback move left either
     ck._sort_lanes = None  # full-buffer rung: nothing left to climb
+    ck._step_lanes = None
     ck._sort_peak_valid = 0.0
     ck._journal = None
     assert ck._grow(4) is None

@@ -11,13 +11,24 @@ jax = pytest.importorskip("jax")
 from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
 from stateright_tpu.parallel.wave_loop import (  # noqa: E402
     BUCKET_SLACK_DEFAULT,
+    SORT_RUNG_HEADROOM,
     SORT_RUNG_MIN,
+    STEP_RUNG_HEADROOM,
+    STEP_RUNG_MIN,
     CheckpointCadence,
+    clamp_rung,
     clamp_sort_lanes,
+    clamp_step_lanes,
+    downshift_rung,
     downshift_sort_lanes,
+    downshift_step_lanes,
     exchange_bucket_lanes,
+    maybe_retune_sort,
+    maybe_retune_step,
     next_bucket_slack,
+    next_rung,
     next_sort_lanes,
+    next_step_lanes,
     relax_dedup_geometry,
 )
 
@@ -111,6 +122,140 @@ def test_downshift_sort_lanes_hysteresis_floor_and_cap():
     assert downshift_sort_lanes(u, u, SORT_RUNG_MIN, 0.0) == SORT_RUNG_MIN
     # ...and never above the full buffer (tiny-U geometries are inert).
     assert downshift_sort_lanes(512, 512, SORT_RUNG_MIN, 1000.0) is None
+
+
+# --- the shared rung-ladder helper (both ladders, one rule) ------------------
+
+
+def test_ladder_wrappers_delegate_to_the_shared_helper():
+    """The sort and step ladders are the ONE parameterized helper
+    applied at their (min, headroom) — wrapper drift would resurrect
+    the two-implementations bug class the helper exists to kill."""
+    for req in (1, 7, 255, 256, 257, 3000, 1 << 20):
+        assert clamp_sort_lanes(req) == clamp_rung(req, SORT_RUNG_MIN)
+        assert clamp_step_lanes(req) == clamp_rung(req, STEP_RUNG_MIN)
+    for cur in (256, 1024, 8192, 1 << 14):
+        for full in (512, 8192, 1 << 14):
+            assert next_sort_lanes(cur, full) == next_rung(
+                cur, full, SORT_RUNG_MIN
+            )
+            assert next_step_lanes(cur, full) == next_rung(
+                cur, full, STEP_RUNG_MIN
+            )
+            for floor in (SORT_RUNG_MIN, 2048):
+                for peak in (0.0, 100.0, 900.0, 5000.0):
+                    assert downshift_sort_lanes(
+                        cur, full, floor, peak
+                    ) == downshift_rung(
+                        cur, full, floor, peak,
+                        SORT_RUNG_MIN, SORT_RUNG_HEADROOM,
+                    )
+                    assert downshift_step_lanes(
+                        cur, full, floor, peak
+                    ) == downshift_rung(
+                        cur, full, floor, peak,
+                        STEP_RUNG_MIN, STEP_RUNG_HEADROOM,
+                    )
+
+
+def test_downshift_rung_parameterization():
+    """The helper honors each parameter independently: min floor,
+    headroom scaling, the overflow-proven floor, the full-buffer cap,
+    and the at-least-halving hysteresis."""
+    full = 1 << 14
+    # min_rung floors the move.
+    assert downshift_rung(full, full, 0, 0.0, 256, 4.0) == 256
+    assert downshift_rung(full, full, 0, 0.0, 1024, 4.0) == 1024
+    # Headroom scales the landing rung: peak 100 at 4x -> 512; at 16x
+    # -> 2048 (next pow2 above 1600).
+    assert downshift_rung(full, full, 0, 100.0, 256, 4.0) == 512
+    assert downshift_rung(full, full, 0, 100.0, 256, 16.0) == 2048
+    # The overflow-proven floor is never revisited.
+    assert downshift_rung(full, full, 4096, 100.0, 256, 4.0) == 4096
+    # Hysteresis: a move that would not at least halve is refused.
+    assert downshift_rung(1024, full, 0, 200.0, 256, 4.0) is None
+    # Capped at the full buffer (tiny-full geometries are inert).
+    assert downshift_rung(512, 512, 0, 1000.0, 256, 4.0) is None
+
+
+def test_downshift_step_lanes_hysteresis_floor_and_cap():
+    full = 1 << 13
+    # Live-frontier evidence is already in lanes (no density scaling):
+    # peak 100 at the step ladder's 4x headroom lands on 512.
+    assert downshift_step_lanes(full, full, STEP_RUNG_MIN, 100.0) == 512
+    # Hysteresis mirrors the sort ladder's.
+    assert downshift_step_lanes(1024, full, STEP_RUNG_MIN, 200.0) is None
+    # The overflow-proven floor (a flag-128 climb) is never revisited.
+    assert downshift_step_lanes(full, full, 2048, 10.0) == 2048
+    # Never below the ladder minimum.
+    assert downshift_step_lanes(full, full, 0, 0.0) == STEP_RUNG_MIN
+
+
+class _LadderEng:
+    """Minimal engine stub exposing both tuner attribute namespaces
+    (_SORT_NS/_STEP_NS) so the ONE _maybe_retune implementation is
+    exercised through both public wrappers."""
+
+    def __init__(self, full=1 << 14):
+        self._full = full
+        self.applied = []
+        # sort namespace
+        self._sort_tune = True
+        self._sort_quanta = 0
+        self._sort_peak_valid = 0.0
+        self._sort_rung_floor = 0
+        self._sort_cur = full
+        # step namespace
+        self._step_tune = True
+        self._step_quanta = 0
+        self._step_peak_frontier = 0.0
+        self._step_rung_floor = 0
+        self._step_cur = full
+
+    def _wl_full_sort_lanes(self):
+        return self._full
+
+    def _sort_width(self):
+        return self._sort_cur
+
+    def _wl_apply_sort_rung(self, rung):
+        self._sort_cur = rung
+        self.applied.append(("sort", rung))
+
+    def _wl_full_step_lanes(self):
+        return self._full
+
+    def _step_width(self):
+        return self._step_cur
+
+    def _wl_apply_step_rung(self, rung):
+        self._step_cur = rung
+        self.applied.append(("step", rung))
+
+
+def test_maybe_retune_is_shared_and_respects_min_quanta():
+    """Both tuners run the one shared implementation: evidence
+    accumulates per committed quantum, no move before the quanta
+    window, then ONE downshift sized by the ladder's own headroom —
+    density×full lanes for sort, raw frontier lanes for step."""
+    eng = _LadderEng()
+    # 7 quanta of evidence: no move yet (window is 8).
+    for _ in range(7):
+        assert not maybe_retune_sort(eng, 100.0 / (1 << 14))
+        assert not maybe_retune_step(eng, 100.0)
+    assert eng.applied == []
+    # The 8th quantum moves BOTH ladders to the same rung (peak 100
+    # lanes, 4x headroom -> 512): one rule, two namespaces.
+    assert maybe_retune_sort(eng, 100.0 / (1 << 14))
+    assert maybe_retune_step(eng, 100.0)
+    assert eng.applied == [("sort", 512), ("step", 512)]
+    # An explicit rung disarms each tuner independently.
+    eng2 = _LadderEng()
+    eng2._sort_tune = False
+    for _ in range(10):
+        assert not maybe_retune_sort(eng2, 100.0 / (1 << 14))
+        maybe_retune_step(eng2, 100.0)
+    assert all(kind == "step" for kind, _ in eng2.applied)
 
 
 # --- shared growth rule ------------------------------------------------------
