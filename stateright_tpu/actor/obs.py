@@ -1,0 +1,349 @@
+"""Actor-runtime observability: per-link metrics, causal message
+tracing, and the live ``/.metrics`` surface for spawned systems.
+
+The device engines report always-on vitals, histograms, and a Prometheus
+exposition (docs/OBSERVABILITY.md); this module gives the *actor* half
+of the capability surface — the runtime that executes model-checked
+actors over real UDP (``actor/spawn.py``) — the same three pieces:
+
+- :class:`ObservedTransport` — wraps any ``Transport`` with per-link
+  datagram/byte counters (``link_*`` flat dicts, rendered as labeled
+  Prometheus gauge families) and, with ``trace=True``, the causal
+  **trace envelope**: every outgoing datagram is wrapped with a
+  ``(trace_id, hop, sent_at)`` header OUTSIDE the message codec, so
+  ``wire.py`` encoding, ORL semantics, and every model-pinned golden
+  stay bit-identical.  A handler's sends inherit the trace id of the
+  message being handled with ``hop + 1`` (the runtime is
+  one-thread-per-actor, so a thread-local carries the context), giving
+  a request a causal chain followable across actors through the
+  journal's ``actor_span`` events.  With ``trace=False`` the send path
+  adds nothing to the datagram — zero wire overhead when disabled.
+- envelope codec (:func:`wrap_datagram` / :func:`unwrap_datagram`) —
+  a fixed binary header (magic + version, 64-bit trace id, hop byte,
+  wall-clock send time, payload length).  Un-enveloped (legacy)
+  datagrams pass through untouched; a datagram that *starts* with the
+  magic but carries a torn or inconsistent header raises ``ValueError``
+  (the malformed-datagram contract ``wire.py`` already guarantees,
+  fuzzed in tests/test_wire_fuzz.py) and the transport drops it.
+- :func:`serve_actor_metrics` — the ``spawn --metrics-port`` surface:
+  ``GET /.metrics`` on the runtime, JSON by default and the Prometheus
+  text exposition under ``?format=prometheus`` / an Accept header
+  preferring it, exactly like the Explorer and the checking service.
+
+Metric names are part of the documented surface
+(docs/OBSERVABILITY.md "Actor-runtime observability").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.metrics import LATENCY_BUCKETS, MetricsRegistry
+from .ids import Id
+from .transport import Endpoint, Transport
+
+__all__ = [
+    "ENVELOPE_OVERHEAD",
+    "MAGIC",
+    "ObservedEndpoint",
+    "ObservedTransport",
+    "TraceContext",
+    "clear_trace_context",
+    "find_in_stack",
+    "find_observed",
+    "serve_actor_metrics",
+    "unwrap_datagram",
+    "wrap_datagram",
+]
+
+# Envelope magic: 0xAB is not valid UTF-8 lead byte for JSON text, so no
+# wire.py datagram (nor any hand-typed `nc -u` probe) can collide with
+# an enveloped one; "SR1" carries the format version.
+MAGIC = b"\xabSR1"
+# trace_id (u64) | hop (u8) | sent_at (f64 wall seconds) | payload len (u32)
+_HEADER = struct.Struct(">QBdI")
+ENVELOPE_OVERHEAD = len(MAGIC) + _HEADER.size
+_MAX_HOP = 255
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The decoded trace header of one received datagram."""
+
+    trace_id: int
+    hop: int
+    sent_at: float
+
+
+def wrap_datagram(
+    payload: bytes, trace_id: int, hop: int, sent_at: float
+) -> bytes:
+    """Envelope ``payload`` with a trace header (see module docstring)."""
+    return MAGIC + _HEADER.pack(
+        trace_id & 0xFFFFFFFFFFFFFFFF,
+        min(max(int(hop), 0), _MAX_HOP),
+        float(sent_at),
+        len(payload),
+    ) + payload
+
+
+def unwrap_datagram(data: bytes) -> Tuple[bytes, Optional[TraceContext]]:
+    """``(payload, TraceContext)`` for an enveloped datagram,
+    ``(data, None)`` for a legacy (un-enveloped) one.  A datagram that
+    starts with the envelope magic but has a truncated header or a
+    payload length that disagrees with the actual size raises
+    ``ValueError`` — the same malformed-datagram contract as
+    ``wire.wire_deserialize``, so the receive path treats it as "drop
+    it", never as a thread-killing surprise."""
+    if not data.startswith(MAGIC):
+        return data, None
+    if len(data) < ENVELOPE_OVERHEAD:
+        raise ValueError("malformed trace envelope: truncated header")
+    trace_id, hop, sent_at, length = _HEADER.unpack(
+        data[len(MAGIC):ENVELOPE_OVERHEAD]
+    )
+    payload = data[ENVELOPE_OVERHEAD:]
+    if len(payload) != length:
+        raise ValueError(
+            f"malformed trace envelope: payload length {len(payload)} != "
+            f"declared {length}"
+        )
+    if sent_at != sent_at or sent_at in (float("inf"), float("-inf")):
+        raise ValueError("malformed trace envelope: non-finite send time")
+    return payload, TraceContext(trace_id, hop, sent_at)
+
+
+def _new_trace_id() -> int:
+    return int.from_bytes(os.urandom(8), "big") or 1
+
+
+# --- the observing transport --------------------------------------------------
+
+
+class ObservedEndpoint(Endpoint):
+    def __init__(self, transport: "ObservedTransport", inner: Endpoint, id: Id):
+        self._transport = transport
+        self._inner = inner
+        self.id = Id(id)
+
+    def send(self, dst: Id, data: bytes) -> None:
+        t = self._transport
+        if t.trace:
+            ctx = getattr(t._tls, "ctx", None)
+            if ctx is not None:
+                trace_id, hop = ctx.trace_id, min(ctx.hop + 1, _MAX_HOP)
+            else:
+                trace_id, hop = _new_trace_id(), 0
+            data = wrap_datagram(data, trace_id, hop, time.time())
+        t._count(int(self.id), int(dst), len(data), out=True)
+        self._inner.send(dst, data)
+
+    def recv(self, timeout: float):
+        received = self._inner.recv(timeout)
+        if received is None:
+            return None
+        data, src = received
+        t = self._transport
+        ctx = None
+        wire_bytes = len(data)  # counted pre-unwrap: bytes on the wire
+        if data.startswith(MAGIC):
+            try:
+                data, ctx = unwrap_datagram(data)
+            except ValueError:
+                t.registry.inc("trace_envelope_malformed_total")
+                return None  # dropped, like any malformed datagram
+        # The handler about to run on this thread inherits this context
+        # (None for a legacy datagram — a stale context must never leak
+        # into an unrelated message's sends).
+        t._tls.ctx = ctx
+        t._count(int(src), int(self.id), wire_bytes, out=False)
+        if ctx is not None:
+            t._record_span(int(src), int(self.id), ctx)
+        return data, src
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class ObservedTransport(Transport):
+    """Counts per-link traffic and (with ``trace=True``) envelopes every
+    datagram with the causal trace header.  Stack it at the actor-facing
+    boundary — e.g. ``Recording(Observed(Faulty(Loopback)))`` in the
+    chaos harness, so the auditor still decodes clean payloads while the
+    fault injector treats the envelope as opaque bytes."""
+
+    def __init__(
+        self,
+        inner: Transport,
+        registry: Optional[MetricsRegistry] = None,
+        trace: bool = False,
+        journal=None,
+    ):
+        from ..runtime.journal import as_journal
+
+        self.inner = inner
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.trace = bool(trace)
+        self.journal = as_journal(journal)
+        self._lock = threading.Lock()
+        # (src, dst) -> [datagrams_sent, bytes_sent, datagrams_recv,
+        # bytes_recv]; sender and receiver sides update different slots
+        # of the same directed-link row.
+        self._links: Dict[Tuple[int, int], List[int]] = {}
+        self._tls = threading.local()
+        self.max_hop = 0
+        self.span_count = 0
+
+    def bind(self, id: Id) -> ObservedEndpoint:
+        return ObservedEndpoint(self, self.inner.bind(id), id)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    # -- internals -------------------------------------------------------------
+
+    def _count(self, src: int, dst: int, nbytes: int, out: bool) -> None:
+        base = 0 if out else 2
+        with self._lock:
+            row = self._links.get((src, dst))
+            if row is None:
+                row = self._links[(src, dst)] = [0, 0, 0, 0]
+            row[base] += 1
+            row[base + 1] += nbytes
+        if out:
+            self.registry.inc("datagrams_sent_total")
+            self.registry.inc("bytes_sent_total", nbytes)
+        else:
+            self.registry.inc("datagrams_received_total")
+            self.registry.inc("bytes_received_total", nbytes)
+
+    def _record_span(self, src: int, dst: int, ctx: TraceContext) -> None:
+        latency = max(0.0, time.time() - ctx.sent_at)
+        self.registry.observe(
+            "actor_deliver_latency_sec", latency, boundaries=LATENCY_BUCKETS
+        )
+        with self._lock:
+            self.max_hop = max(self.max_hop, ctx.hop)
+            self.span_count += 1
+        if self.journal is not None:
+            self.journal.append(
+                "actor_span",
+                trace=format(ctx.trace_id, "016x"),
+                hop=ctx.hop,
+                src=src,
+                dst=dst,
+                latency_sec=round(latency, 6),
+            )
+
+    def link_metrics(self) -> Dict[str, Dict[str, int]]:
+        """The per-link counters as flat ``"src->dst" -> n`` dicts (the
+        shape obs/prometheus.py renders as labeled gauge families, like
+        the sharded engine's per-shard skew dicts)."""
+        with self._lock:
+            rows = dict(self._links)
+        out: Dict[str, Dict[str, int]] = {
+            "link_datagrams_sent": {},
+            "link_bytes_sent": {},
+            "link_datagrams_received": {},
+            "link_bytes_received": {},
+        }
+        for (src, dst), row in sorted(rows.items()):
+            key = f"{src}->{dst}"
+            if row[0]:
+                out["link_datagrams_sent"][key] = row[0]
+                out["link_bytes_sent"][key] = row[1]
+            if row[2]:
+                out["link_datagrams_received"][key] = row[2]
+                out["link_bytes_received"][key] = row[3]
+        return {k: v for k, v in out.items() if v}
+
+
+def find_in_stack(transport_or_endpoint, cls):
+    """Walk a transport/endpoint wrapper stack (``inner`` / ``_inner`` /
+    ``_transport`` links, cycle-safe) for the first ``cls`` instance."""
+    seen = set()
+    node = transport_or_endpoint
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        if isinstance(node, cls):
+            return node
+        node = (
+            getattr(node, "_transport", None)
+            or getattr(node, "inner", None)
+            or getattr(node, "_inner", None)
+        )
+    return None
+
+
+def find_observed(transport_or_endpoint) -> Optional[ObservedTransport]:
+    """The :class:`ObservedTransport` in a wrapper stack, if any."""
+    return find_in_stack(transport_or_endpoint, ObservedTransport)
+
+
+def clear_trace_context(endpoint) -> None:
+    """Drop the calling thread's inherited trace context.  The runtime
+    calls this before dispatching a timer/random interrupt: a send made
+    from ``on_timeout`` starts a NEW causal chain, not a continuation of
+    whatever message this thread happened to receive last."""
+    observed = find_observed(endpoint)
+    if observed is not None:
+        observed._tls.ctx = None
+
+
+# --- the live /.metrics surface ----------------------------------------------
+
+
+def serve_actor_metrics(runtime, address=("127.0.0.1", 0)):
+    """Serve ``GET /.metrics`` over ``runtime.metrics()`` — JSON by
+    default, the Prometheus text exposition via ``?format=prometheus``
+    or a scraper's Accept header (the ``spawn --metrics-port`` surface;
+    content negotiation shared with the Explorer and the checking
+    service).  Returns the started ``ThreadingHTTPServer`` (daemon
+    thread; ``server_address`` carries the bound port when 0 was
+    asked)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    from ..obs.prometheus import (
+        CONTENT_TYPE, render_prometheus, wants_prometheus,
+    )
+
+    class _Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet: stderr is the actors' own
+            pass
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            if parsed.path not in ("/.metrics", "/"):
+                self.send_error(404)
+                return
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                metrics = runtime.metrics()
+            except Exception as e:  # mid-teardown must not 500-loop a scraper
+                self.send_error(503, str(e))
+                return
+            if wants_prometheus(query, self.headers.get("Accept")):
+                body = render_prometheus(metrics).encode()
+                ctype = CONTENT_TYPE
+            else:
+                body = json.dumps(metrics, sort_keys=True).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer(tuple(address), _Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="actor-metrics"
+    )
+    thread.start()
+    return server

@@ -93,11 +93,18 @@ class ActorWrapper(Actor):
         max_resend_interval: float = 30.0,
         max_resends: Optional[int] = None,
         on_give_up: Optional[Callable[[Id, Tuple], None]] = None,
+        metrics=None,
     ):
         self.wrapped_actor = wrapped_actor
         self.resend_interval = tuple(resend_interval)
         self.backoff_factor = float(backoff_factor)
         self.max_resend_interval = float(max_resend_interval)
+        # Runtime-only observability: an optional ``MetricsRegistry``
+        # (obs/metrics.py) fed ack/retransmit/give-up counters.  Like
+        # ``max_resends`` this is a deployment knob — leave it ``None``
+        # on a wrapper that is model checked (the counters are harmless
+        # but meaningless across explored branches).
+        self.metrics = metrics
         # Runtime-only knobs.  ``max_resends`` must stay ``None`` for a
         # wrapper that is model checked: the give-up decision reads the
         # mutable attempt counter below, which is shared across explored
@@ -184,6 +191,8 @@ class ActorWrapper(Actor):
             )
             state = self._process_output(state, wrapped_out, o)
         elif isinstance(msg, Ack):
+            if self.metrics is not None:
+                self.metrics.inc("orl_acks_total")
             pending = tuple(
                 (seq, dm) for seq, dm in state.msgs_pending_ack if seq != msg.seq
             )
@@ -222,6 +231,10 @@ class ActorWrapper(Actor):
                 # Reference behavior: re-arm (with backoff) and resend
                 # everything pending, forever (reference:199-205).
                 self._resend_attempts += 1
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "orl_retransmits_total", len(state.msgs_pending_ack)
+                    )
                 o.set_timer(NETWORK_TIMER, self._next_resend_interval())
                 for seq, (dst, msg) in state.msgs_pending_ack:
                     o.send(dst, Deliver(seq, msg))
@@ -244,9 +257,14 @@ class ActorWrapper(Actor):
                     o.send(dst, Deliver(seq, msg))
             if not kept:
                 self._resend_attempts = 0
+            if self.metrics is not None and kept:
+                self.metrics.inc("orl_retransmits_total", len(kept))
             o.set_timer(NETWORK_TIMER, self._next_resend_interval())
             if not dropped:
                 return None
+            if self.metrics is not None:
+                self.metrics.inc("orl_give_ups_total")
+                self.metrics.inc("orl_msgs_dropped_total", len(dropped))
             if self.on_give_up is not None:
                 self.on_give_up(id, tuple(dropped))
             state = LinkState(

@@ -44,6 +44,7 @@ from .base import (
     SetTimerCmd,
 )
 from .ids import Id
+from .obs import clear_trace_context, find_in_stack, find_observed
 from .transport import (
     MAX_DATAGRAM,
     Endpoint,
@@ -81,16 +82,85 @@ def _addr_str(id: Id) -> str:
 
 
 class ActorRuntime:
-    """Handle for a set of spawned actor threads."""
+    """Handle for a set of spawned actor threads.
 
-    def __init__(self):
+    Every runtime carries a ``MetricsRegistry`` (``self.registry``): the
+    event loops record per-message handler durations, timer sets/fires,
+    and malformed-datagram drops into it, and ``metrics()`` snapshots it
+    in the guaranteed cross-engine schema so the actor runtime scrapes
+    exactly like a checker (docs/OBSERVABILITY.md "Actor-runtime
+    observability"; served live by ``actor/obs.serve_actor_metrics``).
+    """
+
+    def __init__(self, metrics=None):
+        from ..obs.metrics import MetricsRegistry
+
         self._threads: List[threading.Thread] = []
         self._endpoints: List[Endpoint] = []
         self._transport: Optional[Transport] = None
         self._stop = threading.Event()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        self.registry = metrics if metrics is not None else MetricsRegistry()
         self.errors: List[BaseException] = []
+
+    def metrics(self) -> dict:
+        """Live observability snapshot in the guaranteed cross-engine
+        schema (tests/test_metrics_schema.py), actor semantics:
+        ``state_count`` counts handled messages, ``unique_state_count``
+        the spawned actors, ``max_depth`` the deepest causal hop
+        observed by the trace envelope (0 untraced), and
+        ``table_load_factor`` is 0.0 (no device table).  Per-link
+        datagram/byte dicts and chaos fault counters are merged in from
+        the transport stack when present."""
+        from ..obs.metrics import GLOBAL
+
+        snap = self.registry.snapshot()
+        out: dict = {
+            "engine": type(self).__name__,
+            "done": self._stopped,
+            "actors": len(self._threads),
+            "state_count": int(snap.get("msgs_handled_total", 0)),
+            "unique_state_count": len(self._threads),
+            "max_depth": 0,
+            "table_load_factor": 0.0,
+            "program_cache_hits": int(GLOBAL.get("program_cache_hits", 0)),
+            "program_cache_misses": int(
+                GLOBAL.get("program_cache_misses", 0)
+            ),
+            "compile_sec_total": round(
+                float(GLOBAL.get("compile_sec_total", 0.0)), 4
+            ),
+            "recompile_storms": int(GLOBAL.get("recompile_storms", 0)),
+        }
+        out.update(snap)
+        out["histograms"] = self.registry.snapshot_histograms()
+        observed = find_observed(self._transport)
+        if observed is not None:
+            out["max_depth"] = int(observed.max_hop)
+            out["trace"] = observed.trace
+            out["actor_spans_total"] = int(observed.span_count)
+            out.update(observed.link_metrics())
+        faulty = self._find_faulty()
+        if faulty is not None:
+            summary = faulty.fault_summary()
+            out["chaos_faults_total"] = int(summary["total"])
+            if summary["by_kind"]:
+                out["chaos_faults"] = summary["by_kind"]
+            if summary["links"]:
+                # Flat per-link totals (a labeled Prometheus gauge
+                # family); the per-link-per-kind split stays JSON-only.
+                out["link_faults"] = {
+                    link: sum(kinds.values())
+                    for link, kinds in summary["links"].items()
+                }
+                out["chaos_link_faults"] = summary["links"]
+        return out
+
+    def _find_faulty(self):
+        from ..runtime.chaos import FaultyTransport
+
+        return find_in_stack(self._transport, FaultyTransport)
 
     def stop(self, timeout: float = 10.0, raise_errors: bool = True) -> None:
         """Stop all actor threads (closing their endpoints); idempotent.
@@ -141,6 +211,7 @@ def spawn(
     actors: List[Tuple[Id, Actor]],
     storage_dir: str = ".",
     transport: Optional[Transport] = None,
+    metrics=None,
 ) -> ActorRuntime:
     """Run ``actors`` on a datagram transport; returns a runtime handle.
 
@@ -149,10 +220,15 @@ def spawn(
     already-taken address raises here instead of landing in
     ``runtime.errors`` asynchronously.
 
+    ``metrics`` optionally supplies the runtime's ``MetricsRegistry`` —
+    pass the same registry to an ``ObservedTransport`` wrapper and to
+    ORL ``ActorWrapper``s so link, handler, and retransmit counters land
+    in one ``runtime.metrics()`` snapshot.
+
     Reference: ``spawn``, src/actor/spawn.rs:70-168 (which blocks; call
     ``.join()`` on the returned handle for that behavior).
     """
-    runtime = ActorRuntime()
+    runtime = ActorRuntime(metrics=metrics)
     runtime._transport = transport = (
         transport if transport is not None else UdpTransport()
     )
@@ -205,6 +281,7 @@ def _actor_main(
     storage_dir: str,
 ) -> None:
     try:
+        registry = runtime.registry
         storage_path = os.path.join(storage_dir, f"{_addr_str(id)}.storage")
         storage: Optional[Any] = None
         try:
@@ -225,6 +302,7 @@ def _actor_main(
                     return  # unserializable: ignore, like the reference
                 endpoint.send(Id(cmd.dst), data)
             elif isinstance(cmd, SetTimerCmd):
+                registry.inc("timer_sets_total")
                 lo, hi = cmd.duration
                 duration = _random.uniform(lo, hi) if lo < hi else lo
                 next_interrupts[("timeout", cmd.timer)] = (
@@ -270,15 +348,30 @@ def _actor_main(
                 try:
                     msg = msg_deserialize(data)
                 except (ValueError, KeyError):
+                    registry.inc("malformed_datagrams_total")
                     continue  # unparseable: ignore, like the reference
+                handler_start = time.monotonic()
                 next_state = actor.on_msg(id, state, src, msg, out)
+                registry.observe(
+                    "actor_handler_sec", time.monotonic() - handler_start
+                )
+                registry.inc("msgs_handled_total")
             else:
                 del next_interrupts[min_key]
                 kind, payload = min_key
+                # A send from an interrupt handler starts a new causal
+                # chain — never a continuation of whatever message this
+                # thread received last (actor/obs.py).
+                clear_trace_context(endpoint)
+                handler_start = time.monotonic()
                 if kind == "timeout":
+                    registry.inc("timer_fires_total")
                     next_state = actor.on_timeout(id, state, payload, out)
                 else:
                     next_state = actor.on_random(id, state, payload, out)
+                registry.observe(
+                    "actor_handler_sec", time.monotonic() - handler_start
+                )
             if next_state is not None:
                 state = next_state
             for c in out:

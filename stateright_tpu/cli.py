@@ -65,7 +65,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
     if spec.spawn is not None:
         lines.append(
             "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
-            " [--journal PATH] [--duration SEC]"
+            " [--journal PATH] [--duration SEC] [--metrics-port PORT]"
+            " [--trace]"
         )
     if spec.default_network:
         lines.append(f"NETWORK: one of {' | '.join(Network.names())}")
@@ -284,11 +285,15 @@ def _extract_runtime_flags(args):
     )
 
 
-def _parse_chaos_flags(args):
-    """Parse the ``spawn`` subcommand's chaos flags.  Returns
-    ``(leftover_args, ChaosOptions | None)``; raises ``ValueError`` on a
-    malformed flag or chaos spec.  ``--chaos @FILE`` reads the spec JSON
-    from a file."""
+def _parse_chaos_flags(args, trace: bool = False):
+    """Parse the ``spawn`` subcommand's chaos/observability flags.
+    Returns ``(leftover_args, ChaosOptions | None)``; raises
+    ``ValueError`` on a malformed flag or chaos spec.  ``--chaos @FILE``
+    reads the spec JSON from a file.  ``trace`` arrives pre-parsed (the
+    shared runtime-flag parser consumed ``--trace``); it alone — like
+    ``--metrics-port`` — is enough to build options around an empty
+    (fault-free) chaos spec, so a spawned system can be traced or
+    scraped without injecting any faults."""
     from .runtime.chaos import ChaosSpec
 
     spec_json = None
@@ -296,7 +301,8 @@ def _parse_chaos_flags(args):
     audit = False
     journal = None
     duration = 10.0
-    seen_any = False
+    metrics_port = None
+    seen_any = bool(trace)
     out = []
     i = 0
 
@@ -322,6 +328,17 @@ def _parse_chaos_flags(args):
             audit, seen_any = True, True
         elif a == "--journal":
             journal, seen_any = value_of(a), True
+        elif a == "--metrics-port" or a.startswith("--metrics-port="):
+            v = a.split("=", 1)[1] if "=" in a else value_of(a)
+            try:
+                metrics_port = int(v)
+            except ValueError:
+                raise ValueError(
+                    "--metrics-port requires a port number (0 = ephemeral)"
+                ) from None
+            if metrics_port < 0 or metrics_port > 65535:
+                raise ValueError("--metrics-port must be in [0, 65535]")
+            seen_any = True
         elif a == "--duration":
             v = value_of(a)
             try:
@@ -350,20 +367,28 @@ def _parse_chaos_flags(args):
         audit=audit,
         journal=journal,
         duration=duration,
+        metrics_port=metrics_port,
+        trace=trace,
     )
     return out, chaos
 
 
 class ChaosOptions:
     """Parsed ``spawn --chaos`` flags, handed to a chaos-capable spawn
-    target (one whose callable accepts a ``chaos`` keyword)."""
+    target (one whose callable accepts a ``chaos`` keyword).
+    ``metrics_port`` serves the runtime's live ``/.metrics`` and
+    ``trace`` turns on the causal trace envelope (docs/OBSERVABILITY.md
+    "Actor-runtime observability")."""
 
-    def __init__(self, spec, seed, audit, journal, duration):
+    def __init__(self, spec, seed, audit, journal, duration,
+                 metrics_port=None, trace=False):
         self.spec = spec
         self.seed = seed
         self.audit = audit
         self.journal = journal
         self.duration = duration
+        self.metrics_port = metrics_port
+        self.trace = trace
 
 
 def _parse_network(args, spec):
@@ -789,11 +814,11 @@ def example_main(spec: CliSpec, argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if trace and sub != "check-tpu":
+    if trace and sub not in ("check-tpu", "spawn"):
         print(
             "--trace requires the check-tpu subcommand (phase-timed "
-            "tracing instruments the device wave loop; "
-            "docs/OBSERVABILITY.md)",
+            "device wave tracing) or spawn (the actor runtime's causal "
+            "trace envelope); docs/OBSERVABILITY.md",
             file=sys.stderr,
         )
         return 2
@@ -1038,7 +1063,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
             print(f"{spec.name} has no spawn target", file=sys.stderr)
             return 2
         try:
-            args, chaos = _parse_chaos_flags(args)
+            args, chaos = _parse_chaos_flags(args, trace=trace)
         except ValueError as e:
             print(e, file=sys.stderr)
             return 2
@@ -1101,7 +1126,8 @@ def example_main(spec: CliSpec, argv=None) -> int:
 
 
 def spawn_register_system(
-    make_actors, count: int, name: str, make_transport=None
+    make_actors, count: int, name: str, make_transport=None,
+    metrics_port=None, trace: bool = False, journal=None,
 ) -> None:
     """Run register-protocol servers over real localhost UDP, mirroring the
     reference examples' ``spawn`` subcommands (examples/paxos.rs:488-512):
@@ -1112,15 +1138,25 @@ def spawn_register_system(
     ``runtime.chaos.FaultyTransport`` wrapping UDP (with the chaos spec's
     model indices remapped onto the real ids), which is how
     ``spawn --chaos`` (without ``--audit``) injects faults into a system
-    being poked externally with ``nc -u``."""
+    being poked externally with ``nc -u``.
+
+    Observability (docs/OBSERVABILITY.md "Actor-runtime observability"):
+    the transport is wrapped in an ``ObservedTransport`` — per-link
+    datagram/byte counters always, the causal trace envelope under
+    ``trace=True`` (``actor_span`` events into ``journal``) — and
+    ``metrics_port`` serves the runtime's live ``GET /.metrics`` (JSON +
+    Prometheus; 0 picks an ephemeral port, printed at startup)."""
     from .actor.ids import Id
+    from .actor.obs import ObservedTransport, serve_actor_metrics
     from .actor.spawn import spawn
+    from .actor.transport import UdpTransport
     from .actor.wire import wire_deserialize, wire_serialize
 
     ids = [
         Id.from_socket_addr((127, 0, 0, 1), 3000 + i) for i in range(count)
     ]
-    transport = make_transport(ids) if make_transport is not None else None
+    base = make_transport(ids) if make_transport is not None else UdpTransport()
+    transport = ObservedTransport(base, trace=trace, journal=journal)
     server_actors = make_actors(ids)
     print(f"A set of {name} servers is now running on:")
     for i in ids:
@@ -1135,8 +1171,20 @@ def spawn_register_system(
         wire_deserialize,
         list(zip(ids, server_actors)),
         transport=transport,
+        metrics=transport.registry,
     )
+    metrics_server = None
+    if metrics_port is not None:
+        metrics_server = serve_actor_metrics(
+            runtime, ("127.0.0.1", int(metrics_port))
+        )
+        host, port = metrics_server.server_address[:2]
+        print(f"Metrics: http://{host}:{port}/.metrics "
+              "(?format=prometheus for the text exposition)")
     try:
         runtime.join()
     except KeyboardInterrupt:
         runtime.stop(raise_errors=False)
+    finally:
+        if metrics_server is not None:
+            metrics_server.shutdown()

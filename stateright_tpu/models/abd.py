@@ -260,7 +260,12 @@ def run_chaos_audit(chaos, fault=None, client_count=2, put_count=2) -> dict:
     """A hermetic ABD cluster under chaos with live linearizability
     auditing (the `spawn --chaos ... --audit` flow; see docs/ACTORS.md).
     ``fault`` forwards to :class:`AbdActor` — ``"skip_ack"`` builds the
-    deliberately-broken replica the audit must reject."""
+    deliberately-broken replica the audit must reject.  The chaos
+    options' observability knobs ride along (``getattr``, so bare
+    option objects from older callers keep working): ``trace`` turns on
+    the causal trace envelope, ``metrics_port`` serves and self-scrapes
+    the live ``/.metrics`` surface (docs/OBSERVABILITY.md
+    "Actor-runtime observability")."""
     from ..actor.register import RegisterServer
     from ..runtime.chaos import run_chaos_register_system
     from ..semantics import LinearizabilityTester, Register
@@ -276,6 +281,8 @@ def run_chaos_audit(chaos, fault=None, client_count=2, put_count=2) -> dict:
         wire_types=(Internal, Query, AckQuery, Record, AckRecord),
         journal=chaos.journal,
         deadline_sec=chaos.duration,
+        trace=bool(getattr(chaos, "trace", False)),
+        metrics_port=getattr(chaos, "metrics_port", None),
     )
 
 
@@ -336,6 +343,12 @@ def cli_spec():
             3,
             "ABD replicas",
             make_transport=make_transport,
+            metrics_port=(
+                getattr(chaos, "metrics_port", None)
+                if chaos is not None else None
+            ),
+            trace=bool(getattr(chaos, "trace", False)) if chaos else False,
+            journal=chaos.journal if chaos is not None else None,
         )
 
     return CliSpec(

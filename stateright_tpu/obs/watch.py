@@ -114,6 +114,52 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
                 "device" if device >= max(0.0, wall - device) else "host"
             )
 
+    # Actor/chaos journals (runtime/chaos.py, actor/obs.py): the
+    # periodic ``actor_stats`` stream gives a msgs/s EMA + retransmit
+    # counters; injected ``chaos_*`` faults, ``orl_give_up``, an active
+    # partition window, and a rejected audit raise ⚠ badges.
+    stats = [e for e in events if e.get("event") == "actor_stats"]
+    if stats:
+        last = stats[-1]
+        for k in ("datagrams", "invoked", "returned", "retransmits"):
+            if k in last:
+                out[k] = last[k]
+        pts = [
+            (e["t"], e["datagrams"]) for e in stats[-_EMA_TAIL:]
+            if isinstance(e.get("t"), (int, float))
+            and isinstance(e.get("datagrams"), int)
+        ]
+        ema = None
+        for (t0, d0), (t1, d1) in zip(pts, pts[1:]):
+            if t1 > t0:
+                rate = max(0, d1 - d0) / (t1 - t0)
+                ema = rate if ema is None else ema + EMA_ALPHA * (rate - ema)
+        if ema is not None:
+            out["msgs_per_sec"] = round(ema, 1)
+        if last.get("partition_active"):
+            out["partition_active"] = True
+            out["warnings"].append("partition-active")
+    spans = sum(1 for e in events if e.get("event") == "actor_span")
+    if spans:
+        out["spans"] = spans
+    chaos_faults = sum(
+        1 for e in events
+        if str(e.get("event", "")).startswith("chaos_")
+        and e.get("event") not in ("chaos_start", "chaos_summary")
+    )
+    if chaos_faults:
+        out["chaos_faults"] = chaos_faults
+    give_ups = sum(1 for e in events if e.get("event") == "orl_give_up")
+    if give_ups:
+        out["orl_give_ups"] = give_ups
+        out["warnings"].append(f"orl-give-ups={give_ups}")
+    audits = [e for e in events if e.get("event") == "audit"]
+    if audits:
+        out["audit_consistent"] = bool(audits[-1].get("consistent"))
+        out["done"] = True  # the audit verdict is a chaos run's last word
+        if not out["audit_consistent"]:
+            out["warnings"].append("audit-inconsistent")
+
     # Service journals: job counts by their latest lifecycle event.
     job_state: dict = {}
     for e in events:
@@ -276,6 +322,23 @@ def render_line(s: dict) -> str:
             parts.append(f"waves={s['waves']}")
         if s.get("grows"):
             parts.append(f"grows={s['grows']}")
+    if "datagrams" in s:
+        # Actor/chaos journal: the greppable actor fields
+        # (docs/OBSERVABILITY.md "Actor-runtime observability").
+        parts.append(f"msgs/s={_fmt(s.get('msgs_per_sec'))}")
+        parts.append(f"datagrams={_fmt(s.get('datagrams'))}")
+        parts.append(
+            f"ops={_fmt(s.get('returned'))}/{_fmt(s.get('invoked'))}"
+        )
+        parts.append(f"retransmits={_fmt(s.get('retransmits'))}")
+    if "chaos_faults" in s:
+        parts.append(f"faults={s['chaos_faults']}")
+    if s.get("spans"):
+        parts.append(f"spans={s['spans']}")
+    if "audit_consistent" in s:
+        parts.append(
+            "audit=ok" if s["audit_consistent"] else "audit=INCONSISTENT"
+        )
     if "recheck" in s:
         parts.append(f"recheck={s['recheck']}")
     if s.get("verdict_hits"):

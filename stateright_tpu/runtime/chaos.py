@@ -298,10 +298,17 @@ class FaultyTransport(Transport):
         self.seed = int(seed)
         self.journal: Optional[Journal] = as_journal(journal)
         self.fault_counts: Dict[str, int] = {}
+        # Per-directed-link, per-kind injection counters — the fault
+        # attribution table (docs/OBSERVABILITY.md "Actor-runtime
+        # observability"): these aggregate exactly the journaled
+        # ``chaos_*`` events, so a report rebuilt from the journal and a
+        # live ``/.metrics`` scrape must agree to the count.
+        self.link_fault_counts: Dict[Tuple[int, int], Dict[str, int]] = {}
         self._links: Dict[Tuple[int, int], _LinkState] = {}
         self._lock = threading.Lock()
         self._timers: set = set()
         self._closed = False
+        self._summarized = False
         self._start = time.monotonic()
         if self.journal is not None:
             self.journal.append(
@@ -311,12 +318,36 @@ class FaultyTransport(Transport):
     def bind(self, id: Id) -> FaultyEndpoint:
         return FaultyEndpoint(self, self.inner.bind(id), id)
 
+    def fault_summary(self) -> dict:
+        """Injected-fault aggregate: total, per-kind counts, and the
+        per-link ``"src->dst" -> {kind: n}`` attribution table."""
+        with self._lock:
+            by_kind = dict(sorted(self.fault_counts.items()))
+            links = {
+                f"{src}->{dst}": dict(sorted(kinds.items()))
+                for (src, dst), kinds in sorted(self.link_fault_counts.items())
+            }
+        return {
+            "total": sum(by_kind.values()),
+            "by_kind": by_kind,
+            "links": links,
+        }
+
     def close(self) -> None:
         with self._lock:
+            already = self._closed
             self._closed = True
             timers, self._timers = list(self._timers), set()
         for t in timers:
             t.cancel()
+        # The quiescence summary: one journal event carrying the whole
+        # attribution table, emitted once even if close() is re-entered
+        # (endpoint teardown and transport teardown both chain here).
+        if self.journal is not None and not already and not self._summarized:
+            self._summarized = True
+            self.journal.append(
+                "chaos_summary", seed=self.seed, **self.fault_summary()
+            )
         self.inner.close()
 
     # -- internals ------------------------------------------------------------
@@ -331,6 +362,8 @@ class FaultyTransport(Transport):
 
         def event(kind: str, **fields) -> None:
             self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+            per_link = self.link_fault_counts.setdefault(link, {})
+            per_link[kind] = per_link.get(kind, 0) + 1
             events.append({"event": kind, **fields})
 
         batch = None
@@ -502,7 +535,7 @@ class LiveAuditor:
     than raised — a violating history is simply reported inconsistent.
     """
 
-    def __init__(self, tester, client_ids):
+    def __init__(self, tester, client_ids, journal=None):
         from ..actor import register as _register
 
         self._reg = _register
@@ -512,6 +545,11 @@ class LiveAuditor:
         self._invoked: set = set()
         self._returned: set = set()
         self._lock = threading.Lock()
+        # Optional op journal: one ``actor_op`` event per deduplicated
+        # invocation/return, timestamping the operation window so a
+        # rejected history can be correlated against the injected-fault
+        # timeline (obs/report.py's fault-attribution table).
+        self.journal: Optional[Journal] = as_journal(journal)
 
     @staticmethod
     def _unwrap(msg: Any) -> Any:
@@ -540,6 +578,11 @@ class LiveAuditor:
                 self.tester.on_invoke(env.src, op)
             except ValueError as e:
                 self.violations.append(f"invoke {key}: {e}")
+        if self.journal is not None:
+            self.journal.append(
+                "actor_op", kind="invoke", client=key[0],
+                request_id=key[1],
+            )
 
     def on_in(self, env: WireEnvelope) -> None:
         from ..semantics.register import WRITE_OK, ReadOk
@@ -565,6 +608,11 @@ class LiveAuditor:
                 self.tester.on_return(env.dst, ret)
             except ValueError as e:
                 self.violations.append(f"return {key}: {e}")
+        if self.journal is not None:
+            self.journal.append(
+                "actor_op", kind="return", client=key[0],
+                request_id=key[1],
+            )
 
     @property
     def invoked_count(self) -> int:
@@ -616,6 +664,9 @@ def run_chaos_register_system(
     storage_dir: Optional[str] = None,
     transport_factory: Optional[Callable[[], Transport]] = None,
     quiesce_sec: float = 2.0,
+    trace: bool = False,
+    metrics_port: Optional[int] = None,
+    stats_interval: float = 0.5,
 ) -> dict:
     """Run a register-protocol cluster hermetically under chaos and audit it.
 
@@ -634,17 +685,34 @@ def run_chaos_register_system(
     outcome (its op stays in flight, which the testers treat as optional)
     rather than something worth spinning on until the deadline.
 
+    ``trace=True`` turns on the causal trace envelope at the transport
+    boundary (``actor/obs.py``): spans are journaled as ``actor_span``
+    events, and — the fault schedule being a pure function of the
+    per-link datagram *index*, never the bytes — the injected schedule
+    for a fixed seed is bit-identical with tracing on or off
+    (tests/test_actor_chaos.py).  ``metrics_port`` serves the runtime's
+    live ``/.metrics`` during the run (0 picks an ephemeral port); at
+    quiescence the harness scrapes its own surface over real HTTP,
+    validates the Prometheus exposition with ``parse_prometheus``, and
+    folds the scrape into the result (``metrics``, ``prometheus_valid``,
+    ``metrics_address``).  A journal additionally gets periodic
+    ``actor_stats`` events (datagram/op/retransmit progress +
+    ``partition_active``) — the stream the ``watch`` verb renders.
+
     Returns the audit verdict dict plus ``faults`` (injected-fault
-    counts), ``completed``, ``elapsed_sec``, and ``errors``.
+    counts), ``fault_links`` (the per-link attribution table),
+    ``completed``, ``elapsed_sec``, and ``errors``.
     """
     import shutil
 
     from ..actor.ids import Id as _Id
+    from ..actor.obs import ObservedTransport, serve_actor_metrics
     from ..actor.ordered_reliable_link import ActorWrapper, Ack, Deliver, LinkStorage
     from ..actor.register import Get, GetOk, Put, PutOk, RegisterClient
     from ..actor.spawn import spawn
     from ..actor.transport import LoopbackTransport
     from ..actor.wire import register_wire_types, wire_deserialize, wire_serialize
+    from ..obs.metrics import MetricsRegistry
     from ..semantics import LinearizabilityTester, Register
 
     journal = as_journal(journal)
@@ -657,7 +725,8 @@ def run_chaos_register_system(
 
     if tester_factory is None:
         tester_factory = lambda: LinearizabilityTester(Register(None))  # noqa: E731
-    auditor = LiveAuditor(tester_factory(), client_ids)
+    auditor = LiveAuditor(tester_factory(), client_ids, journal=journal)
+    registry = MetricsRegistry()
 
     def give_up(actor_id, dropped):
         if journal is not None:
@@ -676,6 +745,7 @@ def run_chaos_register_system(
             max_resend_interval=max_resend_interval,
             max_resends=max_resends,
             on_give_up=give_up,
+            metrics=registry,
         )
 
     actors = [
@@ -686,10 +756,17 @@ def run_chaos_register_system(
         for cid in client_ids
     ]
 
+    # Stack order matters: Recording(Observed(Faulty(Loopback))) — the
+    # auditor decodes clean payloads ABOVE the envelope boundary, the
+    # observer envelopes/counts at the actor-facing boundary, and the
+    # fault injector treats enveloped datagrams as opaque bytes below.
     inner = transport_factory() if transport_factory is not None else LoopbackTransport()
     faulty = FaultyTransport(inner, spec, seed=seed, journal=journal)
+    observed = ObservedTransport(
+        faulty, registry=registry, trace=trace, journal=journal
+    )
     transport: Transport = RecordingTransport(
-        faulty, wire_deserialize, on_out=auditor.on_out, on_in=auditor.on_in
+        observed, wire_deserialize, on_out=auditor.on_out, on_in=auditor.on_in
     )
 
     tmp_storage = None
@@ -707,8 +784,36 @@ def run_chaos_register_system(
         actors,
         storage_dir=storage_dir,
         transport=transport,
+        metrics=registry,
     )
+    metrics_server = None
+    scrape = None
+
+    def partition_active(elapsed: float) -> bool:
+        return any(
+            p.at <= elapsed and (p.heal is None or elapsed < p.heal)
+            for p in spec.partitions
+        )
+
+    def journal_stats(count: int) -> None:
+        if journal is None:
+            return
+        journal.append(
+            "actor_stats",
+            datagrams=count,
+            invoked=auditor.invoked_count,
+            returned=auditor.returned_count,
+            retransmits=int(registry.get("orl_retransmits_total", 0) or 0),
+            give_ups=int(registry.get("orl_give_ups_total", 0) or 0),
+            faults=faulty.fault_summary()["total"],
+            partition_active=partition_active(time.monotonic() - started),
+        )
+
     try:
+        if metrics_port is not None:
+            metrics_server = serve_actor_metrics(
+                runtime, ("127.0.0.1", int(metrics_port))
+            )
         deadline = started + deadline_sec
         # Quiescence detection only arms once every healing partition has
         # healed; permanent (heal=None) partitions don't delay it — after
@@ -719,28 +824,72 @@ def run_chaos_register_system(
         )
         quiesce_from = started + last_heal
         last_count, last_change = -1, time.monotonic()
+        last_stats = time.monotonic()
         while auditor.returned_count < expected and time.monotonic() < deadline:
             count = faulty.datagram_count()
             now = time.monotonic()
+            if now - last_stats >= stats_interval:
+                last_stats = now
+                journal_stats(count)
             if count != last_count:
                 last_count, last_change = count, now
             elif now >= quiesce_from and now - last_change >= quiesce_sec:
                 break  # stalled-stable: nothing has moved for quiesce_sec
             time.sleep(0.01)
+        journal_stats(faulty.datagram_count())
+        if metrics_server is not None:
+            # The scrape the CI smoke gates on: this process GETs its own
+            # /.metrics over real HTTP — both forms — and validates the
+            # Prometheus exposition with the minimal parser.
+            scrape = _self_scrape(metrics_server)
     finally:
+        if metrics_server is not None:
+            metrics_server.shutdown()
         runtime.stop(raise_errors=False)
         if tmp_storage is not None:
             shutil.rmtree(tmp_storage, ignore_errors=True)
 
     result = auditor.result()
+    fault_summary = faulty.fault_summary()
     result.update(
         completed=result["returned"] >= expected,
         expected=expected,
         elapsed_sec=round(time.monotonic() - started, 3),
-        faults=dict(sorted(faulty.fault_counts.items())),
+        faults=fault_summary["by_kind"],
+        fault_links=fault_summary["links"],
         seed=seed,
         errors=[repr(e) for e in runtime.errors],
     )
+    # Journal the verdict BEFORE folding in the scrape: the full metrics
+    # dict (histogram bucket arrays, per-link maps) belongs in the
+    # returned/printed result, not duplicated into every journal line.
     if journal is not None:
         journal.append("audit", **result)
+    if scrape is not None:
+        result.update(scrape)
     return result
+
+
+def _self_scrape(server) -> dict:
+    """GET the actor metrics server's own ``/.metrics`` (JSON and
+    Prometheus) and validate the exposition; failures land in the dict
+    (``prometheus_valid: false`` + ``scrape_error``), never raise."""
+    import urllib.request
+
+    from ..obs.prometheus import parse_prometheus
+
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}/.metrics"
+    out: dict = {"metrics_address": f"{host}:{port}"}
+    try:
+        with urllib.request.urlopen(base, timeout=10) as r:
+            out["metrics"] = json.loads(r.read())
+        with urllib.request.urlopen(
+            base + "?format=prometheus", timeout=10
+        ) as r:
+            parse_prometheus(r.read().decode())
+        out["prometheus_valid"] = True
+    except Exception as e:
+        out["prometheus_valid"] = False
+        out["scrape_error"] = repr(e)
+    return out

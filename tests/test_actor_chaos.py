@@ -270,12 +270,15 @@ def test_live_auditor_flags_orphan_returns():
 
 
 class _Opts:
-    def __init__(self, spec, seed, journal=None, duration=30.0, audit=True):
+    def __init__(self, spec, seed, journal=None, duration=30.0, audit=True,
+                 trace=False, metrics_port=None):
         self.spec = ChaosSpec.from_json(spec)
         self.seed = seed
         self.audit = audit
         self.journal = journal
         self.duration = duration
+        self.trace = trace
+        self.metrics_port = metrics_port
 
 
 def test_abd_under_chaos_audits_linearizable(tmp_path):
@@ -331,6 +334,44 @@ def test_abd_chaos_run_is_seed_reproducible_in_its_fault_schedule(tmp_path):
     # the shared prefix of every link's schedule must agree exactly.
     for link in set(s1) | set(s2):
         a, b = s1.get(link, []), s2.get(link, [])
+        n = min(len(a), len(b))
+        assert a[:n] == b[:n], f"schedules diverge on link {link}"
+
+
+def test_abd_chaos_schedule_reproducible_with_tracing_enabled(tmp_path):
+    """ISSUE-15 acceptance: the causal trace envelope (actor/obs.py)
+    wraps every datagram, yet the injected fault schedule for a fixed
+    seed stays bit-identical — fault fate depends on the per-link
+    datagram INDEX, never the bytes.  Same prefix-equality rule as the
+    untraced reproducibility test, plus: the traced run audits
+    consistent and journals actor_span events."""
+    from stateright_tpu.models.abd import run_chaos_audit
+
+    def link_schedule(name, trace):
+        journal = str(tmp_path / name)
+        result = run_chaos_audit(
+            _Opts('{"drop": 0.2, "duplicate": 0.2}', seed=5,
+                  journal=journal, trace=trace)
+        )
+        assert result["consistent"], result
+        by_link = {}
+        spans = 0
+        for e in read_journal(journal):
+            if e["event"].startswith("chaos_") and "src" in e:
+                by_link.setdefault((e["src"], e["dst"]), []).append(
+                    (e["event"], e["n"])
+                )
+            elif e["event"] == "actor_span":
+                spans += 1
+        return by_link, spans
+
+    traced, spans = link_schedule("traced.jsonl", trace=True)
+    untraced, no_spans = link_schedule("untraced.jsonl", trace=False)
+    assert spans > 0, "tracing must journal actor_span events"
+    assert no_spans == 0, "trace=False must journal no spans"
+    assert traced, "the seeded run should have injected faults"
+    for link in set(traced) | set(untraced):
+        a, b = traced.get(link, []), untraced.get(link, [])
         n = min(len(a), len(b))
         assert a[:n] == b[:n], f"schedules diverge on link {link}"
 

@@ -68,6 +68,51 @@ def _assert_schema(m: dict, who: str) -> None:
     json.dumps(m)
 
 
+def test_guaranteed_schema_actor_runtime():
+    """The actor runtime (ISSUE 15) reports the same guaranteed key set
+    as every checking engine — a spawned production system scrapes like
+    a checker.  Actor semantics: state_count counts handled messages,
+    unique_state_count the spawned actors, max_depth the deepest causal
+    hop; no device table, so table_load_factor is 0.0."""
+    from stateright_tpu.actor.base import Actor, Out
+    from stateright_tpu.actor.ids import Id
+    from stateright_tpu.actor.obs import ObservedTransport
+    from stateright_tpu.actor.spawn import (
+        json_deserialize, json_serialize, spawn,
+    )
+    from stateright_tpu.actor.transport import LoopbackTransport
+
+    class _Quiet(Actor):
+        def on_start(self, id, storage, o: Out):
+            return ()
+
+        def on_msg(self, id, state, src, msg, o: Out):
+            return None
+
+    transport = ObservedTransport(LoopbackTransport(), trace=True)
+    runtime = spawn(
+        json_serialize, json_deserialize, json_serialize, json_deserialize,
+        [(Id(1), _Quiet())], storage_dir="/tmp", transport=transport,
+        metrics=transport.registry,
+    )
+    probe = transport.bind(Id(9))
+    try:
+        probe.send(Id(1), json_serialize({"poke": 1}))
+        deadline_metrics = runtime.metrics()
+        _assert_schema(deadline_metrics, "ActorRuntime (running)")
+        assert deadline_metrics["done"] is False
+    finally:
+        probe.close()
+        runtime.stop()
+    m = runtime.metrics()
+    _assert_schema(m, "ActorRuntime")
+    assert m["engine"] == "ActorRuntime"
+    assert m["done"] is True
+    assert m["unique_state_count"] == 1
+    assert m["table_load_factor"] == 0.0  # no device table
+    assert "histograms" in m
+
+
 def test_guaranteed_schema_host_and_simulation_engines():
     bfs = BinaryClock().checker().spawn_bfs().join()
     _assert_schema(bfs.metrics(), "GraphChecker")

@@ -690,3 +690,133 @@ def test_watch_once_cli_smoke(tmp_path, capsys):
         cli_spec(), ["watch", str(tmp_path / "nope.jsonl"), "--once"]
     ) == 2
     assert example_main(cli_spec(), ["watch", "--once"]) == 2
+
+
+# --- actor/chaos journals (ISSUE 15) -----------------------------------------
+
+
+def _actor_journal(consistent=True):
+    """A synthetic chaos-run journal: injections, ops, stats, spans,
+    give-up, summary, audit."""
+    return [
+        {"t": 0.0, "event": "chaos_start", "seed": 7, "spec": {}},
+        {"t": 0.1, "event": "actor_op", "kind": "invoke", "client": 3,
+         "request_id": 1},
+        {"t": 0.15, "event": "actor_span", "trace": "ab" * 8, "hop": 0,
+         "src": 3, "dst": 0, "latency_sec": 0.001},
+        {"t": 0.2, "event": "chaos_drop", "src": 0, "dst": 1, "n": 0},
+        {"t": 0.3, "event": "chaos_duplicate", "src": 0, "dst": 1, "n": 1},
+        {"t": 0.35, "event": "actor_span", "trace": "ab" * 8, "hop": 1,
+         "src": 0, "dst": 3, "latency_sec": 0.002},
+        {"t": 0.4, "event": "actor_op", "kind": "return", "client": 3,
+         "request_id": 1},
+        {"t": 0.5, "event": "orl_give_up", "actor": 1, "dropped": 1,
+         "seqs": [4]},
+        {"t": 0.6, "event": "actor_stats", "datagrams": 40, "invoked": 1,
+         "returned": 1, "retransmits": 6, "give_ups": 1,
+         "partition_active": False},
+        # A fault after the op window: attribution must exclude it.
+        {"t": 2.0, "event": "chaos_drop", "src": 1, "dst": 0, "n": 2},
+        {"t": 2.1, "event": "chaos_summary", "seed": 7, "total": 3,
+         "by_kind": {"chaos_drop": 2, "chaos_duplicate": 1},
+         "links": {"0->1": {"chaos_drop": 1, "chaos_duplicate": 1},
+                   "1->0": {"chaos_drop": 1}}},
+        {"t": 2.2, "event": "audit", "consistent": consistent,
+         "invoked": 1, "returned": 1, "in_flight": 0, "violations": [],
+         "completed": True, "expected": 2, "seed": 7},
+    ]
+
+
+def test_actor_only_journal_degrades_without_bottleneck_phase():
+    """ISSUE-15 satellite regression: an actor-only journal (no engine
+    wave events) must not crash analyze_journal and must NOT emit a
+    bogus bottleneck_phase — it degrades to the actor section with a
+    warning."""
+    report = analyze_journal(_actor_journal())
+    assert report["kind"] == "actor"
+    assert "bottleneck_phase" not in report
+    assert "phase_breakdown" not in report
+    assert any("actor-only" in w for w in report["warnings"])
+    actor = report["actor"]
+    assert actor["fault_total"] == 3
+    assert actor["faults_by_kind"] == {"chaos_drop": 2, "chaos_duplicate": 1}
+    assert actor["faults_by_link"] == {
+        "0->1": {"chaos_drop": 1, "chaos_duplicate": 1},
+        "1->0": {"chaos_drop": 1},
+    }
+    # ...and it equals the transport's own journaled summary.
+    assert actor["faults_by_link"] == actor["chaos_summary"]["links"]
+    assert actor["orl_give_ups"] == 1
+    assert actor["spans"] == 2 and actor["max_hop"] == 1
+    assert actor["audit"]["consistent"] is True
+    assert "fault_attribution" not in actor  # consistent: no window
+    md = render_markdown(report)
+    assert "## Actor runtime" in md and "consistent" in md
+    json.dumps(report, default=str)
+
+
+def test_rejected_audit_attribution_windows_on_ops():
+    """A rejected history: the attribution table counts only faults
+    inside the audited operation window."""
+    report = analyze_journal(_actor_journal(consistent=False))
+    attribution = report["actor"]["fault_attribution"]
+    # The t=2.0 drop falls outside the [0.1, 0.4] op window.
+    assert attribution["fault_total"] == 2
+    assert attribution["faults_by_link"] == {
+        "0->1": {"chaos_drop": 1, "chaos_duplicate": 1},
+    }
+    assert attribution["window"]["ops"] == 2
+    md = render_markdown(report)
+    assert "Fault attribution" in md and "REJECTED" in md
+
+
+def test_engine_journal_with_actor_events_keeps_run_kind():
+    """A run journal that ALSO carries chaos events (a supervised run
+    under a chaos-wrapped transport) keeps its run analysis — the actor
+    section rides alongside, no degrade warning."""
+    events = [
+        {"t": 0.5, "event": "wave", "waves": 1, "unique": 100, "depth": 2,
+         "call_sec": 0.1, "occupancy": 0.1, "remaining": 0},
+        {"t": 0.6, "event": "chaos_drop", "src": 0, "dst": 1, "n": 0},
+        {"t": 0.9, "event": "engine_done", "unique": 100},
+    ]
+    report = analyze_journal(events)
+    assert report["kind"] == "run"
+    assert "bottleneck_phase" in report
+    assert report["actor"]["fault_total"] == 1
+    assert not any(
+        "actor-only" in w for w in report.get("warnings", [])
+    )
+
+
+def test_watch_renders_actor_journal_fields_and_badges():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    events = _actor_journal()
+    s = summarize_events(events)
+    assert s["datagrams"] == 40 and s["retransmits"] == 6
+    assert s["chaos_faults"] == 3 and s["orl_give_ups"] == 1
+    assert s["done"] is True  # the audit verdict ends a chaos run
+    line = render_line(s)
+    assert "retransmits=6" in line and "faults=3" in line
+    assert "audit=ok" in line
+    assert "orl-give-ups=1" in line
+
+    # msgs/s EMA over consecutive actor_stats events.
+    events2 = [e for e in events if e["event"] != "actor_stats"] + [
+        {"t": 1.0, "event": "actor_stats", "datagrams": 0, "invoked": 0,
+         "returned": 0, "retransmits": 0, "give_ups": 0,
+         "partition_active": False},
+        {"t": 2.0, "event": "actor_stats", "datagrams": 50, "invoked": 1,
+         "returned": 1, "retransmits": 2, "give_ups": 0,
+         "partition_active": True},
+    ]
+    s2 = summarize_events(events2)
+    assert s2["msgs_per_sec"] == pytest.approx(50.0)
+    assert s2["partition_active"] is True
+    assert "partition-active" in render_line(s2)
+
+    # An inconsistent audit raises the badge.
+    s3 = summarize_events(_actor_journal(consistent=False))
+    assert "audit-inconsistent" in s3["warnings"]
+    assert "audit=INCONSISTENT" in render_line(s3)

@@ -108,6 +108,142 @@ def test_wire_deserialize_failures_are_always_valueerror():
     assert failed > 0, "the corpus should contain undecodable datagrams"
 
 
+# --- the trace envelope (actor/obs.py, ISSUE 15) -----------------------------
+
+
+def test_trace_envelope_round_trips_under_fuzz():
+    """Random payloads (including envelope-magic-looking ones), trace
+    ids, hops, and timestamps: wrap → unwrap must reproduce the payload
+    and header exactly."""
+    from stateright_tpu.actor.obs import unwrap_datagram, wrap_datagram
+
+    rng = random.Random(0x5EED)
+    for _ in range(300):
+        payload = bytes(
+            rng.randrange(256) for _ in range(rng.randrange(0, 200))
+        )
+        trace_id = rng.getrandbits(64)
+        hop = rng.randrange(256)
+        sent_at = rng.random() * 2e9
+        data = wrap_datagram(payload, trace_id, hop, sent_at)
+        out, ctx = unwrap_datagram(data)
+        assert out == payload
+        assert ctx.trace_id == trace_id
+        assert ctx.hop == hop
+        assert abs(ctx.sent_at - sent_at) < 1e-6
+
+
+def test_malformed_envelope_decode_is_always_valueerror():
+    """Anything wearing the envelope magic either decodes or raises
+    ValueError — never struct.error / IndexError — mirroring the wire
+    codec's malformed-datagram contract."""
+    from stateright_tpu.actor.obs import (
+        ENVELOPE_OVERHEAD, MAGIC, unwrap_datagram, wrap_datagram,
+    )
+
+    rng = random.Random(0xBAD)
+    good = wrap_datagram(b"payload-bytes", 12345, 7, 1234.5)
+    corpus = [
+        MAGIC,                      # bare magic
+        MAGIC + b"\x00",            # torn header
+        good[: ENVELOPE_OVERHEAD - 1],  # header truncated by one byte
+        good[:-1],                  # payload shorter than declared
+        good + b"x",                # payload longer than declared
+    ]
+    for _ in range(100):
+        cut = rng.randrange(len(good))
+        corpus.append(good[:cut] if good[:cut].startswith(MAGIC) else good)
+        corpus.append(MAGIC + bytes(
+            rng.randrange(256) for _ in range(rng.randrange(0, 40))
+        ))
+    decoded = failed = 0
+    for datagram in corpus:
+        try:
+            payload, ctx = unwrap_datagram(datagram)
+            assert ctx is not None  # it wore the magic: never "legacy"
+            decoded += 1
+        except ValueError:
+            failed += 1
+    assert failed > 0, "the corpus should contain malformed envelopes"
+
+
+def test_legacy_unenveloped_datagrams_pass_through():
+    """Every datagram the wire codec emits is magic-free, so the
+    envelope layer hands it through byte-identical with no context —
+    un-enveloped (legacy) senders interoperate with traced receivers."""
+    from stateright_tpu.actor.obs import MAGIC, unwrap_datagram
+
+    for datagram in [
+        wire_serialize(FuzzPing(7, "hello")),
+        wire_serialize(FuzzBag(items=(FuzzPong(1),), tags=frozenset([3]))),
+        b"",
+        b"not json",
+        b"[1, 2, 3]",
+    ]:
+        assert not datagram.startswith(MAGIC)
+        out, ctx = unwrap_datagram(datagram)
+        assert out == datagram and ctx is None
+
+
+def test_live_traced_replica_survives_garbage_and_fake_envelopes():
+    """The fuzz corpus — plus magic-wearing garbage — against a replica
+    behind a tracing ObservedTransport: everything malformed drops,
+    enveloped and legacy probes both still answered."""
+    from stateright_tpu.actor.obs import (
+        ObservedTransport, unwrap_datagram, wrap_datagram,
+    )
+
+    obs = ObservedTransport(LoopbackTransport(), trace=True)
+    replica = Id(1)
+    runtime = spawn(
+        wire_serialize,
+        wire_deserialize,
+        wire_serialize,
+        wire_deserialize,
+        [(replica, _EchoActor())],
+        storage_dir="/tmp",
+        transport=obs,
+        metrics=obs.registry,
+    )
+    rng = random.Random(0xFADE)
+    probe = obs.inner.bind(Id(99))  # raw fabric: full control of bytes
+    try:
+        corpus = _hand_typed_corpus() + _seeded_corpus()
+        corpus += [
+            b"\xabSR1" + bytes(rng.randrange(256) for _ in range(n))
+            for n in (0, 1, 10, 30)
+        ]
+        for datagram in corpus:
+            probe.send(replica, datagram)
+        # A LEGACY (un-enveloped) probe is still accepted...
+        probe.send(replica, wire_serialize(FuzzPing(-1, "legacy")))
+        # ...and an enveloped one carries its trace through to the reply.
+        probe.send(
+            replica,
+            wrap_datagram(wire_serialize(FuzzPing(-2, "traced")), 77, 3, 0.0),
+        )
+        wanted = {FuzzPong(-1): None, FuzzPong(-2): None}
+        while any(v is None for v in wanted.values()):
+            r = probe.recv(5.0)
+            assert r is not None, (
+                f"replica stopped answering; errors={runtime.errors!r}"
+            )
+            payload, ctx = unwrap_datagram(r[0])
+            try:
+                msg = wire_deserialize(payload)
+            except ValueError:
+                continue
+            if msg in wanted:
+                wanted[msg] = ctx
+        assert wanted[FuzzPong(-2)].trace_id == 77
+        assert wanted[FuzzPong(-2)].hop == 4  # 3 + the replica's send
+        assert runtime.errors == []
+    finally:
+        probe.close()
+        runtime.stop()
+    assert runtime.registry.get("trace_envelope_malformed_total", 0) > 0
+
+
 class _EchoActor(Actor):
     """Replies FuzzPong to every well-formed FuzzPing."""
 
