@@ -1344,6 +1344,72 @@ def phase_recheck(record: dict) -> None:
     )
 
 
+ENSEMBLE_MEMBERS = 1024
+ENSEMBLE_STEPS = 48
+ENSEMBLE_SEED = 3
+ENSEMBLE_CHAOS = '{"default": {"drop": 0.1, "reorder": 0.05}}'
+
+
+def phase_ensemble(record: dict) -> None:
+    """Chaos-ensemble phase (ensemble/engine.py,
+    docs/CHAOS_ENSEMBLES.md): one device dispatch sweeping
+    ENSEMBLE_MEMBERS independent fault schedules over the ABD workload
+    with the known-violating ``skip_ack`` hook — the GOLDEN GATE: the
+    sweep must find a failing seed, shrink it, and host-replay it to a
+    rejected history, or the posted throughput is hollow.  Metrics: raw
+    schedules/sec for the dispatch (includes the one-time compile — the
+    honest single-dispatch cost) and time-to-first-failing-seed."""
+    from stateright_tpu.ensemble import run_ensemble
+
+    if budget_remaining() < 240.0:
+        raise AssertionError(
+            f"global time budget too low ({budget_remaining():.0f}s left)"
+        )
+
+    result = run_ensemble(
+        members=ENSEMBLE_MEMBERS,
+        seed=ENSEMBLE_SEED,
+        chaos=ENSEMBLE_CHAOS,
+        steps=ENSEMBLE_STEPS,
+        fault="skip_ack",
+        shrink=True,
+        replay=True,
+    )
+    assert result.dispatches == 1
+    assert len(result.failing) > 0, (
+        "the known-violating skip_ack ensemble found no failing seed"
+    )
+    assert result.confirmed, (
+        "no device-found failing seed replayed to a host-rejected history"
+    )
+    assert result.repro is not None and result.repro["steps"] <= ENSEMBLE_STEPS
+
+    record["ensemble"] = {
+        "workload": "abd_skip_ack",
+        "members": result.members,
+        "steps": result.steps,
+        "dispatch_sec": round(result.elapsed_sec, 3),
+        "schedules_per_sec": round(result.schedules_per_sec, 1),
+        "ttff_sec": result.ttff_sec,
+        "failing": len(result.failing),
+        "confirmed": len(result.confirmed),
+        "shrink_steps": result.shrink_steps,
+        "repro_steps": result.repro["steps"],
+        "repro_seed": result.repro["seed"],
+    }
+    # Top-level gauge the trajectory table tracks (obs/report.py).
+    record["ensemble_schedules_per_sec"] = round(
+        result.schedules_per_sec, 1
+    )
+    log(
+        f"ensemble: {result.members} schedules in one dispatch, "
+        f"{result.schedules_per_sec:.0f} sched/s, "
+        f"{len(result.failing)} failing, ttff {result.ttff_sec}s; "
+        f"shrunk to {result.repro['steps']} steps and host-replay "
+        "REJECTED (fault attribution journaled)"
+    )
+
+
 def _force_single_phase() -> bool:
     """Disable the two-phase expansion path (engine falls back to the
     single-phase step kernel).  Returns True if anything changed."""
@@ -1549,6 +1615,7 @@ OPTIONAL_PHASES = (
     "denominator_native",
     "serving",
     "recheck",
+    "ensemble",
     "tiered",
     "trace",
     "dedup",
@@ -1617,6 +1684,7 @@ def main() -> None:
         "denominator_native": phase_denominator_native,
         "serving": phase_serving,
         "recheck": phase_recheck,
+        "ensemble": phase_ensemble,
         "tiered": phase_tiered,
         "trace": lambda r: phase_trace(r, tuned),
         "dedup": phase_dedup,

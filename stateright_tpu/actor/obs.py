@@ -55,6 +55,7 @@ __all__ = [
     "ObservedTransport",
     "TraceContext",
     "clear_trace_context",
+    "find_faulty",
     "find_in_stack",
     "find_observed",
     "serve_actor_metrics",
@@ -284,6 +285,16 @@ def find_in_stack(transport_or_endpoint, cls):
 def find_observed(transport_or_endpoint) -> Optional[ObservedTransport]:
     """The :class:`ObservedTransport` in a wrapper stack, if any."""
     return find_in_stack(transport_or_endpoint, ObservedTransport)
+
+
+def find_faulty(transport_or_endpoint):
+    """The chaos :class:`~stateright_tpu.runtime.chaos.FaultyTransport`
+    in a wrapper stack, if any — the lookup the runtime's ``/.metrics``
+    fold-in and the chaos-ensemble replay harness use to surface the
+    fault-attribution table beside the link counters."""
+    from ..runtime.chaos import FaultyTransport
+
+    return find_in_stack(transport_or_endpoint, FaultyTransport)
 
 
 def clear_trace_context(endpoint) -> None:

@@ -158,9 +158,9 @@ class ActorRuntime:
         return out
 
     def _find_faulty(self):
-        from ..runtime.chaos import FaultyTransport
+        from .obs import find_faulty
 
-        return find_in_stack(self._transport, FaultyTransport)
+        return find_faulty(self._transport)
 
     def stop(self, timeout: float = 10.0, raise_errors: bool = True) -> None:
         """Stop all actor threads (closing their endpoints); idempotent.

@@ -68,6 +68,12 @@ def _usage(name: str, spec: "CliSpec") -> str:
             " [--journal PATH] [--duration SEC] [--metrics-port PORT]"
             " [--trace]"
         )
+    if spec.ensemble:
+        lines.append(
+            "  check-ensemble [--members K] [--seed N]"
+            " [--chaos SPEC_JSON] [--steps T] [--fault HOOK]"
+            " [--journal PATH] [--no-shrink] [--no-replay]"
+        )
     if spec.default_network:
         lines.append(f"NETWORK: one of {' | '.join(Network.names())}")
     return "\n".join(lines)
@@ -88,6 +94,7 @@ class CliSpec:
         default_address: str = "localhost:3017",
         target_max_depth: Optional[int] = None,
         tpu_target_max_depth: Optional[int] = None,
+        ensemble: bool = False,
     ):
         self.name = name
         self.build = build
@@ -99,6 +106,7 @@ class CliSpec:
         self.tpu_kwargs = tpu_kwargs or {}
         self.spawn = spawn
         self.default_address = default_address
+        self.ensemble = ensemble
         self.target_max_depth = target_max_depth
         # Device-run depth override: raft's reference default (12) needs
         # ~4x10^7 stored states — beyond one chip's HBM at its state
@@ -371,6 +379,93 @@ def _parse_chaos_flags(args, trace: bool = False):
         trace=trace,
     )
     return out, chaos
+
+
+def _run_check_ensemble(spec: "CliSpec", args) -> int:
+    """The ``check-ensemble`` verb: one device dispatch sweeping K
+    independent fault schedules (ensemble/engine.py), shrinking and
+    host-replaying any failing seed.  Exits ``VIOLATION_RC`` when a
+    failing schedule was found (host-confirmed when replay is on), so
+    CI gates on it like on ``check-tpu``."""
+    import json as _json
+
+    from .runtime.supervisor import VIOLATION_RC
+
+    members, seed, steps = 1024, 0, 64
+    chaos_json, fault, journal = None, None, None
+    shrink, replay = True, True
+    i = 0
+
+    def value_of(flag):
+        nonlocal i
+        i += 1
+        if i >= len(args):
+            raise ValueError(f"{flag} requires a value")
+        return args[i]
+
+    def int_of(flag, minimum=0):
+        v = value_of(flag)
+        try:
+            n = int(v)
+        except ValueError:
+            raise ValueError(f"{flag} requires an integer") from None
+        if n < minimum:
+            raise ValueError(f"{flag} must be >= {minimum}")
+        return n
+
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--members":
+                members = int_of(a, minimum=1)
+            elif a == "--seed":
+                seed = int_of(a)
+            elif a == "--steps":
+                steps = int_of(a, minimum=1)
+            elif a == "--chaos":
+                chaos_json = value_of(a)
+            elif a == "--fault":
+                fault = value_of(a)
+            elif a == "--journal":
+                journal = value_of(a)
+            elif a == "--no-shrink":
+                shrink = False
+            elif a == "--no-replay":
+                replay = False
+            else:
+                raise ValueError(f"unknown check-ensemble flag: {a}")
+            i += 1
+        if chaos_json is not None and chaos_json.startswith("@"):
+            try:
+                with open(chaos_json[1:], "r", encoding="utf-8") as f:
+                    chaos_json = f.read()
+            except OSError as e:
+                raise ValueError(f"--chaos {chaos_json}: {e}") from None
+        from .ensemble import run_ensemble
+
+        result = run_ensemble(
+            members=members,
+            seed=seed,
+            chaos=chaos_json,
+            steps=steps,
+            fault=fault,
+            journal=journal,
+            shrink=shrink,
+            replay=replay,
+        )
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    print(_json.dumps(result.to_dict(), sort_keys=True, default=str))
+    found = result.confirmed if replay else result.failing
+    if found:
+        print(
+            f"failing schedule discovered: member "
+            f"{result.repro['member']}, seed {result.repro['seed']}",
+            file=sys.stderr,
+        )
+        return VIOLATION_RC
+    return 0
 
 
 class ChaosOptions:
@@ -1057,6 +1152,17 @@ def example_main(spec: CliSpec, argv=None) -> int:
         )
         model.checker().threads(threads).serve((host, port))
         return 0
+
+    if sub == "check-ensemble":
+        if not spec.ensemble:
+            print(
+                f"{spec.name} has no ensemble workload (check-ensemble "
+                "needs a model with a compiled fault hook; "
+                "docs/CHAOS_ENSEMBLES.md)",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_check_ensemble(spec, args)
 
     if sub == "spawn":
         if spec.spawn is None:

@@ -1,0 +1,39 @@
+"""Chaos-ensemble engine: vmapped fault-schedule sweeps at device scale.
+
+One process, one seeded fault schedule is how ``runtime/chaos.py``
+explores faults; this package runs **K independent fault schedules** in a
+single device dispatch by vmapping the ``parallel/simulation_tpu`` walker
+loop over per-member fault parameters.  The bridge that makes device
+findings actionable is ``fate.py``: a bit-exact uint32-limb transcription
+of the host fault-fate function, so any failing member's seed replays
+identically through the host ``FaultyTransport`` + ``LiveAuditor`` path
+(``engine.py`` does that replay and journals the attribution-table
+evidence).  See docs/CHAOS_ENSEMBLES.md.
+
+Submodule imports are lazy: ``fate`` alone pulls in jax.numpy only, and
+the engine's model imports stay off the path of callers that just need
+the kernel (e.g. the host parity tests).
+"""
+
+_EXPORTS = {
+    "device_fault_fate": "fate",
+    "link_seed_limbs": "fate",
+    "partition_cuts": "fate",
+    "rate_threshold": "fate",
+    "EnsembleSchedule": "schedule",
+    "member_seed": "schedule",
+    "EnsembleResult": "engine",
+    "replay_repro": "engine",
+    "run_ensemble": "engine",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    return getattr(import_module(f".{module}", __name__), name)

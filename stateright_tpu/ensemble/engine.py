@@ -1,0 +1,532 @@
+"""The vmapped chaos-ensemble engine.
+
+One device dispatch evaluates **K independent fault schedules** against a
+compiled actor-style model: the ``parallel/simulation_tpu`` walk body is
+vmapped over ``(walker key, member fault parameters)``, with
+:class:`FateLaneHook` masking deliverable FIFO lanes by each member's
+exact host fate stream (``fate.py``).  Member parameters are dispatch
+*inputs* (link-seed limbs, uint32 thresholds, partition step-windows,
+horizon), so shrink candidates re-verify without recompiling.
+
+Device→host bridge, in order:
+
+1. a member "fails on device" when its walk latches an ALWAYS-property
+   violation (for the register workloads that is the *same* exact
+   linearizability DP the checker uses, evaluated per walked state);
+2. the auto-shrinker minimizes the failing schedule — horizon prefix and
+   per-kind rate zeroing re-verified on device, duplicate/delay/partition
+   zeroing re-verified by host replay (those kinds never mask a lane
+   on device, so only the host can vouch for dropping them);
+3. the member's seed replays through the host ``FaultyTransport`` +
+   ``LiveAuditor`` path (``run_chaos_register_system``) — bit-identical
+   fault schedule by the fate-function purity argument — and only a
+   host-REJECTED history counts as a confirmed failing seed.  The replay
+   journals the ``audit`` event whose ``fault_links`` table is the
+   attribution evidence, and the run journals ``ensemble_repro`` with
+   everything needed to rebuild the repro from that event alone
+   (:func:`replay_repro`).
+
+Device fault semantics (documented contract, docs/CHAOS_ENSEMBLES.md):
+a masked lane holds its head and *consumes one fate index per step* —
+the device image of the host's ordered-reliable-link retransmitting a
+dropped/held datagram, where every retransmission is a fresh datagram
+index on the link.  Drop and reorder both mask (a reorder-hold delays
+delivery; a drop delays it until a retransmission survives); duplicate
+and delay never mask — they exist on device only as schedule parameters
+carried to the host replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..runtime.chaos import FATE_DROP, FATE_REORDER, ChaosSpec
+from ..runtime.journal import as_journal
+from .fate import (
+    device_fault_fate,
+    link_seed_limbs,
+    partition_cuts,
+    rate_threshold,
+)
+from .schedule import EnsembleSchedule, derive_schedule
+
+NO_STEP = 0xFFFFFFFF
+
+# Shrink candidates verified on device (they mask lanes) vs by host
+# replay (they only shape the host transport's schedule).
+_DEVICE_KINDS = ("drop", "reorder")
+_REPLAY_KINDS = ("duplicate", "delay")
+
+
+class FateLaneHook:
+    """``build_walk`` fault hook: per-step lane masking by the member's
+    fault schedule, consulting the exact host fate stream."""
+
+    def __init__(self, n_lanes: int):
+        self.n_lanes = n_lanes
+
+    def init(self, params):
+        import jax.numpy as jnp
+
+        del params
+        # Per-lane datagram counters: the (src, dst) link's next fate index.
+        return jnp.zeros((self.n_lanes,), jnp.uint32)
+
+    def apply(self, t, state, valid, n_ctr, params):
+        import jax.numpy as jnp
+
+        del state
+        drop_fate = device_fault_fate(
+            params["link_hi"], params["link_lo"], n_ctr, FATE_DROP
+        )
+        reorder_fate = device_fault_fate(
+            params["link_hi"], params["link_lo"], n_ctr, FATE_REORDER
+        )
+        cut = partition_cuts(
+            params["src_group"], params["dst_group"], t,
+            params["part_at"], params["part_heal"],
+        )
+        masked = (
+            cut
+            | params["drop_always"] | (drop_fate < params["drop_thr"])
+            | params["reorder_always"] | (reorder_fate < params["reorder_thr"])
+        )
+        new_valid = valid & ~masked & (t < params["horizon"])
+        # One datagram attempt per deliverable lane per step: every
+        # masked attempt consumes a fate index, exactly as a host
+        # retransmission would (module docstring).
+        n_ctr = n_ctr + valid.astype(jnp.uint32)
+        return new_valid, n_ctr
+
+
+def _member_params(pairs, schedules: List[EnsembleSchedule]) -> Dict[str, np.ndarray]:
+    """The dispatch-input parameter pack: one row per member, one column
+    per FIFO lane (the compiled model's ``pairs``)."""
+    n_lanes = len(pairs)
+    k = len(schedules)
+    out = {
+        "link_hi": np.zeros((k, n_lanes), np.uint32),
+        "link_lo": np.zeros((k, n_lanes), np.uint32),
+        "drop_thr": np.zeros((k, n_lanes), np.uint32),
+        "drop_always": np.zeros((k, n_lanes), np.bool_),
+        "reorder_thr": np.zeros((k, n_lanes), np.uint32),
+        "reorder_always": np.zeros((k, n_lanes), np.bool_),
+        "src_group": np.full((k, n_lanes), -1, np.int32),
+        "dst_group": np.full((k, n_lanes), -1, np.int32),
+        "part_at": np.zeros((k,), np.int32),
+        "part_heal": np.full((k,), -1, np.int32),
+        "horizon": np.zeros((k,), np.int32),
+    }
+    for mi, sch in enumerate(schedules):
+        group_of: Dict[int, int] = {}
+        if sch.spec.partitions:
+            for gi, g in enumerate(sch.spec.partitions[0].groups):
+                for node in g:
+                    group_of[node] = gi
+        for li, (src, dst, _depth, _off) in enumerate(pairs):
+            hi, lo = link_seed_limbs(sch.seed, src, dst)
+            out["link_hi"][mi, li] = hi
+            out["link_lo"][mi, li] = lo
+            f = sch.spec.faults_for(src, dst)
+            thr, always = rate_threshold(f.drop)
+            out["drop_thr"][mi, li] = thr
+            out["drop_always"][mi, li] = always
+            thr, always = rate_threshold(f.reorder)
+            out["reorder_thr"][mi, li] = thr
+            out["reorder_always"][mi, li] = always
+            out["src_group"][mi, li] = group_of.get(src, -1)
+            out["dst_group"][mi, li] = group_of.get(dst, -1)
+        out["part_at"][mi] = sch.partition_at
+        out["part_heal"][mi] = sch.partition_heal
+        out["horizon"][mi] = sch.steps
+    return out
+
+
+def _zero_kind(spec: ChaosSpec, kind: str) -> ChaosSpec:
+    def z(f):
+        if kind == "delay":
+            return dataclasses.replace(f, delay=(0.0, 0.0))
+        return dataclasses.replace(f, **{kind: 0.0})
+
+    return ChaosSpec(
+        default=z(spec.default),
+        links=tuple((k, z(f)) for k, f in spec.links),
+        partitions=spec.partitions,
+    )
+
+
+def _spec_is_meaningful(spec: ChaosSpec, kind: str) -> bool:
+    """Is there anything to shrink for this kind?"""
+    faults = [spec.default] + [f for _k, f in spec.links]
+    if kind == "delay":
+        return any(f.delay[1] > 0 for f in faults)
+    return any(getattr(f, kind) > 0 for f in faults)
+
+
+@dataclass
+class EnsembleResult:
+    """One ensemble run: the sweep, the failing members, and (when a
+    failure was found) the shrunk + host-confirmed repro."""
+
+    members: int
+    steps: int
+    seed: int
+    workload: str
+    fault: Optional[str]
+    states_walked: int = 0
+    elapsed_sec: float = 0.0
+    schedules_per_sec: float = 0.0
+    ttff_sec: Optional[float] = None  # time to first failing seed
+    failing: List[dict] = field(default_factory=list)
+    confirmed: List[dict] = field(default_factory=list)
+    shrink_steps: int = 0
+    repro: Optional[dict] = None
+    dispatches: int = 1
+
+    def to_dict(self) -> dict:
+        return {
+            "members": self.members,
+            "steps": self.steps,
+            "seed": self.seed,
+            "workload": self.workload,
+            "fault": self.fault,
+            "states_walked": self.states_walked,
+            "elapsed_sec": round(self.elapsed_sec, 3),
+            "schedules_per_sec": round(self.schedules_per_sec, 1),
+            "ttff_sec": self.ttff_sec,
+            "failing": self.failing,
+            "confirmed": self.confirmed,
+            "shrink_steps": self.shrink_steps,
+            "repro": self.repro,
+            "dispatches": self.dispatches,
+        }
+
+
+def _abd_model(client_count: int, fault: Optional[str]):
+    from ..actor import Network
+    from ..models.abd import AbdModelCfg
+
+    return AbdModelCfg(
+        client_count=client_count,
+        server_count=2,
+        network=Network.new_ordered(),
+        fault=fault,
+    ).into_model()
+
+
+def replay_schedule(
+    sch: EnsembleSchedule,
+    *,
+    fault: Optional[str] = None,
+    client_count: int = 2,
+    put_count: int = 1,
+    journal=None,
+    deadline_sec: float = 8.0,
+    quiesce_sec: float = 0.75,
+) -> dict:
+    """Host replay of one member schedule: the same seed through the
+    real ``FaultyTransport`` + ``LiveAuditor`` stack — the confirmation
+    oracle, and the producer of the journaled ``audit`` event whose
+    ``fault_links`` table is the repro's attribution evidence."""
+    from ..actor.register import RegisterServer
+    from ..models.abd import (
+        AbdActor,
+        AckQuery,
+        AckRecord,
+        NULL_VALUE,
+        Query,
+        Record,
+    )
+    from ..actor.register import Internal
+    from ..runtime.chaos import run_chaos_register_system
+    from ..semantics import LinearizabilityTester, Register
+
+    return run_chaos_register_system(
+        lambda peers: RegisterServer(AbdActor(peers, fault=fault)),
+        server_count=2,
+        client_count=client_count,
+        put_count=put_count,
+        spec=sch.spec,
+        seed=sch.seed,
+        tester_factory=lambda: LinearizabilityTester(Register(NULL_VALUE)),
+        wire_types=(Internal, Query, AckQuery, Record, AckRecord),
+        journal=journal,
+        deadline_sec=deadline_sec,
+        quiesce_sec=quiesce_sec,
+    )
+
+
+def replay_repro(repro: dict, *, journal=None, deadline_sec: float = 8.0,
+                 quiesce_sec: float = 0.75) -> dict:
+    """Rebuild and replay a repro from its ``ensemble_repro`` journal
+    payload ALONE — no reference to the ensemble run that found it."""
+    sch = EnsembleSchedule.from_repro(repro)
+    return replay_schedule(
+        sch,
+        fault=repro.get("fault"),
+        client_count=int(repro.get("client_count", 2)),
+        put_count=int(repro.get("put_count", 1)),
+        journal=journal,
+        deadline_sec=deadline_sec,
+        quiesce_sec=quiesce_sec,
+    )
+
+
+def run_ensemble(
+    *,
+    members: int = 1024,
+    seed: int = 0,
+    chaos=None,
+    steps: int = 64,
+    fault: Optional[str] = None,
+    client_count: int = 2,
+    put_count: int = 1,
+    journal=None,
+    shrink: bool = True,
+    replay: bool = True,
+    max_replays: int = 3,
+    replay_deadline_sec: float = 8.0,
+    replay_quiesce_sec: float = 0.75,
+    max_journaled_failures: int = 32,
+    device=None,
+) -> EnsembleResult:
+    """Sweep ``members`` independent fault schedules over the compiled
+    ABD workload in one device dispatch; shrink and host-confirm the
+    best failing member.  ``chaos`` is the base ChaosSpec (object, dict,
+    or JSON string) each member's effective spec derives from;
+    ``fault`` forwards to the replicas (``"skip_ack"`` is the
+    known-violating workload).  See the module docstring for the
+    device→host bridge semantics."""
+    import jax
+
+    spec = chaos if isinstance(chaos, ChaosSpec) else ChaosSpec.from_json(chaos)
+    journal = as_journal(journal)
+    model = _abd_model(client_count, fault)
+    from ..models.abd_compiled import AbdCompiled
+    from ..parallel.simulation_tpu import build_walk
+
+    cm = AbdCompiled(model)
+    if not cm.ordered:
+        raise ValueError("the ensemble engine needs the ordered FIFO fabric")
+    props = model.properties()
+    from ..core.model import Expectation
+
+    always_idx = [
+        i for i, p in enumerate(props)
+        if p.expectation is Expectation.ALWAYS
+    ]
+
+    schedules = [
+        derive_schedule(seed, m, spec, steps) for m in range(members)
+    ]
+    params_np = _member_params(cm.pairs, schedules)
+
+    result = EnsembleResult(
+        members=members, steps=steps, seed=int(seed),
+        workload="abd", fault=fault,
+    )
+    if journal is not None:
+        journal.append(
+            "ensemble_start",
+            members=members, seed=int(seed), steps=steps,
+            workload="abd", fault=fault, client_count=client_count,
+            spec=spec.to_dict(),
+        )
+
+    dev = device or jax.devices()[0]
+    with jax.default_device(dev):
+        import jax.numpy as jnp
+
+        walk = build_walk(cm, props, steps, fault_hook=FateLaneHook(len(cm.pairs)))
+        batch = jax.jit(jax.vmap(walk))
+        keys = jax.vmap(
+            lambda w: jax.random.fold_in(jax.random.PRNGKey(int(seed)), w)
+        )(np.arange(members))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+
+        t0 = time.monotonic()
+        _trace, disc_dev, counted_dev, _appended, flag_dev = batch(keys, params)
+        disc = np.asarray(disc_dev)  # blocks: the dispatch is done here
+        elapsed = time.monotonic() - t0
+        counted = np.asarray(counted_dev)
+        if bool(np.asarray(flag_dev).any()):
+            raise RuntimeError(
+                "the model step kernel flagged an encoding-capacity "
+                "overflow during an ensemble sweep"
+            )
+
+        result.states_walked = int(counted.sum())
+        result.elapsed_sec = elapsed
+        result.schedules_per_sec = members / elapsed if elapsed > 0 else 0.0
+
+        # Failing members: any ALWAYS-property latch.
+        fail_step = np.full(members, NO_STEP, np.uint32)
+        fail_prop = np.full(members, -1, np.int32)
+        for p in always_idx:
+            col = disc[:, p]
+            better = col < fail_step
+            fail_prop = np.where(better, p, fail_prop)
+            fail_step = np.minimum(fail_step, col)
+        failing_members = np.flatnonzero(fail_step != NO_STEP)
+        if len(failing_members):
+            result.ttff_sec = round(elapsed, 3)
+        for mi in failing_members:
+            entry = {
+                "member": int(mi),
+                "seed": schedules[mi].seed,
+                "property": props[int(fail_prop[mi])].name,
+                "step": int(fail_step[mi]),
+            }
+            result.failing.append(entry)
+            if journal is not None and len(result.failing) <= max_journaled_failures:
+                journal.append("ensemble_failing", **entry)
+        if journal is not None:
+            journal.append(
+                "ensemble_sweep",
+                members=members,
+                failing=len(result.failing),
+                states=result.states_walked,
+                elapsed_sec=round(elapsed, 3),
+                schedules_per_sec=round(result.schedules_per_sec, 1),
+                ttff_sec=result.ttff_sec,
+            )
+        if not len(failing_members):
+            return result
+
+        # --- shrink the earliest-latching failing member ------------------
+        best = int(failing_members[np.argmin(fail_step[failing_members])])
+        best_prop = props[int(fail_prop[best])].name
+        sch = schedules[best]
+        single = jax.jit(walk)
+        best_key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), best)
+
+        def verify(candidate: EnsembleSchedule) -> bool:
+            """Re-run ONE member on device; True if it still fails."""
+            row_np = _member_params(cm.pairs, [candidate])
+            row = {k: jnp.asarray(v[0]) for k, v in row_np.items()}
+            _t, d, _c, _a, f = single(best_key, row)
+            if bool(np.asarray(f)):
+                return False
+            d = np.asarray(d)
+            return any(int(d[p]) != NO_STEP for p in always_idx)
+
+        if shrink:
+            # 1. Horizon prefix: the latch step bounds the needed walk.
+            cand = dataclasses.replace(sch, steps=int(fail_step[best]) + 1)
+            ok = verify(cand)
+            result.shrink_steps += 1
+            if journal is not None:
+                journal.append(
+                    "ensemble_shrink", member=best, candidate="prefix",
+                    steps=cand.steps, accepted=ok,
+                )
+            if ok:
+                sch = cand
+            # 2. Per-kind rate zeroing, device-verified.
+            for kind in _DEVICE_KINDS:
+                if not _spec_is_meaningful(sch.spec, kind):
+                    continue
+                cand = dataclasses.replace(sch, spec=_zero_kind(sch.spec, kind))
+                ok = verify(cand)
+                result.shrink_steps += 1
+                if journal is not None:
+                    journal.append(
+                        "ensemble_shrink", member=best, candidate=kind,
+                        accepted=ok,
+                    )
+                if ok:
+                    sch = cand
+
+    # --- host replay: confirmation + replay-verified shrink ----------------
+    def do_replay(candidate: EnsembleSchedule) -> dict:
+        return replay_schedule(
+            candidate,
+            fault=fault,
+            client_count=client_count,
+            put_count=put_count,
+            journal=journal,
+            deadline_sec=replay_deadline_sec,
+            quiesce_sec=replay_quiesce_sec,
+        )
+
+    repro_context = {
+        "workload": "abd",
+        "fault": fault,
+        "client_count": client_count,
+        "put_count": put_count,
+        "server_count": 2,
+        "property": best_prop,
+        "base_seed": int(seed),
+    }
+    if replay:
+        replays = 0
+        verdict = do_replay(sch)
+        replays += 1
+        rejected = not verdict["consistent"]
+        if journal is not None:
+            journal.append(
+                "ensemble_replay", member=best, seed=sch.seed,
+                consistent=verdict["consistent"],
+                violations=len(verdict.get("violations", [])),
+            )
+        if rejected and shrink:
+            # Replay-verified shrink for the kinds the device can't vouch
+            # for (they never mask a lane): duplicate, delay, partitions.
+            for kind in _REPLAY_KINDS:
+                if replays >= max_replays:
+                    break
+                if not _spec_is_meaningful(sch.spec, kind):
+                    continue
+                cand = dataclasses.replace(sch, spec=_zero_kind(sch.spec, kind))
+                v = do_replay(cand)
+                replays += 1
+                ok = not v["consistent"]
+                result.shrink_steps += 1
+                if journal is not None:
+                    journal.append(
+                        "ensemble_shrink", member=best, candidate=kind,
+                        accepted=ok,
+                    )
+                if ok:
+                    sch, verdict = cand, v
+            if replays < max_replays and sch.spec.partitions:
+                cand = dataclasses.replace(
+                    sch,
+                    spec=ChaosSpec(
+                        default=sch.spec.default, links=sch.spec.links,
+                        partitions=(),
+                    ),
+                    partition_at=-1, partition_heal=-1,
+                )
+                v = do_replay(cand)
+                replays += 1
+                ok = not v["consistent"]
+                result.shrink_steps += 1
+                if journal is not None:
+                    journal.append(
+                        "ensemble_shrink", member=best,
+                        candidate="partitions", accepted=ok,
+                    )
+                if ok:
+                    sch, verdict = cand, v
+        if rejected:
+            result.confirmed.append(
+                {
+                    "member": best,
+                    "seed": sch.seed,
+                    "property": best_prop,
+                    "invoked": verdict.get("invoked", 0),
+                    "returned": verdict.get("returned", 0),
+                    "violations": len(verdict.get("violations", [])),
+                    "fault_links": verdict.get("fault_links", {}),
+                }
+            )
+    result.repro = {**sch.to_repro(), **repro_context}
+    if journal is not None:
+        journal.append("ensemble_repro", **result.repro)
+    return result

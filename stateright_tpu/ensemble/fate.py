@@ -1,0 +1,157 @@
+"""Device implementation of the host fault-fate function.
+
+``runtime/chaos.py`` decides the fate of the n-th datagram on a directed
+link as a pure function of ``(seed, src, dst, n)``: a counter-mode
+splitmix64 finalizer evaluated at counter ``4n + k + 1`` over the link
+seed, top 32 bits kept (``fault_fate_u32``).  This module is the same
+function transcribed to uint32 limb arithmetic (TPUs have no u64 vector
+lanes — the (hi, lo) pair idiom of ``ops/device_fp.py``), so a vmapped
+ensemble step can evaluate the *identical* fault schedule the host
+``FaultyTransport`` would inject.  That bit-equality is the load-bearing
+bridge of the chaos-ensemble engine: any failing seed found on device
+replays exactly in the host transport + ``LiveAuditor`` path.
+
+Why the compare transfers exactly (the purity/rounding argument, also in
+docs/CHAOS_ENSEMBLES.md): the host draws are ``fate / 2**32`` — exact in
+float64, since dividing a 32-bit integer by a power of two only adjusts
+the exponent — and the host decision is ``draw < rate``.  For integer
+``fate``, ``fate / 2**32 < rate  ⟺  fate < ceil(rate * 2**32)``, and
+``rate * 2**32`` is itself exact in float64.  :func:`rate_threshold`
+computes that ceiling once on host; the device compares uint32 words.
+The one edge is ``ceil(rate * 2**32) == 2**32`` (rates within 2**-32 of
+1.0), which does not fit a uint32 threshold — ``rate_threshold`` returns
+a separate ``always`` flag for it.
+
+Partition windows are handled at a different layer: host windows are
+measured in elapsed *wall time* (explicitly excluded from the host
+reproducibility guarantee), so the ensemble engine assigns each member a
+deterministic step-indexed window instead and :func:`partition_cuts`
+evaluates the same group-crossing predicate ``Partition.cuts`` applies.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..runtime.chaos import (  # noqa: F401  (re-exported for callers)
+    FATE_DELAY,
+    FATE_DRAWS,
+    FATE_DROP,
+    FATE_DUPLICATE,
+    FATE_REORDER,
+    _link_rng_seed,
+)
+
+_U32 = jnp.uint32
+_MASK32 = 0xFFFFFFFF
+
+# splitmix64 constants, split into uint32 limbs.
+_GAMMA_HI, _GAMMA_LO = 0x9E3779B9, 0x7F4A7C15  # 0x9E3779B97F4A7C15
+_MIX1_HI, _MIX1_LO = 0xBF58476D, 0x1CE4E5B9  # 0xBF58476D1CE4E5B9
+_MIX2_HI, _MIX2_LO = 0x94D049BB, 0x133111EB  # 0x94D049BB133111EB
+
+
+def _mul32x32(a, b):
+    """Full 32x32 -> 64 product of uint32 arrays, as a (hi, lo) pair.
+
+    16-bit half decomposition; every intermediate fits (or harmlessly
+    wraps) in uint32."""
+    a = a.astype(_U32)
+    b = b.astype(_U32)
+    a_lo, a_hi = a & _U32(0xFFFF), a >> _U32(16)
+    b_lo, b_hi = b & _U32(0xFFFF), b >> _U32(16)
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    t = (ll >> _U32(16)) + (lh & _U32(0xFFFF)) + (hl & _U32(0xFFFF))
+    lo = (ll & _U32(0xFFFF)) | ((t & _U32(0xFFFF)) << _U32(16))
+    hi = hh + (lh >> _U32(16)) + (hl >> _U32(16)) + (t >> _U32(16))
+    return hi, lo
+
+
+def _add64(a_hi, a_lo, b_hi, b_lo):
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(_U32)
+    return a_hi + b_hi + carry, lo
+
+
+def _mul64_lo(a_hi, a_lo, b_hi, b_lo):
+    """Low 64 bits of a 64x64 product (hi limbs wrap, as mod-2**64 does)."""
+    hi, lo = _mul32x32(a_lo, b_lo)
+    hi = hi + a_lo * b_hi + a_hi * b_lo
+    return hi, lo
+
+
+def _xorshr64(hi, lo, r: int):
+    """``z ^ (z >> r)`` for 0 < r < 32 on a (hi, lo) pair."""
+    return hi ^ (hi >> _U32(r)), lo ^ ((lo >> _U32(r)) | (hi << _U32(32 - r)))
+
+
+def device_fault_fate(seed_hi, seed_lo, n, k):
+    """The fate word for draw ``k`` of datagram ``n`` on the link whose
+    64-bit seed is the ``(seed_hi, seed_lo)`` uint32 pair.
+
+    Bit-identical to ``runtime.chaos.fault_fate_u32(link_seed, n, k)``
+    for ``4n + k + 1 < 2**32`` (datagram indices far beyond any ensemble
+    horizon).  All arguments broadcast; returns uint32.
+    """
+    c = _U32(4) * jnp.asarray(n).astype(_U32) + jnp.asarray(k).astype(_U32) + _U32(1)
+    d_hi, d_lo = _mul32x32(c, _U32(_GAMMA_LO))
+    d_hi = d_hi + c * _U32(_GAMMA_HI)
+    z_hi, z_lo = _add64(
+        jnp.asarray(seed_hi).astype(_U32), jnp.asarray(seed_lo).astype(_U32),
+        d_hi, d_lo,
+    )
+    z_hi, z_lo = _xorshr64(z_hi, z_lo, 30)
+    z_hi, z_lo = _mul64_lo(z_hi, z_lo, _U32(_MIX1_HI), _U32(_MIX1_LO))
+    z_hi, z_lo = _xorshr64(z_hi, z_lo, 27)
+    z_hi, z_lo = _mul64_lo(z_hi, z_lo, _U32(_MIX2_HI), _U32(_MIX2_LO))
+    z_hi, _ = _xorshr64(z_hi, z_lo, 31)
+    return z_hi
+
+
+def link_seed_limbs(seed: int, src: int, dst: int) -> Tuple[int, int]:
+    """The host per-link seed (``runtime.chaos._link_rng_seed``) as the
+    (hi, lo) uint32 pair the device kernel consumes."""
+    s = _link_rng_seed(int(seed), src, dst)
+    return (s >> 32) & _MASK32, s & _MASK32
+
+
+def rate_threshold(rate: float) -> Tuple[int, bool]:
+    """``(threshold, always)`` such that the host decision
+    ``fate / 2**32 < rate`` equals ``always or fate < threshold`` for
+    every uint32 ``fate`` — the exact-rounding bridge (module docstring).
+
+    ``always`` covers rates within 2**-32 of 1.0, whose ceiling (2**32)
+    does not fit the uint32 threshold word."""
+    rate = float(rate)
+    if not 0.0 <= rate <= 1.0 or math.isnan(rate):
+        raise ValueError(f"fault rate must be in [0, 1]: {rate!r}")
+    thr = math.ceil(rate * 4294967296.0)  # exact: power-of-two multiply
+    if thr >= 1 << 32:
+        return 0, True
+    return int(thr), False
+
+
+def partition_cuts(src_group, dst_group, step, at_step, heal_step):
+    """Device transcription of ``Partition.cuts`` with step-indexed
+    windows: True where the window is active (``at_step <= step``, and
+    ``step < heal_step`` unless ``heal_step < 0`` meaning never-heal)
+    and src/dst sit in *different* groups (group id < 0 = in no group:
+    unaffected).  All arguments broadcast int32; returns bool."""
+    step = jnp.asarray(step).astype(jnp.int32)
+    at_step = jnp.asarray(at_step).astype(jnp.int32)
+    heal_step = jnp.asarray(heal_step).astype(jnp.int32)
+    src_group = jnp.asarray(src_group).astype(jnp.int32)
+    dst_group = jnp.asarray(dst_group).astype(jnp.int32)
+    active = (step >= at_step) & ((heal_step < 0) | (step < heal_step))
+    return (
+        active
+        & (src_group >= 0)
+        & (dst_group >= 0)
+        & (src_group != dst_group)
+    )

@@ -213,9 +213,15 @@ class AbdActor(Actor):
 
 @dataclass
 class AbdModelCfg:
+    """``fault`` forwards to every replica's :class:`AbdActor` —
+    ``"skip_ack"`` builds the deliberately-broken cluster the chaos
+    ensemble (``stateright_tpu.ensemble``) sweeps for failing fault
+    schedules; the compiled codec mirrors the same hook on device."""
+
     client_count: int
     server_count: int
     network: Network
+    fault: Optional[str] = None
 
     def into_model(self) -> ActorModel:
         def value_chosen(_m, state):
@@ -228,7 +234,9 @@ class AbdModelCfg:
             cfg=self, init_history=LinearizabilityTester(Register(NULL_VALUE))
         )
         model.add_actors(
-            RegisterServer(AbdActor(model_peers(i, self.server_count)))
+            RegisterServer(
+                AbdActor(model_peers(i, self.server_count), fault=self.fault)
+            )
             for i in range(self.server_count)
         )
         model.add_actors(
@@ -362,6 +370,7 @@ def cli_spec():
         tpu=True,
         tpu_kwargs=dict(capacity=1 << 13, max_frontier=1 << 8),
         spawn=spawn_servers,
+        ensemble=True,
     )
 
 

@@ -95,6 +95,9 @@ class AbdCompiled(CompiledModel):
             raise ValueError(
                 "packed ABD supports lossless, crash-free configurations"
             )
+        self.fault = getattr(cfg, "fault", None)
+        if self.fault not in (None, "skip_ack"):
+            raise ValueError(f"unknown AbdActor fault: {self.fault!r}")
         if model.init_network.kind not in (
             "unordered_nonduplicating",
             "ordered",
@@ -150,7 +153,7 @@ class AbdCompiled(CompiledModel):
         self.values = self.rc.values
 
     def cache_key(self):
-        return (type(self).__qualname__, self.c, self.ordered)
+        return (type(self).__qualname__, self.c, self.ordered, self.fault)
 
     # --- small-code helpers ---------------------------------------------------
 
@@ -653,6 +656,23 @@ class AbdCompiled(CompiledModel):
         for s in range(S):
             prec = ins(prec, _ACKS0 + s, 1, u(0))
         pg_s0 = mk(_T_QUERY, me * u(4) + peer, pg_rid)
+        pg_flag = jnp.zeros((), jnp.bool_)
+        if self.fault == "skip_ack":
+            # Broken replica (models/abd.py:104-113): acknowledge Put/Get
+            # immediately from local state — no quorum phases, the phase
+            # field untouched, and the guard unconditional (the host
+            # branch precedes the phase-is-None check).
+            pg_guard = occupied
+            new_clock = seq // u(S) + u(1)
+            put_rec = ins(rec, *_F_SEQ, new_clock * u(S) + me)
+            put_rec = ins(put_rec, *_F_VAL, pg_ci + u(1))  # values[ci] code
+            prec = jnp.where(pg_is_get, rec, put_rec)
+            pg_s0 = jnp.where(
+                pg_is_get,
+                mk(_T_GETOK, me * u(4) + pg_ci, val),
+                mk(_T_PUTOK, me * u(4) + pg_ci, u(0)),
+            )
+            pg_flag = ~pg_is_get & (new_clock > u(MAX_CLOCK))
 
         # --- Query (models/abd.py:105-107): reply, state unchanged -----------
         q_guard = occupied  # always answered
@@ -817,7 +837,10 @@ class AbdCompiled(CompiledModel):
             ],
             u(0),
         )
-        branch_flag = sel([(_T_ACKQUERY, aq_flag)], jnp.zeros((), jnp.bool_))
+        branch_flag = sel(
+            [(_T_ACKQUERY, aq_flag), (_T_PUT, pg_flag)],
+            jnp.zeros((), jnp.bool_),
+        )
         s0 = jnp.where(valid, s0, u(0))
         return valid, dsrv, srv_new, cli_f, tw_f, s0, branch_flag, ci
 

@@ -192,6 +192,14 @@ class PaxosModelCfg:
     # property-violating variant BASELINE.md's time-to-first-violation
     # metric is measured on.
     never_decided: bool = False
+    # Ballot-round boundary: states where any server's ballot round
+    # exceeds this are pruned (None = bounded only by the packed
+    # encoding's MAX_ROUND cap, paxos_compiled.py).  Raising it is a
+    # monotone reachable-set widening — every in-bound state keeps its
+    # transitions and the boundary admits a superset — which the
+    # compiled codec declares to the incremental store
+    # (PaxosCompiled.spec_widens, docs/INCREMENTAL.md).
+    max_round: Optional[int] = None
 
     def into_model(self) -> ActorModel:
         def value_chosen(_m, state):
@@ -229,6 +237,18 @@ class PaxosModelCfg:
                 lambda _m, s: not any(
                     getattr(a, "is_decided", False) for a in s.actor_states
                 ),
+            )
+        if self.max_round is not None:
+            # Host half of the round boundary; the device half is
+            # PaxosCompiled.boundary, which reads the same per-server
+            # ballot rounds from the packed record so host BFS and the
+            # TPU engine prune identically.
+            model.within_boundary_(
+                lambda cfg, s: all(
+                    a.ballot[0] <= cfg.max_round
+                    for a in s.actor_states
+                    if hasattr(a, "ballot")
+                )
             )
 
         def _compiled():

@@ -86,6 +86,13 @@ class PaxosCompiled(CompiledModel):
             raise ValueError(
                 "packed paxos supports the unordered_nonduplicating network"
             )
+        mr = getattr(cfg, "max_round", None)
+        self.max_round = MAX_ROUND if mr is None else int(mr)
+        if not 0 <= self.max_round <= MAX_ROUND:
+            raise ValueError(
+                f"max_round {self.max_round} outside 0..{MAX_ROUND} "
+                "(the 4-bit ballot-round encoding cap)"
+            )
         self.c = cfg.client_count
         # In-flight envelope budget: observed peaks are 10 (c=2) and < 32
         # (c=3); larger bench configs (check 4/6, bench.sh:28) get 64 slots
@@ -118,7 +125,67 @@ class PaxosCompiled(CompiledModel):
         )
 
     def cache_key(self):
-        return (type(self).__qualname__, self.c, self.model.cfg.never_decided)
+        return (
+            type(self).__qualname__,
+            self.c,
+            self.model.cfg.never_decided,
+            self.max_round,
+        )
+
+    def boundary(self, state):
+        """Device half of the ``max_round`` ballot boundary: a state is
+        in bounds iff every server's ballot round (bits 0..5 of its
+        record's low word, code = round*S + leader) is <= the bound.
+        None at the encoding cap — the default model stays unbounded
+        and its traced programs (and .jax_cache entries) byte-identical
+        to the boundary-free build."""
+        if self.max_round >= MAX_ROUND:
+            return None
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        ok = jnp.bool_(True)
+        for s in range(S):
+            code = state[2 * s] & u(0x3F)
+            ok = ok & ((code // u(S)) <= u(self.max_round))
+        return ok
+
+    def spec_constants(self):
+        """Explicit constants declaration for the incremental store
+        (the wrapped ActorModel is not a dataclass, so the default
+        would return None and the store would refuse every reuse
+        path).  ``max_round`` is normalized (None -> MAX_ROUND) so an
+        explicit cap equal to the encoding cap hashes like the
+        unbounded default it behaves as."""
+        cfg = self.model.cfg
+        return {
+            "client_count": repr(cfg.client_count),
+            "server_count": repr(cfg.server_count),
+            "network": self.model.init_network.kind,
+            "never_decided": repr(bool(cfg.never_decided)),
+            "max_round": repr(self.max_round),
+        }
+
+    def spec_widens(self, old_constants: dict) -> bool:
+        """Raising ``max_round`` only ever ADDS reachable states: every
+        in-bound state keeps its packed row and its transitions, and
+        the boundary admits a superset — the store's constant-widening
+        contract (docs/INCREMENTAL.md).  Every other constant must be
+        unchanged: they alter the transition relation (client_count,
+        network) or the property set (never_decided), never a monotone
+        widening."""
+        mine = self.spec_constants()
+        if set(old_constants) != set(mine):
+            return False
+        try:
+            old_round = int(str(old_constants["max_round"]))
+        except (TypeError, ValueError):
+            return False
+        return old_round <= self.max_round and all(
+            str(old_constants[k]) == mine[k]
+            for k in mine
+            if k != "max_round"
+        )
 
     # --- small-code helpers --------------------------------------------------
 

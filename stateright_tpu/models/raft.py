@@ -370,6 +370,12 @@ class RaftModelCfg:
 
     server_count: int = 3
     network: Network = None
+    # Crash budget (None = the reference default, (n-1)//2).  Raising
+    # it only adds Crash/Recover action families — every smaller-budget
+    # state keeps its transitions — so the compiled codec declares the
+    # raise a monotone reachable-set widening to the incremental store
+    # (RaftCompiled.spec_widens, docs/INCREMENTAL.md).
+    max_crashes: Optional[int] = None
 
     def into_model(self) -> ActorModel:
         network = (
@@ -410,7 +416,11 @@ class RaftModelCfg:
         model.compiled = _compiled
         model = (
             model.init_network_(network)
-            .max_crashes_((self.server_count - 1) // 2)
+            .max_crashes_(
+                (self.server_count - 1) // 2
+                if self.max_crashes is None
+                else self.max_crashes
+            )
             .property(
                 Expectation.SOMETIMES,
                 "Election Liveness",

@@ -127,6 +127,39 @@ class RaftCompiled(CompiledModel):
     def cache_key(self):
         return (type(self).__qualname__, self.max_crashes)
 
+    def spec_constants(self):
+        """Explicit constants declaration for the incremental store
+        (the wrapped ActorModel is not a dataclass, so the default
+        would return None and the store would refuse every reuse
+        path)."""
+        return {
+            "server_count": repr(N),
+            "max_crashes": repr(self.max_crashes),
+            "network": self.model.init_network.kind,
+        }
+
+    def spec_widens(self, old_constants: dict) -> bool:
+        """Raising the crash budget only ever ADDS reachable states:
+        the Crash lane is gated on ``n_crashed < max_crashes`` and
+        Recover only fires from crashed states, so every
+        smaller-budget state keeps its packed row and its transitions
+        while new crash interleavings appear — the store's
+        constant-widening contract (docs/INCREMENTAL.md).  The other
+        constants alter the transition relation and must be
+        unchanged."""
+        mine = self.spec_constants()
+        if set(old_constants) != set(mine):
+            return False
+        try:
+            old_budget = int(str(old_constants["max_crashes"]))
+        except (TypeError, ValueError):
+            return False
+        return old_budget <= self.max_crashes and all(
+            str(old_constants[k]) == mine[k]
+            for k in mine
+            if k != "max_crashes"
+        )
+
     # --- node record ----------------------------------------------------------
 
     def _encode_node(self, s: NodeState) -> int:

@@ -160,6 +160,33 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
         if not out["audit_consistent"]:
             out["warnings"].append("audit-inconsistent")
 
+    # Chaos-ensemble journals (ensemble/engine.py,
+    # docs/CHAOS_ENSEMBLES.md): members swept, failing seeds, shrink
+    # progress, and whether a repro landed.  In an ensemble journal an
+    # INCONSISTENT replay audit is the *goal* (the host confirming a
+    # device-found failing seed), so the audit warning is withdrawn and
+    # the repro badge speaks instead.
+    starts = [e for e in events if e.get("event") == "ensemble_start"]
+    if starts:
+        out["ensemble_members"] = starts[-1].get("members")
+        sweeps = [e for e in events if e.get("event") == "ensemble_sweep"]
+        if sweeps:
+            out["ensemble_failing"] = sweeps[-1].get("failing")
+            if sweeps[-1].get("schedules_per_sec") is not None:
+                out["schedules_per_sec"] = sweeps[-1]["schedules_per_sec"]
+        shrinks = [e for e in events if e.get("event") == "ensemble_shrink"]
+        if shrinks:
+            out["ensemble_shrinks"] = len(shrinks)
+            out["ensemble_shrinks_accepted"] = sum(
+                1 for e in shrinks if e.get("accepted")
+            )
+        if any(e.get("event") == "ensemble_repro" for e in events):
+            out["ensemble_repro"] = True
+            out["done"] = True
+        out["warnings"] = [
+            w for w in out["warnings"] if w != "audit-inconsistent"
+        ]
+
     # Service journals: job counts by their latest lifecycle event.
     job_state: dict = {}
     for e in events:
@@ -335,10 +362,21 @@ def render_line(s: dict) -> str:
         parts.append(f"faults={s['chaos_faults']}")
     if s.get("spans"):
         parts.append(f"spans={s['spans']}")
-    if "audit_consistent" in s:
+    if "audit_consistent" in s and "ensemble_members" not in s:
         parts.append(
             "audit=ok" if s["audit_consistent"] else "audit=INCONSISTENT"
         )
+    if "ensemble_members" in s:
+        parts.append(f"members={_fmt(s['ensemble_members'])}")
+        parts.append(f"failing={_fmt(s.get('ensemble_failing'))}")
+        parts.append(f"sched/s={_fmt(s.get('schedules_per_sec'))}")
+        if "ensemble_shrinks" in s:
+            parts.append(
+                f"shrinks={s.get('ensemble_shrinks_accepted', 0)}"
+                f"/{s['ensemble_shrinks']}"
+            )
+        if s.get("ensemble_repro"):
+            parts.append("repro=journaled")
     if "recheck" in s:
         parts.append(f"recheck={s['recheck']}")
     if s.get("verdict_hits"):

@@ -46,6 +46,203 @@ from .compiled import CompiledModel, compiled_model_for
 NO_STEP = 0xFFFFFFFF
 
 
+def build_walk(compiled, properties, t_max: int, fault_hook=None):
+    """One bounded random-trace walk as a pure device function — the
+    loop body shared between the Monte-Carlo checker (below, no hook)
+    and the chaos-ensemble engine (``ensemble/engine.py``), which
+    supplies a ``fault_hook`` masking deliverable lanes by each
+    member's fault schedule.
+
+    Hook contract (both methods traced inside the jitted walk):
+
+    - ``fault_hook.init(params)`` -> a carry pytree (per-walk arrays,
+      e.g. per-link datagram counters);
+    - ``fault_hook.apply(t, state, valid, carry, params)`` ->
+      ``(valid, carry)`` — runs after the step kernel's valid mask and
+      before the uniform lane choice, so masked lanes are never
+      selected (a fully-masked step ends the trace as terminal).
+
+    With ``fault_hook=None`` the emitted program is the checker's
+    original walk, unchanged, and the returned callable takes ``key``
+    alone; with a hook it takes ``(key, params)`` and both are vmapped
+    by the caller.
+
+    Returns ``walk -> (trace, disc, counted, appended, flag)`` where
+    ``disc[p]`` is the trace index of property ``p``'s first discovery
+    (``NO_STEP`` if none), ``counted`` the states this walk counted,
+    ``appended`` the trace length, and ``flag`` the step kernel's
+    encoding-overflow alarm.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.model import Expectation
+    from ..ops.device_fp import device_fp64
+
+    cm = compiled
+    props = properties
+    n_props = len(props)
+    ev_indices = [
+        i
+        for i, p in enumerate(props)
+        if p.expectation is Expectation.EVENTUALLY
+    ]
+    always_idx = {
+        i for i, p in enumerate(props) if p.expectation is Expectation.ALWAYS
+    }
+    sometimes_idx = {
+        i
+        for i, p in enumerate(props)
+        if p.expectation is Expectation.SOMETIMES
+    }
+    eb0 = (1 << len(ev_indices)) - 1
+    has_flags = getattr(cm, "step_flags", False)
+
+    init = cm.init_packed()
+    n_init = init.shape[0]
+    init_dev = jnp.asarray(init)
+    has_boundary = cm.boundary(init_dev[0]) is not None
+
+    def walk(key, params=None):
+        u = jnp.uint32
+        key, sub = jax.random.split(key)
+        state0 = init_dev[jax.random.randint(sub, (), 0, n_init)]
+        hook_carry = fault_hook.init(params) if fault_hook is not None else ()
+
+        def body(t, carry):
+            (
+                state,
+                fps_hi,
+                fps_lo,
+                trace,
+                ebits,
+                disc,
+                done,
+                counted,
+                appended,
+                flag,
+                hook_carry,
+                key,
+            ) = carry
+            active = ~done
+            if has_boundary:
+                in_bound = cm.boundary(state)
+            else:
+                in_bound = jnp.ones((), jnp.bool_)
+            end_boundary = active & ~in_bound
+
+            hi, lo = device_fp64(state[: cm.fp_words or cm.state_width])
+            seen = jnp.any(
+                (fps_hi == hi)
+                & (fps_lo == lo)
+                & (jnp.arange(t_max, dtype=u) < appended)
+            )
+            do_append = active & ~end_boundary
+            idx = jnp.where(do_append, appended, u(t_max))
+            fps_hi = fps_hi.at[idx].set(hi, mode="drop")
+            fps_lo = fps_lo.at[idx].set(lo, mode="drop")
+            trace = trace.at[idx].set(state, mode="drop")
+            appended = appended + do_append.astype(u)
+            end_cycle = do_append & seen
+            count_this = do_append & ~seen
+            counted = counted + count_this.astype(u)
+
+            conds = cm.property_conds(state)
+            here = appended - u(1)  # index of this state's fp
+            for p in range(n_props):
+                if p in always_idx:
+                    hit = count_this & ~conds[p]
+                elif p in sometimes_idx:
+                    hit = count_this & conds[p]
+                else:
+                    continue
+                cand = jnp.where(hit, here, u(NO_STEP))
+                disc = disc.at[p].set(
+                    jnp.where(disc[p] == u(NO_STEP), cand, disc[p])
+                )
+            for bit, p in enumerate(ev_indices):
+                ebits = ebits & ~(
+                    (count_this & conds[p]).astype(u) << bit
+                )
+
+            if has_flags:
+                nexts, valid, sf = cm.step(state)
+                flag = flag | (sf & count_this)
+            else:
+                nexts, valid = cm.step(state)
+            valid = valid & count_this
+            if fault_hook is not None:
+                valid, hook_carry = fault_hook.apply(
+                    t, state, valid, hook_carry, params
+                )
+            v = jnp.sum(valid, dtype=u)
+            terminal = count_this & (v == u(0))
+            key, sub = jax.random.split(key)
+            j = jax.random.randint(sub, (), 0, jnp.maximum(v, u(1)))
+            lane = jnp.argmax(jnp.cumsum(valid.astype(u)) == j + u(1))
+            advance = count_this & (v > u(0))
+            state = jnp.where(advance, nexts[lane], state)
+            done = done | end_boundary | end_cycle | terminal
+            return (
+                state,
+                fps_hi,
+                fps_lo,
+                trace,
+                ebits,
+                disc,
+                done,
+                counted,
+                appended,
+                flag,
+                hook_carry,
+                key,
+            )
+
+        carry = (
+            state0,
+            jnp.zeros((t_max,), jnp.uint32),
+            jnp.zeros((t_max,), jnp.uint32),
+            jnp.zeros((t_max, cm.state_width), jnp.uint32),
+            jnp.uint32(eb0),
+            jnp.full((n_props,), NO_STEP, jnp.uint32),
+            jnp.zeros((), jnp.bool_),
+            jnp.uint32(0),
+            jnp.uint32(0),
+            jnp.zeros((), jnp.bool_),
+            hook_carry,
+            key,
+        )
+        (
+            _state,
+            fps_hi,
+            fps_lo,
+            trace,
+            ebits,
+            disc,
+            done,
+            counted,
+            appended,
+            flag,
+            _hook_carry,
+            _key,
+        ) = jax.lax.fori_loop(0, t_max, body, carry)
+
+        # Trace truncated by the depth bound (never ended): skip the
+        # leftover-eventually check, like the host's ended_by_depth.
+        u = jnp.uint32
+        for bit, p in enumerate(ev_indices):
+            left = done & (((ebits >> bit) & u(1)) == u(1))
+            cand = jnp.where(left, appended - u(1), u(NO_STEP))
+            disc = disc.at[p].set(
+                jnp.where(disc[p] == u(NO_STEP), cand, disc[p])
+            )
+        return trace, disc, counted, appended, flag
+
+    if fault_hook is None:
+        return lambda key: walk(key)
+    return walk
+
+
 class TpuSimulationChecker(Checker):
     """Monte-carlo checker running ``walkers`` traces per device batch."""
 
@@ -99,159 +296,9 @@ class TpuSimulationChecker(Checker):
 
     def _build_batch(self):
         import jax
-        import jax.numpy as jnp
 
-        from ..ops.device_fp import device_fp64
-
-        cm = self._compiled
-        props = self._properties
-        n_props = len(props)
-        ev_indices = self._ev_indices
-        t_max = self._t
-        always_idx = {
-            i for i, p in enumerate(props) if p.expectation is Expectation.ALWAYS
-        }
-        sometimes_idx = {
-            i
-            for i, p in enumerate(props)
-            if p.expectation is Expectation.SOMETIMES
-        }
-        eb0 = (1 << len(ev_indices)) - 1
-        has_flags = getattr(cm, "step_flags", False)
-
-        init = cm.init_packed()
-        n_init = init.shape[0]
-        init_dev = jnp.asarray(init)
-        has_boundary = cm.boundary(init_dev[0]) is not None
-
-        def walk(key):
-            u = jnp.uint32
-            key, sub = jax.random.split(key)
-            state0 = init_dev[jax.random.randint(sub, (), 0, n_init)]
-
-            def body(t, carry):
-                (
-                    state,
-                    fps_hi,
-                    fps_lo,
-                    trace,
-                    ebits,
-                    disc,
-                    done,
-                    counted,
-                    appended,
-                    flag,
-                    key,
-                ) = carry
-                active = ~done
-                if has_boundary:
-                    in_bound = cm.boundary(state)
-                else:
-                    in_bound = jnp.ones((), jnp.bool_)
-                end_boundary = active & ~in_bound
-
-                hi, lo = device_fp64(state[: cm.fp_words or cm.state_width])
-                seen = jnp.any(
-                    (fps_hi == hi)
-                    & (fps_lo == lo)
-                    & (jnp.arange(t_max, dtype=u) < appended)
-                )
-                do_append = active & ~end_boundary
-                idx = jnp.where(do_append, appended, u(t_max))
-                fps_hi = fps_hi.at[idx].set(hi, mode="drop")
-                fps_lo = fps_lo.at[idx].set(lo, mode="drop")
-                trace = trace.at[idx].set(state, mode="drop")
-                appended = appended + do_append.astype(u)
-                end_cycle = do_append & seen
-                count_this = do_append & ~seen
-                counted = counted + count_this.astype(u)
-
-                conds = cm.property_conds(state)
-                here = appended - u(1)  # index of this state's fp
-                for p in range(n_props):
-                    if p in always_idx:
-                        hit = count_this & ~conds[p]
-                    elif p in sometimes_idx:
-                        hit = count_this & conds[p]
-                    else:
-                        continue
-                    cand = jnp.where(hit, here, u(NO_STEP))
-                    disc = disc.at[p].set(
-                        jnp.where(disc[p] == u(NO_STEP), cand, disc[p])
-                    )
-                for bit, p in enumerate(ev_indices):
-                    ebits = ebits & ~(
-                        (count_this & conds[p]).astype(u) << bit
-                    )
-
-                if has_flags:
-                    nexts, valid, sf = cm.step(state)
-                    flag = flag | (sf & count_this)
-                else:
-                    nexts, valid = cm.step(state)
-                valid = valid & count_this
-                v = jnp.sum(valid, dtype=u)
-                terminal = count_this & (v == u(0))
-                key, sub = jax.random.split(key)
-                j = jax.random.randint(sub, (), 0, jnp.maximum(v, u(1)))
-                lane = jnp.argmax(jnp.cumsum(valid.astype(u)) == j + u(1))
-                advance = count_this & (v > u(0))
-                state = jnp.where(advance, nexts[lane], state)
-                done = done | end_boundary | end_cycle | terminal
-                return (
-                    state,
-                    fps_hi,
-                    fps_lo,
-                    trace,
-                    ebits,
-                    disc,
-                    done,
-                    counted,
-                    appended,
-                    flag,
-                    key,
-                )
-
-            carry = (
-                state0,
-                jnp.zeros((t_max,), jnp.uint32),
-                jnp.zeros((t_max,), jnp.uint32),
-                jnp.zeros((t_max, cm.state_width), jnp.uint32),
-                jnp.uint32(eb0),
-                jnp.full((n_props,), NO_STEP, jnp.uint32),
-                jnp.zeros((), jnp.bool_),
-                jnp.uint32(0),
-                jnp.uint32(0),
-                jnp.zeros((), jnp.bool_),
-                key,
-            )
-            (
-                _state,
-                fps_hi,
-                fps_lo,
-                trace,
-                ebits,
-                disc,
-                done,
-                counted,
-                appended,
-                flag,
-                _key,
-            ) = jax.lax.fori_loop(0, t_max, body, carry)
-
-            # Trace truncated by the depth bound (never ended): skip the
-            # leftover-eventually check, like the host's ended_by_depth.
-            u = jnp.uint32
-            for bit, p in enumerate(ev_indices):
-                left = done & (((ebits >> bit) & u(1)) == u(1))
-                cand = jnp.where(left, appended - u(1), u(NO_STEP))
-                disc = disc.at[p].set(
-                    jnp.where(disc[p] == u(NO_STEP), cand, disc[p])
-                )
-            return trace, disc, counted, appended, flag
-
-        batch = jax.jit(jax.vmap(walk))
-        return batch
+        walk = build_walk(self._compiled, self._properties, self._t)
+        return jax.jit(jax.vmap(walk))
 
     # --- host loop -----------------------------------------------------------
 

@@ -43,13 +43,53 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
-import random as _random_mod
-
 from ..actor.ids import Id
 from ..actor.transport import Endpoint, Transport
 from .journal import Journal, as_journal
 
 _MASK64 = (1 << 64) - 1
+
+# The four per-datagram draws, in their fixed order (the schedule for
+# datagram n must never shift with timing): drop, reorder, duplicate,
+# delay.  The indices are shared with the device fate kernel
+# (ensemble/fate.py), which evaluates the same counter positions.
+FATE_DROP, FATE_REORDER, FATE_DUPLICATE, FATE_DELAY = range(4)
+FATE_DRAWS = 4
+
+_SPLITMIX_GAMMA = 0x9E3779B97F4A7C15
+
+
+def fault_fate_u32(link_seed: int, n: int, k: int) -> int:
+    """The fate word: a uniform uint32 deciding draw ``k`` (one of the
+    four ``FATE_*`` positions) for the ``n``-th datagram on the link
+    whose seed is ``link_seed`` (:func:`_link_rng_seed`).
+
+    Counter-mode splitmix64 — the finalizer evaluated at counter
+    ``4n + k + 1`` over the link seed, top 32 bits kept.  There is no
+    sequential generator state, so the same function is implementable
+    as uint32 limb arithmetic inside a vmapped device step
+    (``ensemble/fate.py``) and matches this transport bit-for-bit:
+    the load-bearing bridge that lets a device-found failing fault
+    schedule replay exactly in the host transport."""
+    z = (int(link_seed) + (4 * int(n) + int(k) + 1) * _SPLITMIX_GAMMA) & _MASK64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+    z = z ^ (z >> 31)
+    return z >> 32
+
+
+def fault_draws(link_seed: int, n: int) -> Tuple[float, float, float, float]:
+    """The four unit-interval draws ``(drop, reorder, duplicate,
+    delay)`` for datagram ``n``: each is ``fate / 2**32`` — exact in
+    float64 — so the host comparison ``draw < rate`` is bit-equivalent
+    to the device threshold compare ``fate < ceil(rate * 2**32)``
+    (``ensemble/fate.py.rate_threshold`` proves the rounding out)."""
+    return (
+        fault_fate_u32(link_seed, n, FATE_DROP) / 4294967296.0,
+        fault_fate_u32(link_seed, n, FATE_REORDER) / 4294967296.0,
+        fault_fate_u32(link_seed, n, FATE_DUPLICATE) / 4294967296.0,
+        fault_fate_u32(link_seed, n, FATE_DELAY) / 4294967296.0,
+    )
 
 
 # --- chaos specification -----------------------------------------------------
@@ -90,6 +130,10 @@ _FAULT_KEYS = ("drop", "duplicate", "reorder", "delay")
 
 
 def _parse_faults(d: dict, where: str) -> LinkFaults:
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"chaos {where} must be an object of fault rates: {d!r}"
+        )
     unknown = set(d) - set(_FAULT_KEYS)
     if unknown:
         raise ValueError(f"unknown chaos fault key(s) in {where}: {sorted(unknown)}")
@@ -111,6 +155,60 @@ def _parse_faults(d: dict, where: str) -> LinkFaults:
     if lo < 0 or hi < lo:
         raise ValueError(f"chaos {where}.delay must satisfy 0 <= lo <= hi: {delay!r}")
     return LinkFaults(delay=(lo, hi), **rates)
+
+
+def _parse_partition(p, where: str) -> Partition:
+    """One partition window; every malformed shape raises a single
+    ``ValueError`` naming the offending key path (``partitions[i].at``
+    etc.), never a raw ``KeyError``/``TypeError``."""
+    if not isinstance(p, dict):
+        raise ValueError(f"chaos {where} must be an object: {p!r}")
+    unknown = set(p) - {"at", "heal", "groups"}
+    if unknown:
+        raise ValueError(
+            f"unknown chaos key(s) in {where}: {sorted(unknown)}"
+        )
+    missing = [k for k in ("at", "groups") if k not in p]
+    if missing:
+        raise ValueError(
+            f"chaos {where} needs {'/'.join(missing)} "
+            f"(at/groups + optional heal): {p!r}"
+        )
+    try:
+        at = float(p["at"])
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"chaos {where}.at must be seconds: {p['at']!r}"
+        ) from None
+    try:
+        heal = None if p.get("heal") is None else float(p["heal"])
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"chaos {where}.heal must be seconds or null: {p['heal']!r}"
+        ) from None
+    raw_groups = p["groups"]
+    if not isinstance(raw_groups, (list, tuple)):
+        raise ValueError(
+            f"chaos {where}.groups must be an array of id arrays: "
+            f"{raw_groups!r}"
+        )
+    groups = []
+    for j, g in enumerate(raw_groups):
+        if not isinstance(g, (list, tuple)):
+            raise ValueError(
+                f"chaos {where}.groups[{j}] must be an array of actor "
+                f"ids: {g!r}"
+            )
+        try:
+            groups.append(frozenset(int(x) for x in g))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"chaos {where}.groups[{j}] must contain integer actor "
+                f"ids: {g!r}"
+            ) from None
+    if heal is not None and heal < at:
+        raise ValueError(f"chaos {where}: heal < at: {p!r}")
+    return Partition(at, heal, tuple(groups))
 
 
 @dataclass(frozen=True)
@@ -149,8 +247,14 @@ class ChaosSpec:
                 '"default", not both'
             )
         default = _parse_faults(top or obj.get("default", {}) or {}, "default")
+        links_obj = obj.get("links") or {}
+        if not isinstance(links_obj, dict):
+            raise ValueError(
+                'chaos links must be an object of "SRC->DST" keys: '
+                f"{links_obj!r}"
+            )
         links = []
-        for key, d in (obj.get("links") or {}).items():
+        for key, d in links_obj.items():
             try:
                 src_s, dst_s = str(key).split("->")
                 link = (int(src_s), int(dst_s))
@@ -159,24 +263,14 @@ class ChaosSpec:
                     f'chaos links key must look like "SRC->DST": {key!r}'
                 ) from None
             links.append((link, _parse_faults(d or {}, f"links[{key}]")))
+        parts_obj = obj.get("partitions") or ()
+        if not isinstance(parts_obj, (list, tuple)):
+            raise ValueError(
+                f"chaos partitions must be an array: {parts_obj!r}"
+            )
         partitions = []
-        for i, p in enumerate(obj.get("partitions") or ()):
-            if not isinstance(p, dict):
-                raise ValueError(f"chaos partitions[{i}] must be an object: {p!r}")
-            try:
-                at = float(p["at"])
-                heal = None if p.get("heal") is None else float(p["heal"])
-                groups = tuple(
-                    frozenset(int(x) for x in g) for g in p["groups"]
-                )
-            except (KeyError, TypeError, ValueError):
-                raise ValueError(
-                    f"chaos partitions[{i}] needs at/groups "
-                    f"(+ optional heal): {p!r}"
-                ) from None
-            if heal is not None and heal < at:
-                raise ValueError(f"chaos partitions[{i}]: heal < at: {p!r}")
-            partitions.append(Partition(at, heal, groups))
+        for i, p in enumerate(parts_obj):
+            partitions.append(_parse_partition(p, f"partitions[{i}]"))
         return ChaosSpec(
             default=default,
             links=tuple(sorted(links)),
@@ -248,10 +342,10 @@ def _link_rng_seed(seed: int, src: Id, dst: Id) -> int:
 
 
 class _LinkState:
-    __slots__ = ("rng", "n", "held")
+    __slots__ = ("link_seed", "n", "held")
 
     def __init__(self, seed: int, src: Id, dst: Id):
-        self.rng = _random_mod.Random(_link_rng_seed(seed, src, dst))
+        self.link_seed = _link_rng_seed(seed, src, dst)
         self.n = 0  # datagrams sent on this link so far
         self.held: List[bytes] = []  # reorder buffer
 
@@ -376,13 +470,13 @@ class FaultyTransport(Transport):
                 ls = self._links[link] = _LinkState(self.seed, src, dst)
             n = ls.n
             ls.n += 1
-            rng = ls.rng
-            # Always draw all four, in a fixed order: the schedule for
-            # datagram n is a pure function of (seed, link, n).
-            r_drop = rng.random()
-            r_reorder = rng.random()
-            r_dup = rng.random()
-            r_delay = rng.random()
+            # All four draws, at fixed counter positions: the schedule
+            # for datagram n is a pure function of (seed, link, n) — and
+            # counter-mode, so the device fate kernel (ensemble/fate.py)
+            # reproduces each draw without host generator state.
+            r_drop, r_reorder, r_dup, r_delay = fault_draws(
+                ls.link_seed, n
+            )
             faults = self.spec.faults_for(src, dst)
             elapsed = time.monotonic() - self._start
             if any(

@@ -78,6 +78,7 @@ def diversify(
     base_kwargs: dict,
     symmetry_capable: bool = False,
     include_simulation: bool = True,
+    winning_seeds: Optional[List[int]] = None,
 ) -> List[MemberConfig]:
     """The deterministic member set for one portfolio.
 
@@ -85,12 +86,22 @@ def diversify(
     package): dedup/probe geometry, frontier chunk size, device symmetry
     reduction on/off, and seeded simulation walkers vs exhaustive
     search.  Everything derives from ``random.Random(seed)`` — same
-    seed, same portfolio."""
+    seed, same portfolio.
+
+    ``winning_seeds`` folds failure-finding seeds from a chaos-ensemble
+    sweep (``ensemble/engine.py``) into the swarm: the first simulation
+    members take the listed seeds (masked to the 31-bit walker-seed
+    range) in order instead of their derived draws.  Determinism is
+    preserved — the result is still a pure function of the arguments —
+    and the derived-seed stream still advances for every simulation
+    member, so members beyond the list are identical to the
+    no-``winning_seeds`` portfolio."""
     if size < 2:
         raise ValueError("portfolio size must be >= 2")
     rng = random.Random(seed)
     device_engine = base_engine in ("tpu", "sharded")
     sim_engine = "tpu_simulation" if device_engine else "simulation"
+    won = [int(s) & ((1 << 31) - 1) for s in (winning_seeds or [])]
     members = [
         MemberConfig(
             index=0, kind="exhaustive", engine=base_engine,
@@ -101,11 +112,14 @@ def diversify(
         if include_simulation and i % 3 == 2:
             # Every third member is a Monte-Carlo walker with its own
             # derived seed — the cheap, restartable random searches of
-            # the swarm recipe.
+            # the swarm recipe.  Ensemble-found winning seeds preempt
+            # the derived draws (which are still consumed, keeping the
+            # rest of the stream aligned).
+            drawn = rng.randrange(1 << 31)
             members.append(
                 MemberConfig(
                     index=i, kind="simulation", engine=sim_engine,
-                    seed=rng.randrange(1 << 31),
+                    seed=won.pop(0) if won else drawn,
                     target_state_count=_SIM_DEFAULT_TARGET,
                 )
             )

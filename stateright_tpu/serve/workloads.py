@@ -69,6 +69,40 @@ def build_model(
     return spec.build(resolved_n, net), spec, resolved_n
 
 
+def ensemble_capable(workload: str) -> bool:
+    """Whether the workload supports chaos-ensemble sweeps
+    (``ensemble/engine.py``): its CliSpec opted in — today that means
+    the model has a compiled fault hook the ensemble can search over.
+    Unknown names raise, exactly like ``cli_spec_for``."""
+    return bool(getattr(cli_spec_for(workload), "ensemble", False))
+
+
+def ensemble_winning_seeds(
+    workload: str,
+    *,
+    members: int = 256,
+    seed: int = 0,
+    chaos=None,
+    steps: int = 48,
+    fault: Optional[str] = None,
+    limit: int = 4,
+) -> List[int]:
+    """A pre-portfolio chaos sweep: run one ensemble dispatch and hand
+    back up to ``limit`` failure-finding member seeds, ready to fold
+    into ``portfolio.diversify(..., winning_seeds=...)``.  Returns
+    ``[]`` for non-ensemble workloads instead of raising, so the
+    scheduler can call it unconditionally."""
+    if not ensemble_capable(workload):
+        return []
+    from ..ensemble import run_ensemble
+
+    result = run_ensemble(
+        members=members, seed=seed, chaos=chaos, steps=steps,
+        fault=fault, shrink=False, replay=False,
+    )
+    return [f["seed"] for f in result.failing[:limit]]
+
+
 def workload_label(workload: str, n: int, network: Optional[str],
                    symmetry: bool = False) -> str:
     """The knob-cache label for one served workload configuration

@@ -231,6 +231,107 @@ def test_spawn_tpu_abd_unordered_check3_matches_host():
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
 
 
+def abd_skip_ack_model(client_count: int, ordered: bool = False):
+    return AbdModelCfg(
+        client_count=client_count,
+        server_count=2,
+        network=(
+            Network.new_ordered()
+            if ordered
+            else Network.new_unordered_nonduplicating()
+        ),
+        fault="skip_ack",
+    ).into_model()
+
+
+def test_skip_ack_step_differential_full_reachable():
+    """The deliberately-broken skip-ack replica (the chaos ensemble's
+    known-violating workload) on device: full-reachable-space successor
+    and property parity against the host model, and the linearizability
+    violation the fault exists to create is actually reachable."""
+    model = abd_skip_ack_model(2)
+    cm = AbdCompiled(model)
+    assert cm.fault == "skip_ack"
+    assert cm.cache_key() != AbdCompiled(abd_model(2)).cache_key()
+    states = list(enumerate_reachable(model).values())
+    assert states
+    enc = np.stack([cm.encode(s) for s in states]).astype(np.uint32)
+    for s, e in zip(states, enc):
+        assert cm.decode(e) == s
+    lane_fn = jax.jit(
+        jax.vmap(
+            lambda st: jax.vmap(lambda k: cm._deliver_lane(st, k))(
+                jnp.arange(cm.m, dtype=jnp.uint32)
+            )
+        )
+    )
+    nexts, valid, flags = (np.asarray(x) for x in lane_fn(jnp.asarray(enc)))
+    assert not flags.any()
+    for bi, s in enumerate(states):
+        host_map = {}
+        for env in s.network.iter_deliverable():
+            ns = model.next_state(s, Deliver(env.src, env.dst, env.msg))
+            host_map[cm._env_code(env)] = None if ns is None else cm.encode(ns)
+        for k in range(cm.m):
+            code = int(enc[bi][3 + k])
+            if code == 0:
+                assert not valid[bi, k]
+                continue
+            want = host_map[code]
+            if want is None:
+                assert not valid[bi, k], cm._env_of(code)
+            else:
+                assert valid[bi, k], cm._env_of(code)
+                assert np.array_equal(nexts[bi, k], want), cm._env_of(code)
+    conds = np.asarray(jax.jit(jax.vmap(cm.property_conds))(jnp.asarray(enc)))
+    violations = 0
+    for bi, s in enumerate(states):
+        lin = s.history.serialized_history() is not None
+        assert bool(conds[bi, 0]) == lin
+        violations += not lin
+    assert violations > 0  # the broken replica IS catchable
+
+
+def test_skip_ack_ordered_step_differential():
+    """Same hook on the ordered FIFO fabric (the ensemble's fabric)."""
+    model = abd_skip_ack_model(2, ordered=True)
+    cm = AbdCompiled(model)
+    step = jax.jit(cm.step)
+    seen = {}
+    frontier = list(model.init_states())
+    for s in frontier:
+        seen[fingerprint(s)] = s
+    violations = 0
+    while frontier:
+        nxt = []
+        for s in frontier:
+            enc = cm.encode(s)
+            assert cm.decode(enc) == s
+            violations += s.history.serialized_history() is None
+            host_succ = set()
+            acts = []
+            model.actions(s, acts)
+            for a in acts:
+                ns = model.next_state(s, a)
+                if ns is None:
+                    continue
+                host_succ.add(tuple(cm.encode(ns).tolist()))
+                fp = fingerprint(ns)
+                if fp not in seen:
+                    seen[fp] = ns
+                    nxt.append(ns)
+            nexts, valid, flag = step(jnp.asarray(enc))
+            assert not bool(flag), s
+            dev_succ = {
+                tuple(np.asarray(nexts[i]).tolist())
+                for i in range(nexts.shape[0])
+                if bool(valid[i])
+            }
+            assert dev_succ == host_succ, s
+        frontier = nxt
+    assert violations > 0
+
+
 def _dup_send_differential(model, cm, net0):
     """Shared body: bump EACH in-flight envelope of every reachable state
     to count 2 in turn (duplicate runs at interior slots included), then

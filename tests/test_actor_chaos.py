@@ -66,6 +66,33 @@ def test_chaos_spec_rejects_malformed_input():
             ChaosSpec.from_json(bad)
 
 
+def test_chaos_spec_errors_name_the_offending_key_path():
+    """Malformed faults/partition blocks raise a single ValueError whose
+    message names the offending key path — never a raw KeyError or
+    TypeError from deep inside the parser."""
+    for bad, path in (
+        ('{"links": [1]}', "links"),  # links container not an object
+        ('{"links": {"0->1": [0.5]}}', "links[0->1]"),  # link value
+        ('{"default": [1]}', "default"),  # default block not an object
+        ('{"partitions": {"at": 0}}', "partitions"),  # container not array
+        ('{"partitions": [5]}', "partitions[0]"),  # entry not an object
+        ('{"partitions": [{"at": 0, "groups": [[0]], "bogus": 1}]}',
+         "partitions[0]"),  # unknown key
+        ('{"partitions": [{"at": "x", "groups": [[0]]}]}',
+         "partitions[0].at"),
+        ('{"partitions": [{"at": 0, "heal": "x", "groups": [[0]]}]}',
+         "partitions[0].heal"),
+        ('{"partitions": [{"at": 0, "groups": 5}]}', "partitions[0].groups"),
+        ('{"partitions": [{"at": 0, "groups": [5]}]}',
+         "partitions[0].groups[0]"),
+        ('{"partitions": [{"at": 0, "groups": [["x"]]}]}',
+         "partitions[0].groups[0]"),
+    ):
+        with pytest.raises(ValueError) as exc:
+            ChaosSpec.from_json(bad)
+        assert path in str(exc.value), (bad, str(exc.value))
+
+
 def test_chaos_spec_remap_ids_onto_real_addresses():
     """Specs are written with model indices; the UDP spawn path remaps
     them onto socket-addr ids so links/partitions actually match."""

@@ -1,0 +1,161 @@
+"""The chaos-ensemble engine end to end: one dispatch sweeping >=1024
+fault schedules, the device->host repro bridge (shrink, journal, host
+replay to a rejected history with the fault-attribution table), and the
+purity of per-member schedule derivation."""
+
+import json
+
+import pytest
+
+from stateright_tpu.ensemble.engine import (
+    replay_repro,
+    run_ensemble,
+)
+from stateright_tpu.ensemble.schedule import (
+    EnsembleSchedule,
+    derive_schedule,
+    member_seed,
+)
+from stateright_tpu.runtime.chaos import ChaosSpec
+
+_CHAOS = (
+    '{"default": {"drop": 0.15, "reorder": 0.1, "duplicate": 0.05,'
+    ' "delay": [0.0, 0.002]}}'
+)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture(scope="module")
+def replayed_run(tmp_path_factory):
+    """One ensemble run with shrink + host replay, shared by the bridge
+    tests below (the replay is the expensive part)."""
+    journal = tmp_path_factory.mktemp("ensemble") / "journal.jsonl"
+    result = run_ensemble(
+        members=64,
+        seed=3,
+        steps=48,
+        fault="skip_ack",
+        chaos=_CHAOS,
+        journal=str(journal),
+        shrink=True,
+        replay=True,
+    )
+    return result, journal
+
+
+def test_one_dispatch_sweeps_1024_schedules():
+    result = run_ensemble(
+        members=1024,
+        seed=7,
+        steps=48,
+        fault="skip_ack",
+        chaos='{"default": {"drop": 0.1}}',
+        shrink=False,
+        replay=False,
+    )
+    assert result.dispatches == 1
+    assert result.members == 1024
+    assert result.states_walked > 0
+    assert result.schedules_per_sec > 0
+    # The known-violating workload: the sweep finds failing seeds, and
+    # time-to-first-failure is the dispatch time (one dispatch).
+    assert len(result.failing) > 0
+    assert result.ttff_sec is not None
+    assert all(f["property"] == "linearizable" for f in result.failing)
+
+
+def test_failing_seed_shrinks_and_host_replay_rejects(replayed_run):
+    result, _journal = replayed_run
+    assert len(result.failing) > 0
+    # The shrinker ran and the repro is at most the original horizon.
+    assert result.shrink_steps > 0
+    assert result.repro is not None
+    assert result.repro["steps"] <= 48
+    # The host replay REJECTED the history: the confirmation oracle.
+    assert len(result.confirmed) == 1
+    confirmed = result.confirmed[0]
+    assert confirmed["seed"] == result.repro["seed"]
+    assert confirmed["returned"] > 0  # a real history, not a stalled run
+    # The fault-attribution table rode along as evidence.
+    assert isinstance(confirmed["fault_links"], dict)
+
+
+def test_ensemble_journal_carries_the_whole_story(replayed_run):
+    result, journal = replayed_run
+    events = _events(journal)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["event"], []).append(e)
+    assert by_kind["ensemble_start"][0]["members"] == 64
+    sweep = by_kind["ensemble_sweep"][0]
+    assert sweep["failing"] == len(result.failing)
+    assert by_kind["ensemble_failing"]  # at least one journaled failure
+    assert by_kind["ensemble_shrink"]  # shrink candidates journaled
+    assert len(by_kind["ensemble_repro"]) == 1
+    # The replay journals the audit verdict with the attribution table.
+    audits = by_kind["audit"]
+    rejected = [a for a in audits if not a["consistent"]]
+    assert rejected and "fault_links" in rejected[0]
+
+
+def test_repro_replays_from_the_journal_event_alone(replayed_run):
+    result, journal = replayed_run
+    (repro_event,) = [
+        e for e in _events(journal) if e["event"] == "ensemble_repro"
+    ]
+    # Strip the journal envelope; what remains is the repro payload.
+    payload = {k: v for k, v in repro_event.items() if k not in ("t", "event")}
+    assert payload["seed"] == result.repro["seed"]
+    verdict = replay_repro(payload)
+    assert verdict["consistent"] is False
+
+
+def test_healthy_model_finds_no_failing_seed():
+    result = run_ensemble(
+        members=128,
+        seed=7,
+        steps=48,
+        fault=None,
+        chaos='{"default": {"drop": 0.1}}',
+        shrink=True,
+        replay=True,
+    )
+    assert result.failing == []
+    assert result.confirmed == []
+    assert result.repro is None
+
+
+def test_schedule_derivation_is_pure():
+    spec = ChaosSpec.from_json(_CHAOS)
+    a = derive_schedule(3, 11, spec, 48)
+    b = derive_schedule(3, 11, spec, 48)
+    assert a == b
+    assert a.seed == member_seed(3, 11)
+    # Different members draw different seeds and different rate scales.
+    c = derive_schedule(3, 12, spec, 48)
+    assert c.seed != a.seed
+    assert c.spec.default.drop != a.spec.default.drop
+    # Scaled rates stay within the base rates.
+    assert 0.0 <= a.spec.default.drop <= spec.default.drop
+    assert 0.0 <= a.spec.default.delay[1] <= spec.default.delay[1]
+
+
+def test_repro_payload_round_trips():
+    spec = ChaosSpec.from_json(
+        '{"default": {"drop": 0.2}, "links": {"0->1": {"reorder": 0.5}},'
+        ' "partitions": [{"at": 0.0, "groups": [[0], [1]]}]}'
+    )
+    sch = derive_schedule(9, 5, spec, 32)
+    # JSON round trip, as the journal would store it.
+    payload = json.loads(json.dumps(sch.to_repro()))
+    back = EnsembleSchedule.from_repro(payload)
+    assert back.member == sch.member
+    assert back.seed == sch.seed
+    assert back.steps == sch.steps
+    assert back.partition_at == sch.partition_at
+    assert back.partition_heal == sch.partition_heal
+    assert back.spec.to_dict() == sch.spec.to_dict()

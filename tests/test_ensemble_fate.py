@@ -1,0 +1,236 @@
+"""Host/device fault-fate parity (ISSUE 16 satellite).
+
+The chaos-ensemble bridge requires the device fate kernel
+(``ensemble/fate.py``) to be *bit-equal* to the host ``FaultyTransport``
+schedule: same fate words, same threshold decisions, same partition
+predicate.  Property-style sweeps over (seed, link, n) triples pin that
+here — first against the host kernel function, then against the actual
+decision stream a live ``FaultyTransport`` journals, including partition
+windows."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stateright_tpu.actor.ids import Id
+from stateright_tpu.actor.transport import LoopbackTransport
+from stateright_tpu.ensemble.fate import (
+    device_fault_fate,
+    link_seed_limbs,
+    partition_cuts,
+    rate_threshold,
+)
+from stateright_tpu.runtime.chaos import (
+    FATE_DELAY,
+    FATE_DRAWS,
+    FATE_DROP,
+    FATE_DUPLICATE,
+    FATE_REORDER,
+    ChaosSpec,
+    FaultyTransport,
+    Partition,
+    _link_rng_seed,
+    fault_draws,
+    fault_fate_u32,
+)
+from stateright_tpu.runtime.journal import read_journal
+
+
+def test_device_fate_kernel_is_bit_equal_to_host_kernel():
+    """Sweep (seed, src, dst, n, k): the uint32-limb splitmix64 on device
+    equals the arbitrary-precision host integer math bit-for-bit."""
+    cases = []
+    for seed in (0, 1, 42, 0xDEADBEEF, (1 << 63) + 12345):
+        for src, dst in ((0, 1), (1, 0), (2, 1), (255, 254)):
+            cases.append((seed, src, dst))
+    ns = list(range(40)) + [1000, 65535, 1 << 20, (1 << 29) - 1]
+    for seed, src, dst in cases:
+        link_seed = _link_rng_seed(seed, Id(src), Id(dst))
+        hi, lo = link_seed_limbs(seed, src, dst)
+        assert (hi << 32) | lo == link_seed
+        n_arr = jnp.asarray(ns, dtype=jnp.uint32)
+        for k in range(FATE_DRAWS):
+            dev = np.asarray(
+                device_fault_fate(jnp.uint32(hi), jnp.uint32(lo), n_arr, k)
+            )
+            host = [fault_fate_u32(link_seed, n, k) for n in ns]
+            assert dev.tolist() == host, (seed, src, dst, k)
+
+
+def test_rate_threshold_is_exact_for_every_decision():
+    """``fate/2**32 < rate`` on host ⟺ ``always or fate < thr`` on
+    device — across boundary rates and the fates straddling them."""
+    rates = [
+        0.0, 1.0, 0.5, 0.25, 0.1, 0.3, 0.6, 1e-12,
+        1.0 / 4294967296.0,  # exactly one fate word passes
+        1.0 - 1.0 / 8589934592.0,  # within 2**-32 of 1.0: always-fire
+        0.7 + 1e-16,
+    ]
+    for rate in rates:
+        thr, always = rate_threshold(rate)
+        fates = {0, 1, thr - 1, thr, thr + 1, (1 << 32) - 1}
+        for fate in fates:
+            if not 0 <= fate < (1 << 32):
+                continue
+            host = (fate / 4294967296.0) < rate
+            device = always or fate < thr
+            assert host == device, (rate, fate)
+    with pytest.raises(ValueError):
+        rate_threshold(1.5)
+    with pytest.raises(ValueError):
+        rate_threshold(-0.1)
+
+
+def test_host_fault_draws_are_the_fate_words():
+    link_seed = _link_rng_seed(7, Id(0), Id(1))
+    for n in range(20):
+        draws = fault_draws(link_seed, n)
+        fates = [fault_fate_u32(link_seed, n, k) for k in range(FATE_DRAWS)]
+        order = (FATE_DROP, FATE_REORDER, FATE_DUPLICATE, FATE_DELAY)
+        for slot, k in enumerate(order):
+            assert draws[slot] == fates[k] / 4294967296.0
+
+
+def _device_decision_stream(spec, seed, links, count):
+    """Predict the FaultyTransport decision stream with the device
+    kernel + thresholds, mirroring the host precedence
+    (drop → reorder-hold → duplicate / delay)."""
+    out = {}
+    for src, dst in links:
+        faults = spec.faults_for(Id(src), Id(dst))
+        thr = {
+            FATE_DROP: rate_threshold(faults.drop),
+            FATE_REORDER: rate_threshold(faults.reorder),
+            FATE_DUPLICATE: rate_threshold(faults.duplicate),
+        }
+        hi, lo = link_seed_limbs(seed, src, dst)
+        n_arr = jnp.arange(count, dtype=jnp.uint32)
+        fates = {
+            k: np.asarray(device_fault_fate(jnp.uint32(hi), jnp.uint32(lo), n_arr, k))
+            for k in (FATE_DROP, FATE_REORDER, FATE_DUPLICATE, FATE_DELAY)
+        }
+
+        def fires(k, n):
+            t, always = thr[k]
+            return always or int(fates[k][n]) < t
+
+        decisions = []
+        for n in range(count):
+            if fires(FATE_DROP, n):
+                decisions.append("chaos_drop")
+            elif fires(FATE_REORDER, n):
+                decisions.append("chaos_reorder")
+            elif fires(FATE_DUPLICATE, n):
+                decisions.append("chaos_duplicate")
+            else:
+                decisions.append(None)
+        out[(src, dst)] = decisions
+    return out
+
+
+def test_device_kernel_matches_faulty_transport_decision_stream(tmp_path):
+    """Drive a real FaultyTransport and check the journaled fault stream
+    against the device prediction, event for event."""
+    spec = ChaosSpec.from_json(
+        '{"drop": 0.3, "duplicate": 0.25, "reorder": 0.2,'
+        ' "links": {"2->1": {"drop": 0.55, "duplicate": 0.1}}}'
+    )
+    seed = 20260807
+    count = 120
+    journal = tmp_path / "fate.jsonl"
+    lb = LoopbackTransport()
+    ft = FaultyTransport(lb, spec, seed=seed, journal=str(journal))
+    a, c = ft.bind(Id(0)), ft.bind(Id(2))
+    b = ft.bind(Id(1))
+    for i in range(count):
+        a.send(Id(1), f"a{i}".encode())
+        c.send(Id(1), f"c{i}".encode())
+    while b.recv(0.05) is not None:
+        pass
+    ft.close()
+
+    host = {(0, 1): {}, (2, 1): {}}
+    for e in read_journal(str(journal)):
+        if e["event"].startswith("chaos_") and "n" in e:
+            if e["event"] == "chaos_delay":
+                continue  # no delay configured; kept for completeness
+            host[(e["src"], e["dst"])][e["n"]] = e["event"]
+
+    predicted = _device_decision_stream(spec, seed, [(0, 1), (2, 1)], count)
+    for link in ((0, 1), (2, 1)):
+        for n in range(count):
+            assert host[link].get(n) == predicted[link][n], (link, n)
+    # Sanity: the sweep actually exercised every fault kind.
+    kinds = {e for d in host.values() for e in d.values()}
+    assert kinds == {"chaos_drop", "chaos_reorder", "chaos_duplicate"}
+
+
+def test_device_partition_predicate_matches_host_cuts():
+    """``partition_cuts`` equals ``Partition.cuts`` on a sweep of group
+    layouts × (src, dst) × window positions (host windows evaluated at
+    the same scalar the device sees as its step index)."""
+    layouts = [
+        (frozenset([0, 1]), frozenset([2])),
+        (frozenset([0]), frozenset([1]), frozenset([2, 3])),
+        (frozenset([0, 2]),),  # a single group never cuts
+    ]
+    windows = [(0, None), (2, 5), (3, 3), (1, 8)]
+    ids = range(5)  # includes id 4, absent from every layout
+    for groups in layouts:
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for node in g:
+                group_of[node] = gi
+        for at, heal in windows:
+            p = Partition(at=float(at), heal=None if heal is None else float(heal),
+                          groups=groups)
+            for src in ids:
+                for dst in ids:
+                    for step in range(10):
+                        host = p.cuts(src, dst, elapsed=float(step))
+                        dev = bool(
+                            partition_cuts(
+                                group_of.get(src, -1), group_of.get(dst, -1),
+                                step, at, -1 if heal is None else heal,
+                            )
+                        )
+                        assert host == dev, (groups, at, heal, src, dst, step)
+
+
+def test_partition_window_in_live_transport_matches_device_predicate(tmp_path):
+    """A permanent (at=0) partition — the one wall-clock-independent
+    window — journals chaos_partition exactly where the device predicate
+    cuts, with fate thresholds still deciding the uncut links."""
+    spec = ChaosSpec.from_json(
+        '{"drop": 0.4, "partitions": [{"at": 0, "groups": [[0], [1]]}]}'
+    )
+    seed = 99
+    count = 60
+    journal = tmp_path / "part.jsonl"
+    lb = LoopbackTransport()
+    ft = FaultyTransport(lb, spec, seed=seed, journal=str(journal))
+    a = ft.bind(Id(0))
+    b = ft.bind(Id(1))
+    c = ft.bind(Id(2))
+    for i in range(count):
+        a.send(Id(1), f"x{i}".encode())  # crosses the cut: all partitioned
+        a.send(Id(2), f"y{i}".encode())  # dst in no group: fate-decided
+    while b.recv(0.02) is not None:
+        pass
+    while c.recv(0.02) is not None:
+        pass
+    ft.close()
+
+    host = {(0, 1): {}, (0, 2): {}}
+    for e in read_journal(str(journal)):
+        if e["event"].startswith("chaos_") and "n" in e:
+            host[(e["src"], e["dst"])][e["n"]] = e["event"]
+
+    # 0->1 crosses groups: every datagram partitioned (predicate True).
+    assert bool(partition_cuts(0, 1, 0, 0, -1))
+    assert host[(0, 1)] == {n: "chaos_partition" for n in range(count)}
+    # 0->2: id 2 is in no group (predicate False) — fate words decide.
+    assert not bool(partition_cuts(0, -1, 0, 0, -1))
+    predicted = _device_decision_stream(spec, seed, [(0, 2)], count)[(0, 2)]
+    for n in range(count):
+        assert host[(0, 2)].get(n) == predicted[n], n

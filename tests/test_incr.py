@@ -245,6 +245,118 @@ def test_constant_widening_fingerprint_bit_equal(store_dir):
     )
 
 
+# --- widening on the real protocol models (paxos/raft bounds) -----------------
+
+
+PX_KW = dict(capacity=1 << 12, max_frontier=1 << 6)
+
+
+def _paxos(client_count, max_round=None):
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.paxos import PaxosModelCfg
+
+    return PaxosModelCfg(
+        client_count=client_count,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+        max_round=max_round,
+    ).into_model()
+
+
+def _raft(max_crashes=None):
+    from stateright_tpu.models.raft import RaftModelCfg
+
+    return RaftModelCfg(server_count=3, max_crashes=max_crashes).into_model()
+
+
+def test_paxos_round_bound_spec_components():
+    """max_round changes ONLY the constants component — codec,
+    properties, and the snapshot key are bound-independent, which is
+    exactly what lets the store classify a raise as a widening."""
+    base = SpecFingerprint(_paxos(1, max_round=0))
+    wide = SpecFingerprint(_paxos(1))
+    assert base.spec_key != wide.spec_key
+    assert base.family_key == wide.family_key
+    assert base.components["codec"] == wide.components["codec"]
+    assert base.components["properties"] == wide.components["properties"]
+    assert base.components["constants"] != wide.components["constants"]
+    assert base.snapshot_key == wide.snapshot_key
+    assert wide.compiled.spec_widens(base.constants)
+    assert not base.compiled.spec_widens(wide.constants)  # narrowing
+    assert not wide.compiled.spec_widens({"max_round": "0"})  # keys gone
+    # An explicit cap at the encoding limit hashes like the unbounded
+    # default it behaves as (max_round normalization).
+    capped = SpecFingerprint(_paxos(1, max_round=15))
+    assert capped.components["constants"] == wide.components["constants"]
+    # The device boundary exists only when the bound actually prunes,
+    # keeping the default model's traced programs byte-identical.
+    assert wide.compiled.boundary(np.zeros(
+        (wide.compiled.state_width,), np.uint32
+    )) is None
+    assert base.compiled.boundary(np.zeros(
+        (base.compiled.state_width,), np.uint32
+    )) is not None
+    with pytest.raises(ValueError, match="max_round"):
+        SpecFingerprint(_paxos(1, max_round=16))
+
+
+def test_raft_crash_budget_spec_components():
+    """max_crashes is data the step kernel closes over, not codec: a
+    frozen-budget raft shares family/codec/properties with the stock
+    (budget-1) model and the raise is a declared widening."""
+    frozen = SpecFingerprint(_raft(max_crashes=0))
+    stock = SpecFingerprint(_raft())
+    assert stock.constants["max_crashes"] == "1"  # (n-1)//2 default
+    assert frozen.spec_key != stock.spec_key
+    assert frozen.family_key == stock.family_key
+    assert frozen.components["codec"] == stock.components["codec"]
+    assert frozen.components["properties"] == stock.components["properties"]
+    assert frozen.components["constants"] != stock.components["constants"]
+    assert frozen.snapshot_key == stock.snapshot_key
+    assert stock.compiled.spec_widens(frozen.constants)
+    assert not frozen.compiled.spec_widens(stock.constants)  # narrowing
+    assert not stock.compiled.spec_widens({"max_crashes": "0"})  # keys gone
+
+
+def test_paxos_round_bound_widening_fingerprint_bit_equal(store_dir):
+    """The GridWalk widening acceptance gate on the flagship protocol
+    model: a bounded paxos run seeds the unbounded re-check, whose
+    discovered set must be bit-equal to a from-scratch run."""
+    _, info = _check(_paxos(1, max_round=0), store_dir, **PX_KW)
+    assert info["mode"] == COLD
+
+    ck, info2 = _check(_paxos(1), store_dir, **PX_KW)
+    assert info2["mode"] == CONSTANT_WIDENING
+    assert info2["seeded_states"] == 1  # rounds start at 0: init only
+    assert ck.unique_state_count() == 265  # c=1 golden (test_paxos_tpu)
+    cold = _paxos(1).checker().spawn_tpu(**PX_KW).join()
+    assert np.array_equal(
+        ck.discovered_fingerprints(), cold.discovered_fingerprints()
+    )
+    events = read_journal(_journal(store_dir))
+    assert any(e["event"] == "incr_seeded" for e in events)
+
+
+@pytest.mark.slow
+def test_paxos_round_bound_partial_seed_c2(store_dir):
+    """Partial seeding at the reference scale: max_round=1 prunes the
+    c=2 space to 1,834 of its 16,668 states (examples/paxos.rs:328);
+    the widened run seeds from all of them and must reproduce the
+    unbounded golden bit-for-bit."""
+    kw = dict(capacity=1 << 18, max_frontier=1 << 13)
+    _, info = _check(_paxos(2, max_round=1), store_dir, **kw)
+    assert info["mode"] == COLD
+
+    ck, info2 = _check(_paxos(2), store_dir, **kw)
+    assert info2["mode"] == CONSTANT_WIDENING
+    assert info2["seeded_states"] == 1_834
+    assert ck.unique_state_count() == 16_668
+    cold = _paxos(2).checker().spawn_tpu(**kw).join()
+    assert np.array_equal(
+        ck.discovered_fingerprints(), cold.discovered_fingerprints()
+    )
+
+
 # --- the degradation matrix ---------------------------------------------------
 
 

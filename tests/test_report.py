@@ -820,3 +820,72 @@ def test_watch_renders_actor_journal_fields_and_badges():
     s3 = summarize_events(_actor_journal(consistent=False))
     assert "audit-inconsistent" in s3["warnings"]
     assert "audit=INCONSISTENT" in render_line(s3)
+
+
+# --- chaos-ensemble journals (ensemble/engine.py) ----------------------------
+
+
+def _ensemble_journal():
+    """A synthetic ensemble journal: sweep -> failing -> shrink ->
+    replay (rejected) -> repro, with the replay's audit event riding
+    along (as run_ensemble journals it)."""
+    return [
+        {"t": 0.0, "event": "ensemble_start", "members": 256, "seed": 3,
+         "steps": 48, "workload": "abd", "fault": "skip_ack",
+         "spec": {"default": {"drop": 0.1}}},
+        {"t": 1.0, "event": "ensemble_failing", "member": 6, "seed": 999,
+         "property": "linearizable", "step": 4},
+        {"t": 1.1, "event": "ensemble_sweep", "members": 256, "failing": 1,
+         "states": 2000, "elapsed_sec": 1.0, "schedules_per_sec": 256.0,
+         "ttff_sec": 1.0},
+        {"t": 1.5, "event": "ensemble_shrink", "member": 6,
+         "candidate": "prefix", "steps": 5, "accepted": True},
+        {"t": 1.6, "event": "ensemble_shrink", "member": 6,
+         "candidate": "drop", "accepted": False},
+        {"t": 2.0, "event": "audit", "consistent": False, "invoked": 4,
+         "returned": 4, "in_flight": 0, "violations": [], "seed": 999,
+         "fault_links": {"0->1": {"chaos_drop": 1}}},
+        {"t": 2.1, "event": "ensemble_replay", "member": 6, "seed": 999,
+         "consistent": False, "violations": 0},
+        {"t": 2.2, "event": "ensemble_repro", "member": 6, "seed": 999,
+         "spec": {"default": {"drop": 0.0}}, "steps": 5,
+         "partition_at": -1, "partition_heal": -1, "workload": "abd",
+         "fault": "skip_ack", "client_count": 2, "put_count": 1,
+         "server_count": 2, "property": "linearizable", "base_seed": 3},
+    ]
+
+
+def test_report_renders_ensemble_journal_as_first_class_kind():
+    report = analyze_journal(_ensemble_journal())
+    assert report["kind"] == "ensemble"
+    ens = report["ensemble"]
+    assert ens["members"] == 256 and ens["failing"] == 1
+    assert ens["schedules_per_sec"] == 256.0
+    assert ens["shrink_accepted"] == 1 and ens["shrink_candidates"] == 2
+    assert ens["replay"]["rejected"] is True
+    assert ens["repro"]["seed"] == 999 and ens["repro"]["steps"] == 5
+    assert ens["failing_seeds"][0]["member"] == 6
+    # No actor-only degrade warning: the replay's events ride under the
+    # ensemble kind.
+    assert not any("actor-only" in w for w in report.get("warnings", []))
+    md = render_markdown(report)
+    assert "## Chaos ensemble" in md
+    assert "failing seeds: **1**" in md
+    assert "REJECTED" in md and "repro journaled" in md
+    json.dumps(report, default=str)
+
+
+def test_watch_renders_ensemble_journal_without_audit_warning():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    s = summarize_events(_ensemble_journal())
+    assert s["ensemble_members"] == 256 and s["ensemble_failing"] == 1
+    assert s["ensemble_shrinks"] == 2
+    assert s["ensemble_shrinks_accepted"] == 1
+    assert s["ensemble_repro"] is True and s["done"] is True
+    # The rejected replay audit is the ensemble's SUCCESS, not a warning.
+    assert "audit-inconsistent" not in s["warnings"]
+    line = render_line(s)
+    assert "members=256" in line and "failing=1" in line
+    assert "shrinks=1/2" in line and "repro=journaled" in line
+    assert "audit=INCONSISTENT" not in line

@@ -312,6 +312,37 @@ def test_diversify_is_deterministic_and_anchored():
     assert [m.describe() for m in a] != [m.describe() for m in c]
 
 
+def test_diversify_folds_ensemble_winning_seeds_into_sim_members():
+    """Chaos-ensemble winning seeds preempt the derived simulation-seed
+    draws (masked to the 31-bit walker range); everything else in the
+    portfolio — including later simulation members — is unchanged."""
+    kwargs = {"capacity": 1 << 12, "max_frontier": 1 << 6}
+    base = diversify(9, seed=42, base_engine="tpu", base_kwargs=kwargs)
+    won = diversify(9, seed=42, base_engine="tpu", base_kwargs=kwargs,
+                    winning_seeds=[12918135221727111561])
+    sims_base = [m for m in base if m.kind == "simulation"]
+    sims_won = [m for m in won if m.kind == "simulation"]
+    assert len(sims_won) == len(sims_base) >= 2
+    assert sims_won[0].seed == 12918135221727111561 & ((1 << 31) - 1)
+    # The derived-seed stream still advanced: later sims are untouched.
+    assert [m.seed for m in sims_won[1:]] == [m.seed for m in sims_base[1:]]
+    # Member 0 stays the unmodified exhaustive anchor.
+    assert won[0].describe() == base[0].describe()
+    # Purity holds with the new argument too.
+    again = diversify(9, seed=42, base_engine="tpu", base_kwargs=kwargs,
+                      winning_seeds=[12918135221727111561])
+    assert [m.describe() for m in won] == [m.describe() for m in again]
+
+
+def test_ensemble_capable_workloads():
+    from stateright_tpu.serve.workloads import ensemble_capable
+
+    assert ensemble_capable("abd") is True
+    assert ensemble_capable("paxos") is False
+    with pytest.raises(ValueError):
+        ensemble_capable("nonesuch")
+
+
 def run_portfolio_job(tmp_path, tag, seed=7):
     svc = CheckService(
         journal=str(tmp_path / f"{tag}.jsonl"),
