@@ -1214,6 +1214,81 @@ def phase_tiered(record: dict) -> None:
     )
 
 
+def phase_tiered_sharded(record: dict) -> None:
+    """Composed tiered × sharded phase (docs/TIERED.md "Composing the
+    levers"): `2pc check 5` (the reference-pinned 8,832 golden) on a
+    1-device mesh, unconstrained sharded vs tiered-sharded under a
+    spill-forcing PER-SHARD budget.  Same verdict-equality gate as the
+    tiered phase — the budget run's `discovered_fingerprints()` must be
+    bit-identical to the unconstrained engine's — plus the per-shard
+    spill/cold accounting the composed engine adds."""
+    import numpy as np
+    import jax
+
+    from stateright_tpu.models.twophase import TwoPhaseSys
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("shards",))
+    knobs = dict(chunk_size=1 << 10)
+
+    def mk_plain():
+        return TwoPhaseSys(rm_count=TIERED_RM).checker().spawn_tpu_sharded(
+            mesh=mesh, capacity=1 << 15, **knobs
+        )
+
+    def mk_ts():
+        return (
+            TwoPhaseSys(rm_count=TIERED_RM).checker()
+            .spawn_tpu_tiered_sharded(
+                mesh=mesh, memory_budget_mb=TIERED_BUDGET_MB, **knobs
+            )
+        )
+
+    log("tiered_sharded: warming programs...")
+    run_device(mk_plain)
+    ck0, dt0 = run_device_timed(mk_plain)
+    u0 = ck0.unique_state_count()
+    assert u0 == SYM_UNIQUE_FULL, (
+        f"tiered_sharded golden mismatch (unconstrained): {u0}"
+    )
+    run_device(mk_ts)
+    ck1, dt1 = run_device_timed(mk_ts)
+    u1 = ck1.unique_state_count()
+    assert u1 == SYM_UNIQUE_FULL, (
+        f"tiered_sharded golden mismatch (budget-constrained): {u1}"
+    )
+    m = ck1.metrics()
+    assert m.get("spills", 0) >= 2, (
+        f"the per-shard budget did not force evictions "
+        f"(spills={m.get('spills')})"
+    )
+    # THE gate: identical discovery SETS, not just counts.
+    assert np.array_equal(
+        ck0.discovered_fingerprints(), ck1.discovered_fingerprints()
+    ), "tiered-sharded discovery set diverged from the unconstrained engine"
+    record["tiered_sharded"] = {
+        "workload": f"2pc_check_{TIERED_RM}",
+        "unique_states": u1,
+        "n_shards": int(mesh.devices.size),
+        "memory_budget_mb_per_shard": TIERED_BUDGET_MB,
+        "sec_unconstrained": round(dt0, 3),
+        "uniq_per_sec_unconstrained": round(u0 / dt0, 1),
+        "sec_tiered_sharded": round(dt1, 3),
+        "uniq_per_sec_tiered_sharded": round(u1 / dt1, 1),
+        "out_of_core_overhead": round(dt1 / dt0, 2),
+        "spills": m["spills"],
+        "cold_runs": m["cold_runs"],
+        "cold_entries": m["cold_entries"],
+        "verdict_equal": True,
+    }
+    log(
+        f"tiered_sharded: 2pc({TIERED_RM}) {u1} unique bit-identical "
+        f"under a {TIERED_BUDGET_MB} MB/shard hot tier "
+        f"({int(mesh.devices.size)}-shard mesh): {u0 / dt0:.0f} -> "
+        f"{u1 / dt1:.0f} uniq/s ({dt1 / dt0:.2f}x), "
+        f"{m['spills']} spills, {m['cold_entries']} cold entries"
+    )
+
+
 RECHECK_RM = 4  # 2pc(4): 1,568 uniques — big enough to time, fast cold
 RECHECK_REPEATS = 5  # median over this many re-eval legs
 RECHECK_WIDEN_FROM, RECHECK_WIDEN_TO = 40, 44  # GridWalk bounds
@@ -1617,6 +1692,7 @@ OPTIONAL_PHASES = (
     "recheck",
     "ensemble",
     "tiered",
+    "tiered_sharded",
     "trace",
     "dedup",
     "step",
@@ -1686,6 +1762,7 @@ def main() -> None:
         "recheck": phase_recheck,
         "ensemble": phase_ensemble,
         "tiered": phase_tiered,
+        "tiered_sharded": phase_tiered_sharded,
         "trace": lambda r: phase_trace(r, tuned),
         "dedup": phase_dedup,
         "step": phase_step,

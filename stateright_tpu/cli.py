@@ -46,6 +46,7 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--step-lanes N]"
                      " [--tiered] [--memory-budget-mb MB]"
                      " [--store-dir DIR] [--incremental]")
+        lines.append(f"  reshard [{n_meta}] IN.npz OUT.npz --shards M{net}")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
         "  serve [ADDRESS] [--journal PATH] [--journal-max-mb MB]"
@@ -536,13 +537,14 @@ def _checkpointed_tpu_kwargs(ckpt_dir: str, resume: bool) -> dict:
 
 def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
                     resume: bool, tiered: bool = False,
-                    memory_budget_mb=None) -> int:
+                    memory_budget_mb=None, sharded=None) -> int:
     """Parent mode for ``check-tpu --supervise``: re-invoke this model
     module's own CLI as the supervised child (with ``--checkpoint-dir``/
     ``--resume``), watch its journal for death and hangs, and restart it
-    from the latest checkpoint until the check completes.  Tiered flags
-    are forwarded verbatim so the restarted child resumes the same
-    out-of-core run (its checkpoint embeds the cold tier)."""
+    from the latest checkpoint until the check completes.  Tiered and
+    mesh flags are forwarded verbatim so the restarted child resumes
+    the same out-of-core run on the same mesh width (its checkpoint
+    embeds the cold tiers and the shard count)."""
     from .runtime.supervisor import (
         RunSupervisor, SupervisorConfig, SupervisorError,
     )
@@ -564,20 +566,37 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
         child.append("--tiered")
     if memory_budget_mb is not None:
         child.append(f"--memory-budget-mb={memory_budget_mb}")
+    if sharded is not None:
+        child.append("--sharded" if sharded == 0 else f"--sharded={sharded}")
     child += ["--checkpoint-dir", run_dir, "--resume"]
+    if tiered and sharded is not None:
+        engine = "tiered-sharded"
+    elif tiered:
+        engine = "tiered"
+    else:
+        engine = "tpu"
+    # Seed the geometry backoff with the child's ACTUAL engine knobs:
+    # the policy only relaxes knobs it can see, so without these the
+    # frontier/waves steps could never fire in CLI mode.  The sharded
+    # engines speak chunk_size, so the single-chip names translate the
+    # same way the check-tpu dispatch translates them.
+    backoff_kwargs = dict(spec.tpu_kwargs)
+    if sharded is not None:
+        if "max_frontier" in backoff_kwargs:
+            backoff_kwargs["chunk_size"] = backoff_kwargs.pop("max_frontier")
+        for single_chip_only in ("log_capacity", "waves_per_call",
+                                 "auto_tune"):
+            backoff_kwargs.pop(single_chip_only, None)
     sup = RunSupervisor(
         SupervisorConfig(
             run_dir=run_dir,
             resume=resume,
             inherit_output=True,
             call_deadline_sec=600.0,
-            engine="tiered" if tiered else "tpu",
+            engine=engine,
         ),
         child_argv=child,
-        # Seed the geometry backoff with the child's ACTUAL engine knobs:
-        # the policy only relaxes knobs it can see, so without these the
-        # frontier/waves steps could never fire in CLI mode.
-        engine_kwargs=dict(spec.tpu_kwargs),
+        engine_kwargs=backoff_kwargs,
     )
     try:
         result = sup.run()
@@ -595,6 +614,77 @@ def _run_supervised(spec: "CliSpec", n, network, ckpt_dir: str,
     # WITH a violation still gates (VIOLATION_RC), it just isn't a
     # crash the supervisor retries.
     return sup.last_child_rc or 0
+
+
+def _run_reshard(spec: "CliSpec", args) -> int:
+    """The ``reshard`` verb: re-key a sharded or tiered-sharded
+    checkpoint onto a new mesh width (docs/TIERED.md "Elastic
+    resharding").  Re-routes every logged state row to its owner under
+    the new width and writes a tiered-sharded snapshot that resumes on
+    an M-shard mesh — host-side work plus single-device fingerprint
+    evaluation; the target mesh need not be attached."""
+    import json as _json
+
+    if not spec.tpu:
+        print(f"{spec.name} has no compiled TPU form", file=sys.stderr)
+        return 2
+    shards = None
+    rest = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--shards" or a.startswith("--shards="):
+            if a == "--shards":
+                i += 1
+                if i >= len(args):
+                    print("--shards requires a value", file=sys.stderr)
+                    return 2
+                raw = args[i]
+            else:
+                raw = a.split("=", 1)[1]
+            try:
+                shards = int(raw)
+            except ValueError:
+                print(f"--shards requires an integer, got {raw!r}",
+                      file=sys.stderr)
+                return 2
+        else:
+            rest.append(a)
+        i += 1
+    if shards is None or shards < 1:
+        print(
+            "reshard requires --shards M (the new mesh width, >= 1): "
+            f"reshard [{spec.n_meta}] IN.npz OUT.npz --shards M",
+            file=sys.stderr,
+        )
+        return 2
+    n = _parse_n(rest, spec.default_n)
+    if len(rest) < 2:
+        print(
+            "reshard requires the snapshot paths: "
+            f"reshard [{spec.n_meta}] IN.npz OUT.npz --shards M",
+            file=sys.stderr,
+        )
+        return 2
+    in_path, out_path = rest.pop(0), rest.pop(0)
+    try:
+        network = _parse_network(rest, spec)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    _reject_leftovers(rest, spec)
+    model = _build(spec, n, network)
+    from .tiered.reshard import reshard_snapshot
+
+    try:
+        summary = reshard_snapshot(model, in_path, out_path, shards)
+    except (ValueError, KeyError, OSError) as e:
+        print(e, file=sys.stderr)
+        return 1
+    # One parseable line so shell pipelines (and the CI reshard smoke)
+    # can gate on the conversion without reading the snapshot back.
+    print("reshard: " + _json.dumps(summary, sort_keys=True, default=int))
+    return 0
 
 
 # --- checking-service client verbs (docs/SERVING.md) -------------------------
@@ -873,17 +963,11 @@ def example_main(spec: CliSpec, argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if tiered and sharded is not None:
+    if tiered and sharded is not None and trace:
         print(
-            "--tiered does not combine with --sharded (the cold tier is "
-            "single-chip; shard OR tier the table, not both)",
-            file=sys.stderr,
-        )
-        return 2
-    if tiered and trace:
-        print(
-            "--tiered does not combine with --trace (the tiered loop is "
-            "already host-driven per wave; trace the in-HBM engine)",
+            "--tiered --sharded does not combine with --trace (the "
+            "composed pod-scale engine has no traced mode; trace the "
+            "single-chip tiered engine or the plain sharded engine)",
             file=sys.stderr,
         )
         return 2
@@ -894,11 +978,16 @@ def example_main(spec: CliSpec, argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if sharded is not None and (supervise or resume or ckpt_dir):
+    if (
+        sharded is not None and not tiered
+        and (supervise or resume or ckpt_dir)
+    ):
         print(
-            "--sharded does not combine with --supervise/--checkpoint-dir/"
-            "--resume from the CLI yet; use runtime.RunSupervisor with "
-            "engine='sharded' for supervised sharded runs",
+            "--sharded alone does not combine with --supervise/"
+            "--checkpoint-dir/--resume from the CLI yet; use "
+            "runtime.RunSupervisor with engine='sharded', or add "
+            "--tiered (the tiered-sharded engine checkpoints, resumes, "
+            "and supervises from the CLI; docs/TIERED.md)",
             file=sys.stderr,
         )
         return 2
@@ -959,6 +1048,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
             return _run_supervised(
                 spec, n, network, ckpt_dir, resume,
                 tiered=tiered, memory_budget_mb=memory_budget_mb,
+                sharded=sharded,
             )
         model = _build(spec, n, network)
         print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
@@ -1037,9 +1127,19 @@ def example_main(spec: CliSpec, argv=None) -> int:
                     tpu_kwargs.pop(single_chip_only, None)
                 if bucket_slack is not None:
                     tpu_kwargs["bucket_slack"] = bucket_slack
-                checker = builder.spawn_tpu_sharded(
-                    mesh=mesh, **tpu_kwargs
-                )
+                if tiered:
+                    # The composed pod-scale engine: the sharded BFS
+                    # with the HBM budget applied PER SHARD
+                    # (docs/TIERED.md "Composing the levers").
+                    if memory_budget_mb is not None:
+                        tpu_kwargs["memory_budget_mb"] = memory_budget_mb
+                    checker = builder.spawn_tpu_tiered_sharded(
+                        mesh=mesh, **tpu_kwargs
+                    )
+                else:
+                    checker = builder.spawn_tpu_sharded(
+                        mesh=mesh, **tpu_kwargs
+                    )
             elif tiered:
                 # Out-of-core run under an HBM budget (docs/TIERED.md).
                 # The budget is authoritative in the engine itself: it
@@ -1223,6 +1323,9 @@ def example_main(spec: CliSpec, argv=None) -> int:
         from .obs.watch import watch_main
 
         return watch_main(args)
+
+    if sub == "reshard":
+        return _run_reshard(spec, args)
 
     print(_usage(spec.name, spec))
     return 2

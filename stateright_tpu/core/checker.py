@@ -157,6 +157,25 @@ class CheckerBuilder:
 
         return TieredTpuChecker(self, **kwargs)
 
+    def spawn_tpu_tiered_sharded(self, **kwargs) -> "Checker":
+        """Spawn the composed pod-scale engine: the sharded wavefront
+        BFS of ``spawn_tpu_sharded`` with the tiered engine's hard
+        memory cap applied PER SHARD (``memory_budget_mb`` bounds each
+        shard's fingerprint table; evicted partitions live in shard-
+        local cold stores — owner-sharded fingerprints mean the
+        pre-commit cold merge-join never crosses shards).  Snapshots
+        embed mesh size × cold tiers and can be re-keyed onto a larger
+        or smaller mesh with ``stateright_tpu.tiered.reshard`` (the
+        ``reshard`` CLI verb); discovery sets stay bit-identical to an
+        unconstrained single-chip run (docs/TIERED.md)."""
+        self._require(
+            "stateright_tpu.tiered.sharded_engine",
+            "tiered sharded TPU checker",
+        )
+        from ..tiered.sharded_engine import TieredShardedTpuChecker
+
+        return TieredShardedTpuChecker(self, **kwargs)
+
     def spawn_tpu_sharded(self, **kwargs) -> "Checker":
         """Spawn the multi-chip wavefront checker: frontier and visited set
         sharded over a ``jax.sharding.Mesh`` by fingerprint ownership, with

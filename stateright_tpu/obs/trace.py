@@ -35,13 +35,18 @@ from typing import Dict, Optional
 from .roofline import hbm_util_frac, peaks_for_device
 
 # Canonical display order; engines may omit phases they don't have.
-PHASE_ORDER = ("step", "canon", "dedup", "exchange", "append", "readback")
+# ``cold_probe`` is the tiered engines' pre-commit merge-join against
+# the evicted runs (host searchsorted + device window filter).
+PHASE_ORDER = (
+    "step", "canon", "dedup", "exchange", "cold_probe", "append",
+    "readback",
+)
 
 # Host-side phases: excluded from the HBM-utilization denominator (they
 # are not device time) but included in wave/call wall time.  Public so
 # consumers picking a "bottleneck" phase (bench.py) can exclude the
 # trace instrumentation's own cost the same way.
-HOST_PHASES = frozenset({"readback"})
+HOST_PHASES = frozenset({"readback", "cold_probe"})
 _HOST_PHASES = HOST_PHASES
 
 

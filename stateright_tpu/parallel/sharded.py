@@ -114,6 +114,24 @@ def _owner_mix_host(hi: int, lo: int) -> int:
     return _fmix32((lo ^ (((hi << 7) | (hi >> 25)) & M) ^ 0xA511E9B3) & M)
 
 
+def _owner_mix_host_np(hi, lo):
+    """Vectorized host evaluation of :func:`_owner_mix` over uint32
+    numpy arrays — the bulk re-owner for resharding (every logged row
+    re-routed by fingerprint) and for tiered-sharded seeding.  Pinned
+    bit-identical to the scalar host mix (and therefore to the device
+    mix) by tests/test_tiered_sharded.py."""
+    hi = np.asarray(hi, np.uint32)
+    lo = np.asarray(lo, np.uint32)
+    rot = (hi << np.uint32(7)) | (hi >> np.uint32(25))
+    h = lo ^ rot ^ np.uint32(0xA511E9B3)
+    h ^= h >> np.uint32(16)
+    h = h * np.uint32(0x85EBCA6B)
+    h ^= h >> np.uint32(13)
+    h = h * np.uint32(0xC2B2AE35)
+    h ^= h >> np.uint32(16)
+    return h
+
+
 class ShardedTpuChecker(Checker):
     """Wavefront checker running one program per mesh device via shard_map."""
 
@@ -1851,9 +1869,15 @@ class ShardedTpuChecker(Checker):
                 raise ValueError(
                     f"sharded snapshot was written on a "
                     f"{int(snap['n_shards'])}-shard mesh and cannot "
-                    f"resume on {self._n} shards: global state ids "
-                    "encode the owner shard; re-run on a mesh of the "
-                    "same size (or restart the check from scratch)"
+                    f"resume on {self._n} shards directly: global "
+                    "state ids encode the owner shard, so the only "
+                    f"valid direct-resume size is "
+                    f"{int(snap['n_shards'])} shards; to continue this "
+                    f"run on a {self._n}-shard mesh, re-key the "
+                    "snapshot first with the `reshard` verb "
+                    "(stateright_tpu.tiered.reshard.reshard_snapshot) "
+                    "and resume the converted snapshot with the "
+                    "tiered-sharded engine"
                 )
             want_key = self._snapshot_key()
             got_key = str(snap["engine_key"])

@@ -805,7 +805,7 @@ def finalize_run(eng, carry_dict: dict) -> None:
         )
 
 
-def fingerprints_of_rows(cm, rows_np, canon=None):
+def fingerprints_of_rows(cm, rows_np, canon=None, sort=True):
     """Sorted uint64 fingerprints of a batch of packed state rows — the
     shared implementation behind both engines'
     ``discovered_fingerprints()``, so cross-engine discovery-set pins
@@ -830,7 +830,9 @@ def fingerprints_of_rows(cm, rows_np, canon=None):
     fps = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
         lo
     ).astype(np.uint64)
-    return np.sort(fps)
+    # sort=False keeps row order: resharding re-owners each logged row by
+    # its fingerprint and needs fps[i] to stay aligned with rows_np[i].
+    return np.sort(fps) if sort else fps
 
 
 def log_grow(eng, flags: int, grown: str, unique: int, depth: int) -> None:

@@ -105,6 +105,8 @@ def run_child(run_dir: str) -> int:
             checker = builder.spawn_tpu_sharded(**engine_kwargs)
         elif spec.engine == "tiered":
             checker = builder.spawn_tpu_tiered(**engine_kwargs)
+        elif spec.engine == "tiered-sharded":
+            checker = builder.spawn_tpu_tiered_sharded(**engine_kwargs)
         else:
             checker = builder.spawn_tpu(**engine_kwargs)
 

@@ -54,6 +54,10 @@ SHARDED_ENGINE = "tpu-sharded-bucketed-v3"
 # the serve scheduler additionally keys their LABEL by the job's
 # memory_budget_mb so entries never shadow each other across budgets.
 TIERED_ENGINE = "tpu-tiered-v3"
+# The composed engine shares neither geometry: its table is per-shard
+# AND budget-pinned, so entries must shadow neither sharded nor tiered
+# warm starts (the scheduler budget-keys the label here too).
+TIERED_SHARDED_ENGINE = "tpu-tiered-sharded-v1"
 
 # Serializes read-merge-write cycles within this process (two service
 # jobs storing knobs for different workloads must both survive).
@@ -102,7 +106,8 @@ def _write_all(cache_dir: str, data: dict) -> None:
 
 def load_knobs(cache_dir: str, key: str) -> Optional[dict]:
     """The cached kwargs dict for ``key``, or None.  Values come back as
-    plain ints (engine kwargs are all integer knobs)."""
+    plain ints (engine kwargs are all integer knobs — except the tiered
+    engines' fractional ``memory_budget_mb``, which stays a float)."""
     entry = _read_all(cache_dir).get(key)
     if not isinstance(entry, dict):
         return None
@@ -110,7 +115,10 @@ def load_knobs(cache_dir: str, key: str) -> Optional[dict]:
     if not isinstance(knobs, dict) or not knobs:
         return None
     try:
-        return {str(k): int(v) for k, v in knobs.items()}
+        return {
+            str(k): (float(v) if k == "memory_budget_mb" else int(v))
+            for k, v in knobs.items()
+        }
     except (TypeError, ValueError):
         return None
 
@@ -122,7 +130,13 @@ def store_knobs(cache_dir: str, key: str, knobs: dict, **meta) -> None:
     ``knobs`` is read back."""
     with _LOCK:
         data = _read_all(cache_dir)
-        data[key] = {"knobs": {k: int(v) for k, v in knobs.items()}, **meta}
+        # Geometry knobs are integers — EXCEPT memory_budget_mb, the
+        # tiered engines' fractional-MB budget (int() would floor the
+        # spill-forcing test budgets to 0 and change the derived cap).
+        data[key] = {"knobs": {
+            k: (float(v) if k == "memory_budget_mb" else int(v))
+            for k, v in knobs.items()
+        }, **meta}
         _write_all(cache_dir, data)
 
 

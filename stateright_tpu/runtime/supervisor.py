@@ -113,6 +113,9 @@ _DEFAULTS = {
 # The tiered engine is the single-chip engine plus a cold tier — same
 # knob names, same crash-relevant geometry axes.
 _DEFAULTS["tiered"] = _DEFAULTS["tpu"]
+# The composed engine is the sharded engine plus per-shard cold tiers:
+# sharded knob names (chunk_size), sharded defaults.
+_DEFAULTS["tiered-sharded"] = _DEFAULTS["sharded"]
 FRONTIER_FLOOR = 2048
 WAVES_PER_CALL_FLOOR = 8
 
@@ -279,7 +282,7 @@ class CheckSpec:
     model_factory: Callable
     factory_args: tuple = ()
     factory_kwargs: dict = field(default_factory=dict)
-    engine: str = "tpu"  # "tpu" | "sharded" | "tiered"
+    engine: str = "tpu"  # "tpu" | "sharded" | "tiered" | "tiered-sharded"
     engine_kwargs: dict = field(default_factory=dict)
     target_state_count: Optional[int] = None
     target_max_depth: Optional[int] = None

@@ -35,8 +35,8 @@ FAILED = "failed"
 CANCELLED = "cancelled"
 
 _ENGINES = (
-    "tpu", "tiered", "sharded", "bfs", "dfs", "simulation",
-    "tpu_simulation",
+    "tpu", "tiered", "sharded", "tiered-sharded", "bfs", "dfs",
+    "simulation", "tpu_simulation",
 )
 _FINISH_WHEN = ("all", "any", "any_failures", "all_failures")
 

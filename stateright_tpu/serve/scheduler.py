@@ -290,7 +290,9 @@ class Scheduler:
         builder = model.checker().threads(
             spec.threads or (os.cpu_count() or 1)
         )
-        device = engine in ("tpu", "tiered", "sharded", "tpu_simulation")
+        device = engine in (
+            "tpu", "tiered", "sharded", "tiered-sharded", "tpu_simulation",
+        )
         depth = spec.target_max_depth
         if depth is None:
             depth = (
@@ -319,6 +321,8 @@ class Scheduler:
             return builder.spawn_tpu_tiered(**engine_kwargs)
         if engine == "sharded":
             return builder.spawn_tpu_sharded(**engine_kwargs)
+        if engine == "tiered-sharded":
+            return builder.spawn_tpu_tiered_sharded(**engine_kwargs)
         if engine == "bfs":
             return builder.spawn_bfs()
         if engine == "dfs":
@@ -368,7 +372,9 @@ class Scheduler:
         # sharded knob set — chunk_size/bucket_slack — is disjoint from
         # the single-chip one, and tiered entries pin the budget-derived
         # capacity, which must never shadow the in-HBM right-sizing).
-        device_engine = spec.engine in ("tpu", "tiered", "sharded")
+        device_engine = spec.engine in (
+            "tpu", "tiered", "sharded", "tiered-sharded",
+        )
         if (
             device_engine
             and spec.use_knob_cache
@@ -377,12 +383,14 @@ class Scheduler:
             label = workload_label(
                 spec.workload, n, spec.network, spec.symmetry
             )
-            if spec.engine == "tiered":
+            if spec.engine in ("tiered", "tiered-sharded"):
                 # Tiered entries pin a budget-DERIVED capacity (and a
                 # possibly budget-shrunk frontier), so the budget is
                 # part of the entry's identity: without it, one
                 # budget's tiny pinned table would silently warm-start
                 # the same workload at a different (or no) budget.
+                # Tiered-sharded budgets are PER SHARD, but the engine
+                # tag already separates the two entry families.
                 label += ":mb={}".format(
                     spec.engine_kwargs.get("memory_budget_mb")
                 )
@@ -572,12 +580,15 @@ class Scheduler:
         them)."""
         from ..runtime.knob_cache import (
             SHARDED_ENGINE, SINGLE_CHIP_ENGINE, TIERED_ENGINE,
+            TIERED_SHARDED_ENGINE,
         )
 
         if engine == "sharded":
             return SHARDED_ENGINE
         if engine == "tiered":
             return TIERED_ENGINE
+        if engine == "tiered-sharded":
+            return TIERED_SHARDED_ENGINE
         return SINGLE_CHIP_ENGINE
 
     @staticmethod
@@ -618,6 +629,15 @@ class Scheduler:
             out["step_lanes"] = step_rung
         if "sortless" in m:
             out["sortless"] = int(bool(m["sortless"]))
+        # The tiered-sharded engine's PER-SHARD budget is part of its
+        # geometry identity (it derives cap_s, which the snapshot and
+        # the warm start must agree on); a float, so it bypasses the
+        # int() cast above.  The budget-keyed cache label already
+        # separates budgets — storing it here makes the warm-started
+        # spawn self-describing even without the label.
+        if m.get("engine") == "tpu-tiered-sharded" and \
+                m.get("memory_budget_mb") is not None:
+            out["memory_budget_mb"] = float(m["memory_budget_mb"])
         return out
 
     def _poll_to_completion(self, job: Job, checker) -> None:

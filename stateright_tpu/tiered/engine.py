@@ -119,14 +119,23 @@ class TieredTpuChecker(TpuChecker):
         that triggers an LSM merge.  ``cold_dir``: optional directory —
         when set, runs live on disk memory-mapped (the disk tier).
 
-        Unsupported base-engine modes fail loudly: ``trace=True`` (this
-        loop is already per-wave host-driven; trace the in-HBM engine
-        instead) and visitors (they force tracing)."""
-        if kwargs.get("trace"):
+        ``trace=True`` produces per-wave phase breakdowns like the
+        in-HBM engine's traced mode — the tiered loop already dispatches
+        the traced-mode phase kernels separately, so tracing only adds
+        the per-phase sync, not a mode switch.  The phase set gains
+        ``cold_probe`` (the pre-commit merge-join; host-classed like
+        ``readback``, obs/trace.py).  Visitors stay unsupported (they
+        require the base traced readback path)."""
+        # Intercepted, NOT forwarded: base trace=True would dispatch
+        # _check_once_traced, which knows nothing of the tiers.  The
+        # tiered loop does its own phase timing in _wl_call instead.
+        self._t_trace = bool(kwargs.pop("trace", False))
+        self._t_trace_last = None
+        if self._t_trace and kwargs.get("resume_from") is not None:
             raise ValueError(
-                "spawn_tpu_tiered(trace=True) is not supported: the "
-                "tiered loop is already host-driven per wave; run the "
-                "roofline trace on the in-HBM engine (spawn_tpu)"
+                "spawn_tpu_tiered(trace=True) does not support "
+                "resume_from: tracing is a diagnostic mode; resume the "
+                "run untraced and trace a fresh (bounded) run instead"
             )
         if options._visitor is not None:
             raise ValueError(
@@ -331,6 +340,7 @@ class TieredTpuChecker(TpuChecker):
         import jax.numpy as jnp
 
         key_hi, key_lo, rows, parent, ebits = carry
+        self._t_trace_last = None  # set per COMMITTED wave below
         td = self._options._target_max_depth or 0
         if (
             self._t_level_end <= self._t_level_start
@@ -349,6 +359,11 @@ class TieredTpuChecker(TpuChecker):
         f_eff = self._step_width()  # the live step-geometry rung
         count = min(self._t_level_end - self._t_level_start, f_eff)
         disc_prev = self._t_disc  # t_step does not donate it
+        trace = self._t_trace
+        if trace:
+            import jax
+
+            t = [time.perf_counter()]
         (
             disc, eb, _states, cand_rows, cand_src, cand_act,
             _n_valid_d, v_ovf_d, gen_d, stepflag_d,
@@ -356,11 +371,20 @@ class TieredTpuChecker(TpuChecker):
             rows, ebits, disc_prev,
             jnp.uint32(self._t_level_start), jnp.uint32(self._t_level_end),
         )
+        if trace:
+            jax.block_until_ready(cand_rows)
+            t.append(time.perf_counter())
         hi, lo = progs["fp"](cand_rows)
+        if trace:
+            jax.block_until_ready(lo)
+            t.append(time.perf_counter())
         (
             key_hi, key_lo, u_new, u_origin, n_new_d, probe_ok_d,
-            dd_ovf_d, _rounds_d,
+            dd_ovf_d, rounds_d,
         ) = progs["insert"](key_hi, key_lo, hi, lo, cand_act)
+        if trace:
+            jax.block_until_ready(key_lo)
+            t.append(time.perf_counter())
         n_new_hot = int(np.asarray(n_new_d))
         flags = 0
         if (
@@ -380,12 +404,17 @@ class TieredTpuChecker(TpuChecker):
             # chunk rung and re-run — the base engine's contract.
             flags |= 128
 
+        if trace:
+            t.append(time.perf_counter())  # readback: the scalar syncs
+
         cold = None
         fresh, n_fresh = u_new, n_new_hot
         if flags == 0 and n_new_hot and self._cold.run_count:
             fresh, n_fresh, cold = self._cold_filter(
                 hi, lo, u_new, u_origin, n_new_hot
             )
+        if trace:
+            t.append(time.perf_counter())
         if flags == 0 and self._t_tail + n_fresh > self._log_capacity:
             flags |= 2
 
@@ -395,6 +424,30 @@ class TieredTpuChecker(TpuChecker):
                 u_origin, jnp.uint32(self._t_level_start),
                 jnp.uint32(self._t_tail),
             )
+            if trace:
+                jax.block_until_ready(ebits)
+                t.append(time.perf_counter())
+                from ..parallel.wave_common import two_phase_capable
+
+                phases = {
+                    "step": t[1] - t[0],
+                    "canon": t[2] - t[1],
+                    "dedup": t[3] - t[2],
+                    "readback": t[4] - t[3],
+                    "cold_probe": t[5] - t[4],
+                    "append": t[6] - t[5],
+                }
+                # Modeled device bytes: the base phase model (these ARE
+                # the base phase kernels) — cold_probe bytes stay out of
+                # the HBM model (host-classed, obs/trace.py) and ride
+                # the cold accounting instead.
+                self._t_trace_last = self._tracer.record_wave(
+                    phases,
+                    self._traced_wave_bytes(
+                        int(np.asarray(rounds_d)),
+                        two_phase_capable(self._compiled),
+                    ),
+                )
             self._hot_entries += n_new_hot
             self._t_tail += n_fresh
             self._t_unique += n_fresh
@@ -444,6 +497,10 @@ class TieredTpuChecker(TpuChecker):
         if self._t_cold_last is not None:
             extra["cold_passes"] = self._t_cold_last["passes"]
             extra["cold_bytes"] = self._t_cold_last["bytes"]
+        if self._t_trace_last is not None:
+            # Traced runs: the wave's phase breakdown rides the shared
+            # loop's journal "wave" event, like the base traced loop.
+            extra.update(self._t_trace_last)
         return WaveView(
             waves_this_call=1,
             remaining=self._t_level_end - self._t_level_start,
@@ -802,11 +859,19 @@ class TieredTpuChecker(TpuChecker):
 
             from ..parallel.wave_loop import FusedWaveLoop, finalize_run
 
+            if self._t_trace:
+                from ..obs.trace import WaveTracer
+
+                self._tracer = WaveTracer(self._device, "tpu-tiered")
             self._loop_qcap, self._loop_pad = qcap, pad
             carry = (key_hi, key_lo, rows, parent, ebits)
             carry, _waves = FusedWaveLoop(self).run(carry, deadline)
             key_hi, key_lo, rows, parent, ebits = carry
             self._tables_dev = (parent, rows)
+            if self._tracer is not None and self._journal:
+                self._journal.append(
+                    "trace_summary", **self._tracer.summary()
+                )
             finalize_run(self, self._carry_from(
                 key_hi, key_lo, rows, parent, ebits, self._stats_np()
             ))

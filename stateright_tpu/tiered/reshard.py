@@ -1,0 +1,377 @@
+"""Elastic resharding: re-key a sharded snapshot onto a different mesh.
+
+Sharded global state ids encode the owner shard, so a snapshot written
+on an n-shard mesh cannot simply resume on m != n shards — every parent
+pointer and discovery gid would point at the wrong row.  This module is
+the offline translation: it reads a snapshot written by EITHER sharded
+engine (``ShardedTpuChecker`` slot-layout or ``TieredShardedTpuChecker``
+positional-log layout), re-routes every row to its owner under the new
+mesh width with the SAME host owner mix the engines use
+(``_owner_mix_host_np`` — host/device parity is pinned by tests), and
+writes a **tiered-sharded** snapshot for the new width:
+
+- each new shard's log keeps BFS segment order (visited rows, then the
+  current frontier, then the accumulating next level — within a segment,
+  old shards in index order, old log order within a shard), so a resume
+  continues the same level structure the old run was mid-way through;
+- parent gids and per-shard discovery gids are remapped through the full
+  old-gid → new-gid table;
+- the hot tier restarts EMPTY: every row's fingerprint becomes one
+  sorted cold run per new shard (the log is the source of truth for
+  rows; cold runs only need fingerprints), so the resumed run's first
+  waves rebuild hot occupancy organically and correctness never depends
+  on re-splitting the old hot/cold watermarks.
+
+The output is always a tiered-sharded snapshot — resume it with
+``spawn_tpu_tiered_sharded`` (or ``check-tpu --tiered --sharded=M
+--resume``).  The discovery-set bit-equality pin holds across the
+conversion: dedup is exact and the level structure is preserved, so the
+continued run visits exactly the states the uninterrupted run would
+(tests/test_tiered_sharded.py pins 8→4 and 4→8 against the
+unconstrained engine).
+
+Everything here runs on the host (single-device fingerprint evaluation,
+no mesh), so a snapshot can be resharded on a coordinator node — or any
+CPU — without claiming the target mesh.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..parallel.sharded import NO_GID, S_DISC, S_LEVEL_START, \
+    S_LEVEL_END, S_TAIL, S_SC_LO, S_SC_HI, S_UNIQUE_G, S_DEPTH, \
+    S_CAND_LO, S_CAND_HI, _owner_mix_host_np
+
+_TS_SUFFIX = "+tiered-sharded-v1"
+
+
+def _rekey_engine_key(old_key: str, new_shards: int) -> str:
+    """The snapshot engine key with its shard-count element replaced.
+
+    Both sharded engine keys are ``repr()`` of a tuple whose index 6 is
+    the mesh width (parallel/sharded.py _snapshot_key), the tiered
+    variant with a version suffix appended; parse, substitute, re-repr."""
+    base = old_key
+    if base.endswith(_TS_SUFFIX):
+        base = base[: -len(_TS_SUFFIX)]
+    parts = list(ast.literal_eval(base))
+    parts[6] = new_shards
+    return repr(tuple(parts)) + _TS_SUFFIX
+
+
+def _segments_from_plain(snap, compiled, canon):
+    """Per-old-shard (rows, parent_gids, ebits, seg boundaries) in BFS
+    log order from a plain ShardedTpuChecker snapshot (slot-layout
+    store + insertion-order queue), plus an old-gid decoder."""
+    n = int(snap["n_shards"])
+    cap_s = int(snap["cap_s"])
+    slot_bits = cap_s.bit_length() - 1
+    w = compiled.state_width
+    store = np.asarray(snap["store"]).reshape(n, cap_s, w)
+    parent = np.asarray(snap["parent"]).reshape(n, cap_s)
+    ebits = np.asarray(snap["ebits"]).reshape(n, cap_s)
+    queue = np.asarray(snap["queue"]).reshape(n, -1)
+    stats = np.asarray(snap["stats"]).astype(np.int64).reshape(n, -1)
+    shards = []
+    # slot -> log position inverse, for decoding parent gids.
+    inv = np.zeros((n, cap_s), np.int64)
+    for d in range(n):
+        tail = int(stats[d, S_TAIL])
+        slots = queue[d, :tail].astype(np.int64)
+        inv[d, slots] = np.arange(tail)
+        shards.append({
+            "rows": store[d, slots],
+            "parent": parent[d, slots],
+            "ebits": ebits[d, slots],
+            "level_start": int(stats[d, S_LEVEL_START]),
+            "level_end": int(stats[d, S_LEVEL_END]),
+            "tail": tail,
+        })
+
+    def decode(g):
+        d = g >> slot_bits
+        return d, int(inv[d, g & (cap_s - 1)])
+
+    meta = {
+        "n": n,
+        "depth": int(stats[0, S_DEPTH]),
+        "unique": int(stats[0, S_UNIQUE_G]),
+        "states": (int(stats[0, S_SC_HI]) << 32) | int(stats[0, S_SC_LO]),
+        "cand": int(
+            (
+                (stats[:, S_CAND_HI] << 32) | stats[:, S_CAND_LO]
+            ).sum()
+        ),
+        "disc": stats[:, S_DISC:].astype(np.uint32),
+    }
+    return shards, decode, meta
+
+
+def _segments_from_tiered(snap, compiled):
+    """Same, from a TieredShardedTpuChecker snapshot (positional log:
+    gid = pos * n + shard, rows already in BFS order)."""
+    n = int(snap["n_shards"])
+    w = compiled.state_width
+    rows = np.asarray(snap["rows"]).reshape(n, -1, w)
+    parent = np.asarray(snap["parent"]).reshape(n, -1)
+    ebits = np.asarray(snap["ebits"]).reshape(n, -1)
+    starts = np.asarray(snap["ts_level_start"], np.int64)
+    ends = np.asarray(snap["ts_level_end"], np.int64)
+    tails = np.asarray(snap["ts_tails"], np.int64)
+    shards = []
+    for d in range(n):
+        tail = int(tails[d])
+        shards.append({
+            "rows": rows[d, :tail],
+            "parent": parent[d, :tail],
+            "ebits": ebits[d, :tail],
+            "level_start": int(starts[d]),
+            "level_end": int(ends[d]),
+            "tail": tail,
+        })
+
+    def decode(g):
+        return g % n, g // n
+
+    meta = {
+        "n": n,
+        "depth": int(snap["ts_depth"]),
+        "unique": int(snap["ts_unique"]),
+        "states": int(snap["ts_states"]),
+        "cand": int(np.asarray(snap["ts_cand"], np.int64).sum()),
+        "disc": np.asarray(snap["disc"]).astype(np.uint32),
+    }
+    return shards, decode, meta
+
+
+def reshard_snapshot(
+    model,
+    in_path: str,
+    out_path: str,
+    new_shards: int,
+    compiled=None,
+    journal=None,
+) -> dict:
+    """Re-key the sharded snapshot at ``in_path`` onto a ``new_shards``
+    mesh, writing a tiered-sharded snapshot at ``out_path``.
+
+    ``model`` identifies the checked system (the canonical fingerprints
+    and — under symmetry — the canonicalizer come from its compiled
+    form, exactly as the engines derive them).  Returns a summary dict
+    (per-new-shard tails, the re-keyed engine key, counters) and, when
+    ``journal`` is given, appends one ``reshard`` event to it."""
+    from ..parallel.compiled import compiled_model_for
+    from ..parallel.wave_loop import fingerprints_of_rows
+
+    if new_shards < 1:
+        raise ValueError("new_shards must be >= 1")
+    cm = compiled if compiled is not None else compiled_model_for(model)
+    snap = np.load(in_path, allow_pickle=False)
+    required = {"engine_key", "n_shards", "cap_s", "chunk"}
+    if not required.issubset(set(snap.files)):
+        raise ValueError(
+            f"{in_path} is not a sharded engine snapshot (missing "
+            f"{sorted(required - set(snap.files))})"
+        )
+    tiered_in = "ts_tails" in snap.files
+    # Canonical-fp snapshots carry a ("sym",) tail on the engine-key
+    # tuple; their ownership routing ran on canonical fingerprints, so
+    # the re-key must too.
+    key_str = str(snap["engine_key"])
+    key_tuple = ast.literal_eval(
+        key_str[: -len(_TS_SUFFIX)]
+        if key_str.endswith(_TS_SUFFIX) else key_str
+    )
+    canon = None
+    if "sym" in key_tuple[7:]:
+        from ..parallel.canon import make_canon
+
+        canon = make_canon(cm)
+        if canon is None:
+            raise ValueError(
+                "snapshot was written with symmetry canonicalization "
+                f"but {type(cm).__name__} declares no canonicalization"
+            )
+    if tiered_in:
+        old, decode, meta = _segments_from_tiered(snap, cm)
+    else:
+        old, decode, meta = _segments_from_plain(snap, cm, canon)
+    n = meta["n"]
+    m = int(new_shards)
+    w = cm.state_width
+
+    # Route every old row to its new owner (the engines' host owner
+    # mix on the canonical fingerprint — host/device parity pinned).
+    owners = []
+    fps_all = []
+    for seg in old:
+        if seg["tail"]:
+            fps = fingerprints_of_rows(cm, seg["rows"], canon, sort=False)
+        else:
+            fps = np.zeros((0,), np.uint64)
+        fps_all.append(fps)
+        owners.append(
+            _owner_mix_host_np(
+                (fps >> np.uint64(32)).astype(np.uint32),
+                (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            ).astype(np.int64) % m
+        )
+
+    # New logs keep the BFS segment order: visited ++ frontier ++ next,
+    # old shards in index order within each segment — the (d, pos) ->
+    # (e, new_pos) table doubles as the gid remap.
+    new_pos = [np.zeros(seg["tail"], np.int64) for seg in old]
+    new_owner = owners
+    counts = np.zeros(m, np.int64)
+    bounds = np.zeros((m, 2), np.int64)  # (level_start, level_end)
+    for bound_idx, lo_key, hi_key in (
+        (None, None, "level_start"),
+        (0, "level_start", "level_end"),
+        (1, "level_end", "tail"),
+    ):
+        if bound_idx is not None:
+            bounds[:, bound_idx] = counts
+        for d, seg in enumerate(old):
+            lo = 0 if lo_key is None else seg[lo_key]
+            hi = seg[hi_key]
+            for p in range(lo, hi):
+                e = int(new_owner[d][p])
+                new_pos[d][p] = counts[e]
+                counts[e] += 1
+
+    tails_new = counts
+    max_tail = int(tails_new.max()) if m else 0
+    log_cap = 1 << max(max_tail, 1).bit_length()  # >= 2x headroom
+    chunk = int(snap["chunk"])
+    pad = chunk
+    lp = log_cap + pad
+    if lp * m >= 0xFFFFFFFF:
+        raise ValueError(
+            f"resharding onto {m} shards needs {lp * m} global ids, "
+            "past the 32-bit gid space; use fewer, larger shards"
+        )
+
+    def remap_gid(g: int) -> int:
+        if g == NO_GID:
+            return NO_GID
+        d, p = decode(g)
+        return int(new_pos[d][p]) * m + int(new_owner[d][p])
+
+    rows_new = np.zeros((m, lp, w), np.uint32)
+    parent_new = np.full((m, lp), NO_GID, np.uint32)
+    ebits_new = np.zeros((m, lp), np.uint32)
+    cold_fps = [[] for _ in range(m)]
+    for d, seg in enumerate(old):
+        if not seg["tail"]:
+            continue
+        e = new_owner[d]
+        p = new_pos[d]
+        rows_new[e, p] = seg["rows"]
+        ebits_new[e, p] = seg["ebits"]
+        par = seg["parent"].astype(np.int64)
+        parent_new[e, p] = np.array(
+            [remap_gid(int(g)) for g in par], np.uint32
+        )
+        for j in range(m):
+            sel = e == j
+            if sel.any():
+                cold_fps[j].append(fps_all[d][sel])
+
+    n_props = meta["disc"].shape[1]
+    disc_new = np.full((m, n_props), NO_GID, np.uint32)
+    for d in range(n):
+        for p in range(n_props):
+            g = int(meta["disc"][d, p])
+            if g == NO_GID:
+                continue
+            g2 = remap_gid(g)
+            e = g2 % m
+            if disc_new[e, p] == NO_GID:
+                disc_new[e, p] = g2
+
+    # The whole log spills: one sorted cold run per new shard, hot tier
+    # empty (spill_tail == tail).  Run lengths are pre-sort counts; the
+    # store contract only needs each run internally sorted.
+    runs_per = np.zeros(m, np.int64)
+    flat_fps = []
+    flat_lens = []
+    for j in range(m):
+        fps = (
+            np.sort(np.concatenate(cold_fps[j]))
+            if cold_fps[j] else np.zeros((0,), np.uint64)
+        )
+        if fps.size:
+            flat_fps.append(fps)
+            flat_lens.append(fps.size)
+            runs_per[j] = 1
+    zeros_m = np.zeros(m, np.int64)
+    out = {
+        "engine_key": _rekey_engine_key(str(snap["engine_key"]), m),
+        "n_shards": np.int64(m),
+        "cap_s": np.int64(int(snap["cap_s"])),
+        "chunk": np.int64(chunk),
+        "rows": rows_new.reshape(m * lp, w),
+        "parent": parent_new.reshape(m * lp),
+        "ebits": ebits_new.reshape(m * lp),
+        "disc": disc_new,
+        "ts_level_start": bounds[:, 0],
+        "ts_level_end": bounds[:, 1],
+        "ts_tails": tails_new,
+        "ts_spill_tails": tails_new.copy(),
+        # Candidate accounting is global-true but per-shard-unknowable
+        # after a re-key; spread evenly so the sum survives.
+        "ts_cand": np.full(m, meta["cand"] // m, np.int64)
+        + (np.arange(m) < meta["cand"] % m),
+        "ts_depth": np.int64(meta["depth"]),
+        "ts_unique": np.int64(meta["unique"]),
+        "ts_states": np.uint64(meta["states"]),
+        "ts_log_cap": np.int64(log_cap),
+        "ts_cold_fps": (
+            np.concatenate(flat_fps)
+            if flat_fps else np.zeros((0,), np.uint64)
+        ),
+        "ts_cold_lens": np.asarray(flat_lens, np.int64),
+        "ts_cold_runs_per_shard": runs_per,
+        "ts_spill_counts": zeros_m,
+    }
+    for k in ("bucket_slack", "sort_lanes", "sortless", "step_lanes"):
+        if k in snap.files:
+            out[k] = np.asarray(snap[k])
+    tmp = out_path + ".tmp"
+    np.savez_compressed(tmp, **out)
+    # np.savez appends .npz to a suffix-less temp name.
+    tmp_written = tmp if os.path.exists(tmp) else tmp + ".npz"
+    os.replace(tmp_written, out_path)
+    summary = {
+        "in_path": in_path,
+        "out_path": out_path,
+        "old_shards": n,
+        "new_shards": m,
+        "unique": meta["unique"],
+        "depth": meta["depth"],
+        "tails": tails_new.tolist(),
+        "log_capacity": log_cap,
+        "engine_key": out["engine_key"],
+    }
+    if journal is not None:
+        # Accept a Journal or a path, like the engines' journal kwarg.
+        if isinstance(journal, (str, os.PathLike)):
+            from ..runtime.journal import Journal
+
+            j = Journal(os.fspath(journal))
+            try:
+                j.append("reshard", **{
+                    k: v for k, v in summary.items() if k != "engine_key"
+                })
+            finally:
+                j.close()
+        else:
+            journal.append("reshard", **{
+                k: v for k, v in summary.items() if k != "engine_key"
+            })
+    return summary

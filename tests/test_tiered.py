@@ -386,7 +386,11 @@ def test_cold_probe_binary_search_matches_host(tmp_path):
 def test_tiered_spawn_validation():
     m = TwoPhaseSys(rm_count=3)
     with pytest.raises(ValueError, match="trace"):
-        m.checker().spawn_tpu_tiered(capacity=256, trace=True)
+        # Traced tiered runs are supported (docs/OBSERVABILITY.md) but
+        # are diagnostic: they never resume.
+        m.checker().spawn_tpu_tiered(
+            capacity=256, trace=True, resume_from="nope.npz"
+        )
     with pytest.raises(ValueError, match="visitor"):
         m.checker().visitor(lambda *a: True).spawn_tpu_tiered(capacity=256)
     with pytest.raises(ValueError, match="spill_threshold"):
@@ -411,8 +415,9 @@ def test_tiered_cli_flags(capsys):
     assert rc == 0
     assert "unique=288" in out
     for bad in (
-        ["check-tpu", "3", "--tiered", "--sharded"],
-        ["check-tpu", "3", "--tiered", "--trace"],
+        # The COMPOSED engine has no traced mode; --tiered --sharded
+        # alone and --tiered --trace alone are both supported now.
+        ["check-tpu", "3", "--tiered", "--sharded", "--trace"],
         ["check", "3", "--tiered"],
         ["check-tpu", "3", "--memory-budget-mb", "nope"],
         ["check-tpu", "3", "--memory-budget-mb", "-2"],
@@ -420,6 +425,54 @@ def test_tiered_cli_flags(capsys):
         ["check-tpu", "3", "--memory-budget-mb", "inf"],
     ):
         assert example_main(cli_spec(), bad) == 2, bad
+
+
+def test_tiered_trace_breaks_out_cold_probe(tmp_path):
+    """ISSUE-17 satellite: `--tiered --trace` is supported — the tiered
+    loop times its own phases (the base traced loop knows nothing of
+    the tiers) and the wave breakdown gains the host-classed
+    ``cold_probe`` phase; the run still spills and still matches the
+    in-HBM engine."""
+    journal = str(tmp_path / "trace.jsonl")
+    ref = _plain(TwoPhaseSys(rm_count=3)).join()
+    t = _tiered(
+        TwoPhaseSys(rm_count=3), capacity=256, trace=True, journal=journal,
+    ).join()
+    assert t.unique_state_count() == ref.unique_state_count() == 288
+    assert np.array_equal(
+        t.discovered_fingerprints(), ref.discovered_fingerprints()
+    )
+
+    summary = t.trace_summary()
+    assert summary["traced_waves"] > 0
+    assert "cold_probe" in summary["wave_breakdown"]
+
+    events = read_journal(journal)
+    assert any(e["event"] == "spill" for e in events)
+    waves = [
+        e for e in events
+        if e["event"] == "wave" and "wave_breakdown" in e
+    ]
+    assert waves, "traced waves must journal their phase breakdown"
+    assert all("cold_probe" in w["wave_breakdown"] for w in waves)
+    assert any(e["event"] == "trace_summary" for e in events)
+
+
+def test_tiered_trace_cli(capsys):
+    """The CLI refusal is lifted: `check-tpu --tiered --trace` runs and
+    prints the parseable trace summary line."""
+    from stateright_tpu.cli import example_main
+    from stateright_tpu.models.twophase import cli_spec
+
+    rc = example_main(
+        cli_spec(),
+        ["check-tpu", "3", "--tiered", "--memory-budget-mb", "0.005",
+         "--trace"],
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unique=288" in out
+    assert "trace: " in out
 
 
 def test_tiered_serve_job_and_knob_cache(tmp_path):

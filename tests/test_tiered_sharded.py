@@ -1,0 +1,341 @@
+"""The composed tiered × sharded engine (tiered/sharded_engine.py) and
+elastic resharding (tiered/reshard.py): ISSUE-17's acceptance matrix —
+per-shard memory budgets force evictions into shard-local cold stores
+while ``discovered_fingerprints()`` stays bit-identical to the
+unconstrained engine at every mesh size, including across a supervised
+kill-mid-run resume and across an 8→4 / 4→8 mid-run reshard."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from stateright_tpu.runtime.journal import read_journal  # noqa: E402
+from stateright_tpu.tiered import ColdStore  # noqa: E402
+
+# 256 slots/shard: the spill-forcing budget for 2pc3's 288 uniques
+# (capacity_for_budget floors at 256; 288 states over <=2 shards cross
+# the 45% spill threshold repeatedly).
+FORCING_MB = 0.003
+
+
+def _mesh(n):
+    return jax.sharding.Mesh(
+        np.array(jax.devices("cpu")[:n]), ("shards",)
+    )
+
+
+def _ref(model):
+    return (
+        model.checker()
+        .spawn_tpu_sharded(mesh=_mesh(1), capacity=1 << 14,
+                           chunk_size=1 << 6)
+        .join()
+    )
+
+
+def _tiered_sharded(model, n, **kwargs):
+    kwargs.setdefault("memory_budget_mb", FORCING_MB)
+    kwargs.setdefault("chunk_size", 1 << 5)
+    return model.checker().spawn_tpu_tiered_sharded(
+        mesh=_mesh(n), **kwargs
+    )
+
+
+# --- host helpers (fast, no device work) -------------------------------------
+
+
+def test_owner_mix_host_np_matches_scalar():
+    """The vectorised owner router the reshard path uses must agree
+    with the scalar mix the engines pin (parallel/sharded.py)."""
+    from stateright_tpu.parallel.sharded import (
+        _owner_mix_host, _owner_mix_host_np,
+    )
+
+    rng = np.random.default_rng(17)
+    fps = rng.integers(0, 1 << 64, size=512, dtype=np.uint64)
+    hi = (fps >> np.uint64(32)).astype(np.uint64)
+    lo = (fps & np.uint64(0xFFFFFFFF)).astype(np.uint64)
+    vec = _owner_mix_host_np(hi, lo)
+    ref = np.asarray(
+        [_owner_mix_host(int(h), int(lw)) for h, lw in zip(hi, lo)],
+        dtype=np.uint64,
+    )
+    assert np.array_equal(vec, ref)
+
+
+def test_sibling_spill_dirs_never_clobber_or_cross_adopt(tmp_path):
+    """ISSUE-17 satellite: shard-local cold stores spill under sibling
+    ``shard_<d>/`` subdirectories; one shard's spills and LSM merges
+    must never touch — or be adopted by — a sibling's run files, and a
+    reopened store sees exactly its own runs."""
+    base = str(tmp_path / "cold")
+    d0, d1 = os.path.join(base, "shard_0"), os.path.join(base, "shard_1")
+    s0 = ColdStore(spill_dir=d0, max_runs=2)
+    s1 = ColdStore(spill_dir=d1, max_runs=2)
+    s0.add_run(np.asarray([2, 4], np.uint64))
+    s1.add_run(np.asarray([3, 5], np.uint64))
+    s0.add_run(np.asarray([6], np.uint64))
+    # s0 crosses max_runs -> merge rewrites ITS disk set only.
+    s0.add_run(np.asarray([8], np.uint64))
+    assert s0.run_count == 1 and s0.entries == 4
+    assert s1.run_count == 1 and s1.entries == 2
+    assert s1.contains([3, 5, 2]).tolist() == [True, True, False]
+    files0 = {os.path.join(d0, f) for f in os.listdir(d0)}
+    files1 = {os.path.join(d1, f) for f in os.listdir(d1)}
+    assert files0 and files1 and not files0 & files1
+    # Reopening each directory adopts only that shard's runs.
+    r0 = ColdStore.open(d0, max_runs=2)
+    r1 = ColdStore.open(d1, max_runs=2)
+    assert r0.entries == 4 and r0.contains([2, 4, 6, 8]).all()
+    assert not r0.contains([3, 5]).any()
+    assert r1.entries == 2 and r1.contains([3, 5]).all()
+    assert not r1.contains([2, 4, 6, 8]).any()
+
+
+def test_tiered_sharded_spawn_validation():
+    m = TwoPhaseSys(rm_count=3)
+    with pytest.raises(ValueError, match="trace"):
+        m.checker().spawn_tpu_tiered_sharded(trace=True)
+    with pytest.raises(ValueError, match="spill_threshold"):
+        m.checker().spawn_tpu_tiered_sharded(spill_threshold=0.9)
+
+
+def test_tiered_sharded_cli_refusals():
+    """The composed engine has no traced mode, and plain --sharded
+    still refuses CLI supervision (only the tiered-sharded snapshot
+    carries everything a restart needs)."""
+    from stateright_tpu.cli import example_main
+    from stateright_tpu.models.twophase import cli_spec
+
+    for bad in (
+        ["check-tpu", "3", "--tiered", "--sharded", "--trace"],
+        ["check-tpu", "3", "--sharded", "--supervise",
+         "--checkpoint-dir", "/tmp/nope"],
+        ["reshard", "3", "in.npz", "out.npz"],          # missing --shards
+        ["reshard", "3", "--shards", "4"],              # missing paths
+        ["reshard", "3", "in.npz", "out.npz", "--shards", "zero"],
+    ):
+        assert example_main(cli_spec(), bad) == 2, bad
+
+
+# --- the acceptance pins (device-compiling; slow) ----------------------------
+
+
+@pytest.mark.slow
+def test_tiered_sharded_bit_identical_across_mesh_sizes(tmp_path):
+    """The universal gate at 1/2/4/8 virtual shards: per-shard budgets
+    force spills (at the widths where per-shard load crosses the
+    threshold) and the discovery set stays bit-identical to the
+    unconstrained engine."""
+    model = TwoPhaseSys(rm_count=3)
+    ref = _ref(model)
+    ref_fps = ref.discovered_fingerprints()
+    spilled_any = False
+    for n in (1, 2, 4, 8):
+        journal = str(tmp_path / f"ts{n}.jsonl")
+        t = _tiered_sharded(model, n, journal=journal).join()
+        m = t.metrics()
+        assert t.unique_state_count() == ref.unique_state_count() == 288
+        assert t.state_count() == ref.state_count()
+        assert t.max_depth() == ref.max_depth()
+        assert sorted(t.discoveries()) == sorted(ref.discoveries())
+        assert np.array_equal(t.discovered_fingerprints(), ref_fps)
+        events = read_journal(journal)
+        spills = [e for e in events if e["event"] == "spill"]
+        # Spill events are per shard and carry the owner.
+        assert all(0 <= e["shard"] < n for e in spills)
+        assert len(spills) == m.get("spills", 0) or m.get("spills", 0) > 0
+        if spills:
+            spilled_any = True
+            assert m["cold_entries"] > 0
+    assert spilled_any, "the forcing budget never spilled at any width"
+
+
+@pytest.mark.slow
+def test_tiered_sharded_kill_mid_run_supervised_resume(
+    tmp_path, monkeypatch
+):
+    """The robustness pin: a supervised tiered-sharded child (virtual
+    8-wide mesh, spill-forcing budget) dies the moment its first
+    checkpoint lands, auto-resumes — rebuilding the hot planes and
+    re-adopting the per-shard cold stores from the snapshot — and
+    reports the same totals and discovery set as an uninterrupted
+    run."""
+    from stateright_tpu.runtime.supervisor import (
+        CheckSpec, RunSupervisor, SupervisorConfig,
+    )
+
+    model = TwoPhaseSys(rm_count=3)
+    ref = _ref(model)
+
+    monkeypatch.setenv(
+        "STATERIGHT_RUNTIME_FAULT_EXIT_AFTER_CHECKPOINT", "137"
+    )
+    run_dir = str(tmp_path / "run")
+    spec = CheckSpec(
+        model_factory=TwoPhaseSys,
+        factory_kwargs={"rm_count": 3},
+        engine="tiered-sharded",
+        engine_kwargs={
+            "memory_budget_mb": FORCING_MB,
+            "chunk_size": 1 << 5,
+        },
+    )
+    sup = RunSupervisor(
+        SupervisorConfig(
+            run_dir=run_dir,
+            checkpoint_every_waves=1,
+            checkpoint_every_sec=None,
+            call_deadline_sec=240.0,
+            poll_interval_sec=0.05,
+            max_restarts=2,
+        ),
+        spec=spec,
+    )
+    result = sup.run()
+
+    assert result["completed"]
+    assert result["unique_state_count"] == ref.unique_state_count()
+    assert result["state_count"] == ref.state_count()
+    assert result["max_depth"] == ref.max_depth()
+    assert result["discoveries"] == sorted(ref.discoveries())
+
+    events = read_journal(os.path.join(run_dir, "journal.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert "checkpoint" in kinds
+    assert "crash" in kinds
+    assert "resume" in kinds
+    assert kinds.count("run_start") == 2
+
+
+@pytest.mark.slow
+def test_tiered_sharded_reshard_resume_both_directions(tmp_path):
+    """Elastic resharding: a mid-run 8-shard checkpoint re-keyed to 4
+    shards resumes to the exact unconstrained result, and a 4-shard
+    checkpoint re-keyed to 8 does too (the widening AND narrowing
+    directions of the acceptance matrix)."""
+    from stateright_tpu.tiered.reshard import reshard_snapshot
+
+    model = TwoPhaseSys(rm_count=3)
+    ref = _ref(model)
+    ref_fps = ref.discovered_fingerprints()
+
+    for n_from, n_to in ((8, 4), (4, 8)):
+        ck = str(tmp_path / f"ck{n_from}.npz")
+        part = (
+            model.checker()
+            .target_max_depth(5)
+            .spawn_tpu_tiered_sharded(
+                mesh=_mesh(n_from), memory_budget_mb=FORCING_MB,
+                chunk_size=1 << 5, checkpoint_path=ck,
+                checkpoint_every_waves=1,
+            )
+            .join()
+        )
+        assert part.max_depth() <= 5  # genuinely mid-run
+        out = str(tmp_path / f"rs{n_from}to{n_to}.npz")
+        journal = str(tmp_path / f"rs{n_from}to{n_to}.jsonl")
+        summary = reshard_snapshot(model, ck, out, n_to, journal=journal)
+        assert summary["old_shards"] == n_from
+        assert summary["new_shards"] == n_to
+        assert len(summary["tails"]) == n_to
+        assert any(
+            e["event"] == "reshard" for e in read_journal(journal)
+        )
+
+        # Direct resume on the WRONG width stays loud and names the
+        # reshard verb (ISSUE-17 satellite).
+        with pytest.raises(ValueError, match="reshard"):
+            model.checker().spawn_tpu_tiered_sharded(
+                mesh=_mesh(n_from), memory_budget_mb=FORCING_MB,
+                chunk_size=1 << 5, resume_from=out,
+            ).join()
+
+        res = (
+            model.checker()
+            .spawn_tpu_tiered_sharded(
+                mesh=_mesh(n_to), memory_budget_mb=FORCING_MB,
+                chunk_size=1 << 5, resume_from=out,
+            )
+            .join()
+        )
+        assert res.unique_state_count() == ref.unique_state_count()
+        assert res.state_count() == ref.state_count()
+        assert res.max_depth() == ref.max_depth()
+        assert sorted(res.discoveries()) == sorted(ref.discoveries())
+        assert np.array_equal(res.discovered_fingerprints(), ref_fps)
+
+
+@pytest.mark.slow
+def test_plain_sharded_snapshot_resharded_into_tiered(tmp_path):
+    """The migration path: an UN-tiered sharded checkpoint reshards
+    into a tiered-sharded snapshot and finishes under the composed
+    engine with the identical discovery set."""
+    from stateright_tpu.tiered.reshard import reshard_snapshot
+
+    model = TwoPhaseSys(rm_count=3)
+    ref = _ref(model)
+    ck = str(tmp_path / "plain.npz")
+    (
+        model.checker()
+        .target_max_depth(6)
+        .spawn_tpu_sharded(
+            mesh=_mesh(4), capacity=1 << 14, chunk_size=1 << 6,
+            checkpoint_path=ck, checkpoint_every_waves=1,
+        )
+        .join()
+    )
+    out = str(tmp_path / "plain_rs2.npz")
+    reshard_snapshot(model, ck, out, 2)
+    res = (
+        model.checker()
+        .spawn_tpu_tiered_sharded(
+            mesh=_mesh(2), capacity=(1 << 12) * 2, chunk_size=1 << 6,
+            resume_from=out,
+        )
+        .join()
+    )
+    assert res.unique_state_count() == ref.unique_state_count()
+    assert res.state_count() == ref.state_count()
+    assert np.array_equal(
+        res.discovered_fingerprints(), ref.discovered_fingerprints()
+    )
+
+
+@pytest.mark.slow
+def test_tiered_sharded_serve_job(tmp_path):
+    """A tiered-sharded service job completes, reports its engine, and
+    persists its budget-keyed geometry under the composed engine's own
+    knob tag (never shadowing sharded or tiered entries)."""
+    from stateright_tpu.runtime.knob_cache import (
+        TIERED_SHARDED_ENGINE, knob_key, load_knobs,
+    )
+    from stateright_tpu.serve import CheckService
+    from stateright_tpu.serve.workloads import workload_label
+
+    knobs = str(tmp_path / "knobs")
+    svc = CheckService(journal=None, knob_cache_dir=knobs)
+    try:
+        spec = {
+            "workload": "twophase", "n": 3, "engine": "tiered-sharded",
+            "engine_kwargs": {"memory_budget_mb": FORCING_MB},
+        }
+        job = svc.submit(dict(spec))
+        assert job.wait(timeout=240)
+        assert job.state == "done", (job.state, job.error)
+        assert job.result["unique_state_count"] == 288
+        assert job.result["engine"] == "tiered-sharded"
+        key = knob_key(
+            workload_label("twophase", 3, None, False)
+            + ":mb={}".format(FORCING_MB),
+            engine=TIERED_SHARDED_ENGINE,
+        )
+        stored = load_knobs(knobs, key)
+        assert stored is not None
+        assert stored.get("memory_budget_mb") == FORCING_MB
+    finally:
+        svc.scheduler.shutdown()
