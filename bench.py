@@ -1138,6 +1138,105 @@ def phase_serving(record: dict) -> None:
         svc.scheduler.shutdown()
 
 
+FLEET_BOUNDS = tuple(range(5, 13))  # 8 gang-compatible grid walks
+
+
+def phase_fleet(record: dict) -> None:
+    """Fleet gang-batching phase (fleet/, docs/SERVING.md "Fleet
+    mode"): the same 8 gang-compatible jobs — one workload family,
+    differing constants — drained twice through a real fleet worker,
+    once serialized solo (``gang_max=1``: every job compiles its own
+    constant-baked program, the pre-fleet cost model) and once
+    gang-batched (one program, constants as data, one device dispatch
+    per wave).  The GOLDEN GATE is verdict equality: every job's
+    unique/state counts, depth, property rows, and discoveries must
+    match between the two drains AND the known (bound+1)^2 closed form,
+    or no rate is posted.  The gauge is gang-batched jobs/sec over the
+    serialized baseline — the fleet's reason to exist on small jobs."""
+    import tempfile
+
+    from stateright_tpu.fleet import FleetStore, FleetWorker
+    from stateright_tpu.serve.jobs import JobSpec
+
+    if budget_remaining() < 120.0:
+        raise AssertionError(
+            f"global time budget too low ({budget_remaining():.0f}s left)"
+        )
+
+    def drain(gang_max: int):
+        root = tempfile.mkdtemp(prefix=f"bench-fleet-g{gang_max}-")
+        store = FleetStore(root)
+        ids = [
+            store.submit(JobSpec.from_dict(
+                {"workload": "grid_walk", "n": b, "engine": "tpu"}
+            ))
+            for b in FLEET_BOUNDS
+        ]
+        worker = FleetWorker(root, poll_interval=0.005,
+                             gang_max=gang_max)
+        t0 = time.perf_counter()
+        worker.run(once=True)
+        elapsed = time.perf_counter() - t0
+        view = store.fold()
+        results = {}
+        for jid, b in zip(ids, FLEET_BOUNDS):
+            assert view.jobs[jid]["state"] == "done", (
+                f"fleet job (bound={b}, gang_max={gang_max}) "
+                f"{view.jobs[jid]['state']}: {view.jobs[jid]['error']}"
+            )
+            results[b] = store.read_result(jid)
+        return elapsed, results, view
+
+    solo_sec, solo_results, _ = drain(gang_max=1)
+    gang_sec, gang_results, gang_view = drain(gang_max=8)
+    assert gang_view.counters["gang_dispatches"] >= 1, (
+        "gang drain never gang-batched"
+    )
+    occupancy = (
+        gang_view.counters["gang_jobs_batched"]
+        / gang_view.counters["gang_dispatches"]
+    )
+
+    # The golden gate: per-job verdicts bit-equal across drains and
+    # matching the closed form — a fast wrong answer posts nothing.
+    for b in FLEET_BOUNDS:
+        for key in ("unique_state_count", "state_count", "max_depth",
+                    "properties", "violation", "discoveries"):
+            assert solo_results[b][key] == gang_results[b][key], (
+                f"fleet verdict mismatch (bound={b}, {key}): "
+                f"{solo_results[b][key]!r} != {gang_results[b][key]!r}"
+            )
+        assert gang_results[b]["unique_state_count"] == (b + 1) ** 2, (
+            f"fleet golden mismatch (bound={b}): "
+            f"{gang_results[b]['unique_state_count']} != {(b + 1) ** 2}"
+        )
+
+    speedup = solo_sec / gang_sec if gang_sec > 0 else 0.0
+    jobs = len(FLEET_BOUNDS)
+    assert speedup >= 2.0, (
+        f"gang batching only {speedup:.2f}x over serialized solo "
+        f"({solo_sec:.2f}s -> {gang_sec:.2f}s for {jobs} jobs); "
+        "the fleet gauge demands >= 2x"
+    )
+    record["fleet"] = {
+        "workload": "grid_walk_family",
+        "jobs": jobs,
+        "solo_sec": round(solo_sec, 3),
+        "gang_sec": round(gang_sec, 3),
+        "solo_jobs_per_sec": round(jobs / solo_sec, 2),
+        "gang_jobs_per_sec": round(jobs / gang_sec, 2),
+        "gang_occupancy": round(occupancy, 2),
+        "gang_dispatches": gang_view.counters["gang_dispatches"],
+    }
+    # Top-level gauge the trajectory table tracks (obs/report.py).
+    record["gang_speedup"] = round(speedup, 2)
+    log(
+        f"fleet: {jobs} gang-compatible jobs, serialized {solo_sec:.2f}s "
+        f"-> gang {gang_sec:.2f}s ({speedup:.1f}x, occupancy "
+        f"{occupancy:.1f}); verdicts bit-equal across both drains"
+    )
+
+
 TIERED_RM = 5
 TIERED_BUDGET_MB = 0.05  # -> 4096-slot hot tier vs 8,832 uniques
 
@@ -1689,6 +1788,7 @@ OPTIONAL_PHASES = (
     "trajectory",
     "denominator_native",
     "serving",
+    "fleet",
     "recheck",
     "ensemble",
     "tiered",
@@ -1759,6 +1859,7 @@ def main() -> None:
         "trajectory": phase_trajectory,
         "denominator_native": phase_denominator_native,
         "serving": phase_serving,
+        "fleet": phase_fleet,
         "recheck": phase_recheck,
         "ensemble": phase_ensemble,
         "tiered": phase_tiered,

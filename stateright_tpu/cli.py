@@ -51,6 +51,15 @@ def _usage(name: str, spec: "CliSpec") -> str:
     lines.append(
         "  serve [ADDRESS] [--journal PATH] [--journal-max-mb MB]"
         " [--knob-cache DIR] [--workers N] [--store-dir DIR]"
+        " [--fleet-dir DIR]"
+    )
+    lines.append(
+        "  fleet-worker --fleet-dir DIR [--knob-cache DIR]"
+        " [--lease-sec S] [--gang-max K] [--accept-big]"
+        " [--preempt-after S] [--once]"
+    )
+    lines.append(
+        "  fleet {submit|status|cancel|quota} --fleet-dir DIR ..."
     )
     lines.append(
         f"  submit [{n_meta}]{net} [--address ADDR] [--engine ENGINE]"
@@ -1300,6 +1309,21 @@ def example_main(spec: CliSpec, argv=None) -> int:
         if store_dir is not None:
             args = args + ["--store-dir", store_dir]
         return serve_main(args)
+
+    if sub == "fleet-worker":
+        # One fleet worker process: claims jobs from the shared durable
+        # store and runs them on this process's backend (fleet/worker.py,
+        # docs/SERVING.md "Fleet mode").
+        from .fleet.worker import worker_main
+
+        return worker_main(args)
+
+    if sub == "fleet":
+        # Fleet operator verbs: submit/status/cancel/quota against a
+        # fleet directory (fleet/__main__.py).
+        from .fleet.__main__ import main as fleet_main
+
+        return fleet_main(args)
 
     if sub == "submit":
         return _run_submit(spec, args)

@@ -1,0 +1,43 @@
+"""Fleet serving: durable multi-worker scheduling over a shared
+directory (docs/SERVING.md "Fleet mode").
+
+The in-process service (serve/) runs N scheduler threads against the
+one mesh its process owns; the fleet runs N PROCESSES — each owning
+its own backend (a CPU container, a GPU box, a TPU mesh) — against one
+durable on-disk job store.  Four pieces:
+
+- fleet/store.py — the store: an fsync'd journal as the single source
+  of truth plus O_EXCL lock files for claim races.  kill -9 any worker;
+  no accepted job is lost.
+- fleet/gang.py — gang batching: K compatible small jobs become ONE
+  device dispatch over a leading jobs axis, with per-job verdicts
+  bit-identical to K solo runs.
+- fleet/placement.py — heterogeneous placement: small jobs to
+  commodity workers, TPU meshes reserved for jobs that need them.
+- fleet/worker.py / fleet/service.py — the worker loop and the
+  fleet-backed HTTP service (same endpoints as serve/server.py).
+
+``python -m stateright_tpu.fleet worker|submit|status|cancel|quota``
+or the ``fleet-worker`` / ``fleet`` CLI verbs drive it.
+"""
+
+from .gang import GangMemberChecker, gang_eligibility, run_gang
+from .placement import (
+    describe_worker, estimate_unique, is_big, placement_order,
+    worker_takes,
+)
+from .service import FleetJobView, FleetService
+from .store import (
+    CANCELLED, COUNTERS, DONE, FAILED, FleetStore, FleetView, QUEUED,
+    QuotaExceeded, RUNNING, TERMINAL,
+)
+from .worker import FleetWorker, worker_main
+
+__all__ = [
+    "CANCELLED", "COUNTERS", "DONE", "FAILED", "FleetJobView",
+    "FleetService", "FleetStore", "FleetView", "FleetWorker",
+    "GangMemberChecker", "QUEUED", "QuotaExceeded", "RUNNING",
+    "TERMINAL", "describe_worker", "estimate_unique",
+    "gang_eligibility", "is_big", "placement_order", "run_gang",
+    "worker_main", "worker_takes",
+]

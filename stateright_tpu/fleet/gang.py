@@ -1,0 +1,456 @@
+"""Gang batching: K compatible small jobs in ONE device dispatch.
+
+The fleet's small-job traffic is dominated by parameter sweeps — the
+same workload module at different constants (grid walks at different
+bounds, counters at different caps).  Run solo, each job pays a full
+program trace/compile (its constants are baked into the traced step)
+and a device round-trip per wave for a frontier that fills a sliver of
+a chip.  The gang runner instead stacks K such jobs on a leading *jobs*
+axis and drives ONE jitted wave program over ``[K, F, W]`` frontiers,
+with each job's constants riding a traced ``[K, C]`` input — so every
+member of a gang family shares one compiled program AND one device
+dispatch per wave.
+
+Compatibility is the model's own declaration (``CompiledModel.gang_*``
+hooks, parallel/compiled.py): a non-None ``gang_key()`` names the
+family, and the contract is that equal keys trace IDENTICAL programs
+with the per-instance constants supplied as data.  On top of that the
+job spec must be semantically batchable — see :func:`gang_eligibility`;
+anything else (and any member that overgrows the gang's fixed geometry
+mid-run) is ejected and requeued to run solo, journaled as
+``gang_eject``.
+
+Parity contract (the gate in docs/SERVING.md): each member's
+``discovered_fingerprints()``, per-property verdicts, and violation
+verdict are bit-equal to K serial ``spawn_tpu`` runs.  The wave
+semantics reproduce ``wave_common.wave_eval`` for the gang-eligible
+subset (no EVENTUALLY properties): property conditions evaluated at
+expansion time, the awaiting-discoveries gate, ALWAYS/SOMETIMES
+latching with first-lane witnesses, and boundary pruning — over the
+same 64-bit device fingerprints (``ops.device_fp``) the solo engines
+dedup and report with.  Gang families are required to carry a
+never-discovered ALWAYS anchor property (their declared convention, see
+docs/SERVING.md), which keeps every state awaited in both engines and
+makes the parity independent of chunking and discovery timing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.checker import Checker
+from ..core.model import Expectation
+from ..core.path import Path
+
+# Engine kwargs that only shape solo geometry; the gang manages its own
+# geometry, so these are ignorable — anything else changes semantics
+# (journal, trace, resume_from, ...) and disqualifies the job.
+_GEOMETRY_KWARGS = {
+    "capacity", "log_capacity", "max_frontier", "chunk_size",
+    "dedup_factor", "sort_lanes", "sortless", "bucket_slack",
+}
+
+# Compiled gang wave programs, keyed by (gang_key, K, F) — the shared
+# bounded-FIFO idiom (wave_common.cached_program), so gang compiles
+# count into the same program_cache_hits/misses evidence and journal
+# the same ``compile`` events as every other engine.
+_GANG_PROGRAMS: dict = {}
+_GANG_CACHE_MAX = 8
+
+
+def gang_eligibility(spec) -> Tuple[Optional[tuple], str]:
+    """Decide whether one JobSpec may join a gang.
+
+    Returns ``(compat, reason)``: ``compat`` is a hashable family key
+    (equal keys may share a dispatch) or None with ``reason`` naming the
+    first disqualifier — journaled on ``gang_eject`` so an operator can
+    see WHY a job ran solo.
+
+    The semantic requirements mirror what the gang wave implements:
+    single-chip exhaustive search (engine ``tpu``), run-to-completion
+    stopping (``finish_when`` absent or ``all``, no depth/count/time
+    targets), no symmetry (the gang fingerprints raw rows), no
+    portfolio/store wrapping, and a model whose compiled form declares
+    a gang family with no EVENTUALLY properties (the eventually-bit
+    pipeline needs trace-end bookkeeping the gang does not carry).
+    """
+    from ..serve.workloads import build_model
+
+    if spec.engine != "tpu":
+        return None, f"engine {spec.engine!r}"
+    if spec.portfolio is not None:
+        return None, "portfolio job"
+    if spec.store:
+        return None, "verification-store job"
+    if spec.symmetry:
+        return None, "symmetry"
+    if spec.finish_when not in (None, "all"):
+        return None, f"finish_when {spec.finish_when!r}"
+    if spec.target_max_depth is not None:
+        return None, "target_max_depth"
+    if spec.target_state_count is not None:
+        return None, "target_state_count"
+    if spec.timeout is not None:
+        return None, "timeout"
+    extra = set(spec.engine_kwargs) - _GEOMETRY_KWARGS
+    if extra:
+        return None, f"engine_kwargs {sorted(extra)}"
+    try:
+        model, cli, n = build_model(spec.workload, spec.n, spec.network)
+    except Exception as exc:
+        return None, f"build failed: {exc}"
+    if cli.target_max_depth is not None or \
+            cli.tpu_target_max_depth is not None:
+        return None, "workload depth target"
+    compiled = getattr(model, "compiled", None)
+    if compiled is None:
+        return None, "no compiled form"
+    cm = model.compiled()
+    key = cm.gang_key()
+    if key is None:
+        return None, "model not gang-capable"
+    props = model.properties()
+    if any(p.expectation is Expectation.EVENTUALLY for p in props):
+        return None, "eventually property"
+    consts = np.asarray(cm.gang_constants(), np.uint32)
+    compat = (key, spec.finish_when or "all", int(consts.shape[0]),
+              tuple(p.name for p in props),
+              tuple(p.expectation.name for p in props))
+    return compat, ""
+
+
+class GangMemberChecker(Checker):
+    """The finished-checker view of one gang member: the same surface
+    ``checker_summary`` (serve/portfolio.py) and the discovery pins read
+    on a solo checker — counts, discoveries as re-executed
+    :class:`Path` objects, and the sorted 64-bit discovery-set
+    fingerprint."""
+
+    def __init__(self, model, state_count: int, unique: int, depth: int,
+                 discoveries: Dict[str, Path], fingerprints: np.ndarray,
+                 waves: int, gang_size: int):
+        super().__init__(model)
+        self._state_count = int(state_count)
+        self._unique = int(unique)
+        self._depth = int(depth)
+        self._discoveries = dict(discoveries)
+        self._fps = fingerprints
+        self._waves = int(waves)
+        self._gang_size = int(gang_size)
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique
+
+    def max_depth(self) -> int:
+        return self._depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return dict(self._discoveries)
+
+    def is_done(self) -> bool:
+        return True
+
+    def join(self) -> "Checker":
+        return self
+
+    def discovered_fingerprints(self) -> np.ndarray:
+        """Sorted uint64 device fingerprints of every committed state —
+        the cross-engine discovery-set pin, bit-equal to the solo
+        engine's ``discovered_fingerprints()`` by the parity gate."""
+        return self._fps.copy()
+
+    def metrics(self) -> dict:
+        m = super().metrics()
+        m["engine"] = "gang-member"
+        m["gang_waves"] = self._waves
+        m["gang_size"] = self._gang_size
+        return m
+
+
+class _Member:
+    """Host-side traversal state for one gang lane."""
+
+    def __init__(self, tag, model, cm, consts, n_props):
+        self.tag = tag  # caller's handle (the fleet job dict)
+        self.model = model
+        self.cm = cm
+        self.consts = consts
+        self.frontier_rows: List[np.ndarray] = []
+        self.frontier_fps: List[int] = []
+        self.seen: set = set()
+        self.parent: Dict[int, Optional[int]] = {}
+        self.rowof: Dict[int, np.ndarray] = {}
+        self.witness: List[Optional[int]] = [None] * n_props
+        self.state_count = 0
+        self.depth = 0
+        self.done = False
+        self.eject_reason: Optional[str] = None
+
+    @property
+    def alive(self) -> bool:
+        return not self.done and self.eject_reason is None
+
+    def path_to(self, fp: int) -> Path:
+        """Re-execute the parent chain behind ``fp`` — the same
+        host-replay witness recovery the solo engine's ``_slot_path``
+        does (Path.from_fingerprints re-runs the model along the
+        chain's HOST fingerprints)."""
+        chain: List[int] = []
+        cur: Optional[int] = fp
+        while cur is not None:
+            chain.append(cur)
+            cur = self.parent[cur]
+        chain.reverse()
+        return Path.from_fingerprints(
+            self.model,
+            [self.model.fingerprint(self.cm.decode(self.rowof[c]))
+             for c in chain],
+        )
+
+
+def _build_wave(cm, expectations, K, F, A, W, P, C, has_boundary):
+    """Trace the gang wave: one jitted call advancing ALL K members one
+    BFS level.  ``cm`` is any member's compiled model — by the gang_key
+    contract its ``gang_*`` hooks read every instance-specific constant
+    from the traced ``consts`` lane, so the program is family-global."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.device_fp import device_fp64
+
+    fpw = cm.fp_words or W
+
+    def one(states, active, consts, undisc):
+        conds = jax.vmap(
+            lambda s: cm.gang_property_conds(s, consts)
+        )(states)  # [F, P]
+        # The awaiting-discoveries gate, exactly wave_eval's: a state
+        # expands only while some property still awaits what this state
+        # offers (ALWAYS awaits satisfying states, SOMETIMES awaits
+        # non-satisfying ones).
+        awaiting = jnp.zeros((F,), jnp.bool_)
+        hits = []
+        for p, exp in enumerate(expectations):
+            if exp == "ALWAYS":
+                awaiting = awaiting | (undisc[p] & conds[:, p])
+                hits.append(active & ~conds[:, p])
+            else:  # SOMETIMES (EVENTUALLY is gang-ineligible)
+                awaiting = awaiting | (undisc[p] & ~conds[:, p])
+                hits.append(active & conds[:, p])
+        hitm = jnp.stack(hits) if hits else jnp.zeros((0, F), jnp.bool_)
+        nexts, valid = jax.vmap(
+            lambda s: cm.gang_step(s, consts)
+        )(states)  # [F, A, W], [F, A]
+        valid = valid & active[:, None] & awaiting[:, None]
+        if has_boundary:
+            inb = jax.vmap(
+                lambda row: jax.vmap(
+                    lambda s: cm.gang_boundary(s, consts)
+                )(row)
+            )(nexts)
+            valid = valid & inb
+        generated = jnp.sum(valid, dtype=jnp.uint32)
+        return hitm, nexts, valid, generated
+
+    @jax.jit
+    def wave(frontier, active, consts, undisc):
+        hitm, nexts, valid, generated = jax.vmap(one)(
+            frontier, active, consts, undisc
+        )
+        flat = nexts.reshape((K * F * A, W))
+        # hi/lo stay separate uint32 on device (no x64); the host folds
+        # them into uint64 — same split as fingerprints_of_rows.
+        hi, lo = device_fp64(flat[:, :fpw])
+        return (hitm, nexts, valid, generated,
+                hi.reshape((K, F * A)), lo.reshape((K, F * A)))
+
+    return wave
+
+
+def run_gang(members_in: List[dict], journal=None,
+             max_frontier: int = 256, max_states: int = 1 << 20,
+             on_wave=None):
+    """Run one gang to completion.
+
+    ``members_in``: dicts with ``tag`` (opaque handle), ``model``,
+    ``cm``, ``consts`` — all sharing one compat key from
+    :func:`gang_eligibility`.  Returns ``(results, waves)`` where
+    ``results`` is a list of ``(tag, checker_or_None, eject_reason)``
+    in input order: a :class:`GangMemberChecker` for completed members,
+    ``None`` + reason for members ejected mid-run (frontier or state
+    budget overgrown — the caller requeues those to run solo).
+    ``on_wave(wave_index, alive_tags)`` fires once per device wave —
+    the fleet worker's hook for lease heartbeats mid-gang.
+    """
+    from ..parallel.wave_common import cached_program
+    from ..parallel.wave_loop import fingerprints_of_rows
+
+    first = members_in[0]
+    cm = first["cm"]
+    model = first["model"]
+    props = model.properties()
+    expectations = [p.expectation.name for p in props]
+    P = len(props)
+    W = cm.state_width
+    A = cm.max_actions
+    C = int(np.asarray(first["consts"]).shape[0])
+    F = int(max_frontier)
+    has_boundary = cm.gang_boundary(
+        np.zeros((W,), np.uint32), np.asarray(first["consts"], np.uint32)
+    ) is not None
+    # Pad K to a power of two: gangs of 3 and 4 share one program, and
+    # the cache holds O(log) shapes per family instead of one per size.
+    K = 1
+    while K < len(members_in):
+        K *= 2
+
+    members = [
+        _Member(m["tag"], m["model"], m["cm"],
+                np.asarray(m["consts"], np.uint32), P)
+        for m in members_in
+    ]
+
+    # Seed frontiers with each member's unique initial states, in init
+    # order — the same first-occurrence commit order the solo row log
+    # uses, over the same device fingerprints.
+    for mem in members:
+        rows = [np.asarray(mem.cm.encode(s), np.uint32)
+                for s in mem.model.init_states()]
+        fps = fingerprints_of_rows(
+            mem.cm, np.stack(rows, axis=0), sort=False
+        )
+        for row, fp in zip(rows, fps):
+            fp = int(fp)
+            if fp in mem.seen:
+                continue
+            mem.seen.add(fp)
+            mem.parent[fp] = None
+            mem.rowof[fp] = row
+            mem.frontier_rows.append(row)
+            mem.frontier_fps.append(fp)
+        mem.state_count = len(mem.frontier_rows)
+        # Solo max_depth counts path LENGTH in states, not edges: the
+        # init level alone is depth 1.
+        mem.depth = 1 if mem.frontier_rows else 0
+        if len(mem.frontier_rows) > F:
+            mem.eject_reason = "init frontier exceeds gang geometry"
+
+    gang_key = cm.gang_key()
+    wave_fn = cached_program(
+        _GANG_PROGRAMS, _GANG_CACHE_MAX,
+        (gang_key, tuple(expectations), K, F, has_boundary),
+        lambda: _build_wave(cm, expectations, K, F, A, W, P, C,
+                            has_boundary),
+        label=f"gang:{gang_key[0]}", journal=journal,
+        provenance={"gang_key": str(gang_key), "K": K, "F": F},
+    )
+
+    consts_arr = np.zeros((K, C), np.uint32)
+    for j, mem in enumerate(members):
+        consts_arr[j] = mem.consts
+
+    waves = 0
+    while any(mem.alive and mem.frontier_rows for mem in members):
+        frontier = np.zeros((K, F, W), np.uint32)
+        active = np.zeros((K, F), bool)
+        undisc = np.zeros((K, P), bool)
+        for j, mem in enumerate(members):
+            if not (mem.alive and mem.frontier_rows):
+                continue
+            f = len(mem.frontier_rows)
+            frontier[j, :f] = np.stack(mem.frontier_rows, axis=0)
+            active[j, :f] = True
+            undisc[j] = [w is None for w in mem.witness]
+        hitm, nexts, valid, generated, hi, lo = wave_fn(
+            frontier, active, consts_arr, undisc
+        )
+        hitm = np.asarray(hitm)
+        nexts = np.asarray(nexts)
+        valid = np.asarray(valid)
+        generated = np.asarray(generated)
+        fps = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | \
+            np.asarray(lo).astype(np.uint64)
+        waves += 1
+        if on_wave is not None:
+            on_wave(waves, [m.tag for m in members if m.alive])
+
+        for j, mem in enumerate(members):
+            if not (mem.alive and mem.frontier_rows):
+                continue
+            f = len(mem.frontier_rows)
+            # Latch witnesses first-lane-wins, against wave-start
+            # discoveries — wave_eval's ordering.
+            for p in range(P):
+                if mem.witness[p] is not None:
+                    continue
+                lanes = np.nonzero(hitm[j, p, :f])[0]
+                if lanes.size:
+                    mem.witness[p] = mem.frontier_fps[int(lanes[0])]
+            mem.state_count += int(generated[j])
+            # Commit successors in candidate lane order (state-major,
+            # action-minor) — the solo row log's first-occurrence
+            # append order — deduped on the same 64-bit device fps.
+            nxt_rows: List[np.ndarray] = []
+            nxt_fps: List[int] = []
+            for i in range(f):
+                parent_fp = mem.frontier_fps[i]
+                for a in range(A):
+                    if not valid[j, i, a]:
+                        continue
+                    fp = int(fps[j, i * A + a])
+                    if fp in mem.seen:
+                        continue
+                    mem.seen.add(fp)
+                    mem.parent[fp] = parent_fp
+                    mem.rowof[fp] = nexts[j, i, a].copy()
+                    nxt_rows.append(mem.rowof[fp])
+                    nxt_fps.append(fp)
+            # Finish check AFTER the commit, like the solo wave loop:
+            # the matching wave's successors are in the log but never
+            # expanded (finish_when "all" — the only gang policy).
+            if P and all(w is not None for w in mem.witness):
+                mem.done = True
+                mem.frontier_rows, mem.frontier_fps = [], []
+                continue
+            if len(nxt_rows) > F:
+                mem.eject_reason = (
+                    f"frontier overgrew gang geometry "
+                    f"({len(nxt_rows)} > {F})"
+                )
+                continue
+            if len(mem.seen) > max_states:
+                mem.eject_reason = (
+                    f"state budget overgrown ({len(mem.seen)} > "
+                    f"{max_states})"
+                )
+                continue
+            mem.frontier_rows, mem.frontier_fps = nxt_rows, nxt_fps
+            if nxt_rows:
+                mem.depth += 1
+            else:
+                mem.done = True
+
+    results = []
+    for mem in members:
+        if mem.eject_reason is not None:
+            results.append((mem.tag, None, mem.eject_reason))
+            continue
+        discoveries = {
+            props[p].name: mem.path_to(mem.witness[p])
+            for p in range(P)
+            if mem.witness[p] is not None
+        }
+        fps_sorted = np.sort(
+            np.fromiter(mem.seen, dtype=np.uint64, count=len(mem.seen))
+        )
+        checker = GangMemberChecker(
+            mem.model, mem.state_count, len(mem.seen), mem.depth,
+            discoveries, fps_sorted, waves, len(members),
+        )
+        results.append((mem.tag, checker, None))
+    return results, waves

@@ -1,0 +1,161 @@
+"""Heterogeneous placement: which worker should run which job.
+
+A fleet mixes backends — CPU containers, the odd GPU box, TPU meshes —
+and the placement rule is the obvious economic one: small jobs are
+cheap anywhere, so they go to commodity workers; a TPU mesh is the
+scarce resource, reserved for jobs that actually need device scale.
+Workers self-describe (:func:`describe_worker` — the same
+platform/device_kind fields the knob cache keys on,
+runtime/knob_cache.knob_key), jobs are sized (:func:`is_big` — the
+declared engine plus the knob-cache history's recorded unique-state
+counts), and :func:`placement_order` turns one worker's view of the
+queue into the ordered claim list: TPU workers take big jobs first,
+non-TPU workers never take them at all (``--accept-big`` overrides for
+single-backend fleets).
+
+There is no central placer: every worker applies the same pure
+functions to the same folded store view, and the per-attempt claim
+locks (fleet/store.py) resolve the races.  docs/SERVING.md documents
+the policy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+# A job is "big" when its expected unique-state count crosses this, or
+# when it explicitly asks for a multi-chip engine.  2^20 unique states
+# is where the single-chip engines start growing tables past commodity
+# RAM and a mesh's HBM begins to pay for itself.
+BIG_UNIQUE_THRESHOLD = 1 << 20
+# An explicit capacity request at/above this is a self-declared big job
+# even with no history.
+BIG_CAPACITY_THRESHOLD = 1 << 22
+
+_MESH_ENGINES = ("sharded", "tiered-sharded")
+
+
+def describe_worker(accept_big: bool = False) -> dict:
+    """This process's backend self-description, journaled as the
+    ``fleet_worker`` registration event.  The platform/device_kind
+    fields are exactly the knob cache's device-key fields, so a
+    journal reader can correlate a worker's claims with the knob
+    entries its runs produced."""
+    import jax
+
+    d = jax.devices()[0]
+    mem_mb = None
+    try:
+        stats = d.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            mem_mb = int(stats["bytes_limit"] // (1024 * 1024))
+    except Exception:
+        pass
+    return {
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", d.platform),
+        "memory_mb": mem_mb,
+        "engines": ["tpu", "tiered", "bfs", "dfs", "simulation",
+                    "tpu_simulation"]
+        + (["sharded", "tiered-sharded"] if len(jax.devices()) > 1 else []),
+        "accept_big": bool(accept_big),
+    }
+
+
+def estimate_unique(spec: dict,
+                    knob_cache_dir: Optional[str]) -> Optional[int]:
+    """Expected unique-state count for a job, from the knob-cache
+    history: every served run persists its final geometry with the
+    run's ``unique`` count as metadata (serve/scheduler.py
+    ``store_knobs(..., unique=...)``), so the cache doubles as a
+    size-history keyed by workload label.  Matched by label prefix
+    across devices/engines (the count is device-independent); the MAX
+    over matches is returned — requeues must not flap a job between
+    size classes because a partial run recorded a smaller count.
+    None when this workload configuration has never been seen."""
+    if not knob_cache_dir:
+        return None
+    try:
+        with open(os.path.join(knob_cache_dir, "knobs.json"), "r",
+                  encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    from ..serve.workloads import cli_spec_for, workload_label
+
+    workload = spec.get("workload")
+    n = spec.get("n")
+    if n is None:
+        try:
+            n = cli_spec_for(workload).default_n
+        except Exception:
+            return None
+    prefix = workload_label(
+        workload, int(n), spec.get("network"), bool(spec.get("symmetry"))
+    ) + "|"
+    best = None
+    for key, entry in data.items():
+        if not str(key).startswith(prefix):
+            continue
+        try:
+            unique = int(entry.get("unique"))
+        except (AttributeError, TypeError, ValueError):
+            continue
+        best = unique if best is None else max(best, unique)
+    return best
+
+
+def is_big(spec: dict, knob_cache_dir: Optional[str]) -> bool:
+    """Size one job.  Declared intent first (a mesh engine or a huge
+    explicit capacity IS a big job), then the knob-cache history; an
+    unknown workload defaults to small — the first run sizes it for
+    every run after."""
+    if spec.get("engine") in _MESH_ENGINES:
+        return True
+    kwargs = spec.get("engine_kwargs") or {}
+    try:
+        if int(kwargs.get("capacity", 0)) >= BIG_CAPACITY_THRESHOLD:
+            return True
+    except (TypeError, ValueError):
+        pass
+    est = estimate_unique(spec, knob_cache_dir)
+    return est is not None and est >= BIG_UNIQUE_THRESHOLD
+
+
+def worker_takes(job: dict, desc: dict,
+                 knob_cache_dir: Optional[str]) -> bool:
+    """May a worker with self-description ``desc`` claim ``job``?  The
+    reservation rule: big jobs only on TPU-platform workers (or an
+    explicit ``accept_big``); engines the backend can't spawn are
+    skipped (a single-device worker claiming a sharded job would just
+    fail it)."""
+    spec = job.get("spec") or {}
+    engine = spec.get("engine", "tpu")
+    if engine in _MESH_ENGINES and engine not in desc.get("engines", ()):
+        return False
+    if is_big(spec, knob_cache_dir):
+        return desc.get("platform") == "tpu" or bool(
+            desc.get("accept_big")
+        )
+    return True
+
+
+def placement_order(queued: List[dict], desc: dict,
+                    knob_cache_dir: Optional[str]) -> List[dict]:
+    """Order one worker's claim attempts over the queue (already
+    priority-sorted by ``FleetView.queued``): filter to what this
+    worker may take, then — on TPU workers only — big jobs first, so
+    the mesh drains the work only it can do before competing with CPU
+    siblings for crumbs."""
+    mine = [
+        j for j in queued if worker_takes(j, desc, knob_cache_dir)
+    ]
+    if desc.get("platform") != "tpu":
+        return mine
+    big = [j for j in mine if is_big(j.get("spec") or {}, knob_cache_dir)]
+    small = [j for j in mine if j not in big]
+    return big + small

@@ -1,0 +1,650 @@
+"""Durable on-disk job store shared by a fleet of worker processes.
+
+The store is a directory (``--fleet-dir``)::
+
+    fleet-dir/
+      journal.jsonl     # fsync'd event log — the SINGLE source of truth
+      locks/            # O_EXCL claim/requeue/resolve lock files
+      results/          # one atomic JSON file per finished job
+      flags/            # cancel request markers
+      snapshots/        # preemption snapshots (fleet/worker.py)
+      quotas.json       # optional per-tenant admission limits
+
+There is no database and no daemon: every fact about every job is an
+appended ``fleet_*`` event (runtime/journal.py with ``fsync=True``, so
+an event that was acknowledged survives ``kill -9`` an instruction
+later), and the current state is a pure fold over the event stream
+(:meth:`FleetStore.fold`).  Any process with the directory can compute
+the same view — that is what lets N independent workers cooperate with
+no coordinator and lets a sibling requeue a dead worker's job.
+
+Mutual exclusion uses the one primitive shared filesystems give us
+atomically: ``open(..., O_CREAT | O_EXCL)``.  Claims are per-attempt
+(``locks/<job>.claim.<attempt>``), so a requeued job's next attempt is
+a fresh race that the dead worker's stale lock cannot block; requeues
+race on ``locks/<job>.requeue.<attempt>`` so exactly one sibling moves
+the job back to queued.  Both outcomes of every race are journaled
+(``fleet_claimed`` / ``fleet_claim_lost``), so the journal alone
+reconstructs who won and who stood down.
+
+Crash-safety argument (the durability gate in docs/SERVING.md):
+
+* killed before the claim lock       -> job still queued, anyone claims;
+* killed between lock and journal    -> the orphan-claim rule below
+  detects the aged lock with no ``fleet_claimed`` event and requeues to
+  the next attempt;
+* killed while running               -> the lease (``fleet_lease``
+  heartbeats) expires and any sibling requeues;
+* killed after the result file but before ``fleet_done`` -> the job
+  reruns; the run is deterministic, so the rewritten result is
+  identical bit-for-bit.
+
+In every window, an accepted (journaled) job is eventually completed by
+somebody, and nothing a client was told is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..runtime.journal import Journal, read_journal_stats
+from ..serve.jobs import JobSpec, worker_id
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+TERMINAL = (DONE, FAILED, CANCELLED)
+
+# Fold-derived fleet counters surfaced by /.metrics (fleet/service.py).
+COUNTERS = (
+    "fleet_submitted", "fleet_claims", "fleet_claims_lost",
+    "fleet_lease_requeues", "fleet_orphan_requeues", "fleet_preemptions",
+    "gang_dispatches", "gang_jobs_batched", "gang_ejects",
+)
+
+
+class QuotaExceeded(ValueError):
+    """Tenant admission refused: active jobs at the configured limit."""
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """tmp + fsync + rename: readers see the old file or the complete
+    new one, never a torn JSON (same discipline as the knob cache)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, sort_keys=True, default=str)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _try_lock(path: str) -> bool:
+    """One O_EXCL creation attempt — THE atomic race primitive.  The
+    file content (worker id) is advisory breadcrumbs for debugging; the
+    creation itself is the decision."""
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, worker_id().encode())
+    finally:
+        os.close(fd)
+    return True
+
+
+class FleetView:
+    """One fold of the journal: jobs, workers, and event counters."""
+
+    def __init__(self, jobs: Dict[str, dict], workers: Dict[str, dict],
+                 counters: Dict[str, int], torn: int):
+        self.jobs = jobs
+        self.workers = workers
+        self.counters = counters
+        self.torn = torn
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in (QUEUED, RUNNING) + TERMINAL}
+        for job in self.jobs.values():
+            out[job["state"]] += 1
+        return out
+
+    def queued(self) -> List[dict]:
+        """Claimable jobs, priority-major / submit-order-minor — the
+        same ordering the in-process scheduler's heap gives.  Portfolio
+        parents are NOT claimable (their members are)."""
+        out = [
+            j for j in self.jobs.values()
+            if j["state"] == QUEUED and not j.get("portfolio_parent")
+        ]
+        out.sort(key=lambda j: (-j["priority"], j["submitted_at"], j["id"]))
+        return out
+
+    def active_for_tenant(self, tenant: str) -> int:
+        return sum(
+            1 for j in self.jobs.values()
+            if j["tenant"] == tenant and j["state"] in (QUEUED, RUNNING)
+            and not j.get("portfolio_parent")
+        )
+
+
+class FleetStore:
+    """One process's handle on a fleet directory.  Stateless between
+    calls apart from the journal fd: every decision re-derives from the
+    directory, so any number of FleetStore instances (in any number of
+    processes) stay consistent."""
+
+    def __init__(self, root: str, lease_sec: float = 15.0,
+                 max_attempts: int = 5):
+        self.root = str(root)
+        self.lease_sec = float(lease_sec)
+        self.max_attempts = int(max_attempts)
+        for sub in ("locks", "results", "flags", "snapshots"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        self.journal_path = os.path.join(self.root, "journal.jsonl")
+        # Unrotated on purpose: the journal is the store's entire
+        # history, and requeue correctness folds over all of it.
+        self.journal = Journal(self.journal_path, fsync=True)
+
+    # -- paths ----------------------------------------------------------------
+
+    def _lock(self, name: str) -> str:
+        return os.path.join(self.root, "locks", name)
+
+    def result_path(self, job_id: str) -> str:
+        return os.path.join(self.root, "results", f"{job_id}.json")
+
+    def _cancel_flag(self, job_id: str) -> str:
+        return os.path.join(self.root, "flags", f"{job_id}.cancel")
+
+    def snapshot_path(self, job_id: str, attempt: int) -> str:
+        return os.path.join(
+            self.root, "snapshots", f"{job_id}.{attempt}.npz"
+        )
+
+    # -- admission ------------------------------------------------------------
+
+    def quotas(self) -> Dict[str, int]:
+        path = os.path.join(self.root, "quotas.json")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                raw = json.load(fh)
+            return {str(k): int(v) for k, v in raw.items()}
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def set_quota(self, tenant: str, limit: Optional[int]) -> None:
+        q = self.quotas()
+        if limit is None:
+            q.pop(tenant, None)
+        else:
+            q[str(tenant)] = int(limit)
+        _atomic_write_json(os.path.join(self.root, "quotas.json"), q)
+
+    def _next_id(self) -> str:
+        # Ids must be unique ACROSS processes with no shared counter: a
+        # per-store sequence file under an O_EXCL lock would serialize
+        # submits; time+pid+seq is collision-free without coordination
+        # and sorts roughly by submission.
+        self._seq = getattr(self, "_seq", 0) + 1
+        return f"fj-{int(time.time() * 1000):013d}-{os.getpid()}-{self._seq}"
+
+    def submit(self, spec: JobSpec, tenant: str = "default",
+               priority: Optional[int] = None) -> str:
+        """Admit one job: quota check, then the durable ``fleet_submitted``
+        event (spec inlined — the journal alone must reconstruct the
+        job).  Portfolio specs are expanded HERE into per-member jobs
+        (``group=<parent>``), which is what makes fleet portfolios
+        diversify across workers instead of across threads of one."""
+        if spec.store:
+            raise ValueError(
+                "store: true jobs need the serving process's verification "
+                "store; submit them to a serve instance, not the fleet"
+            )
+        quota = self.quotas().get(tenant)
+        if quota is not None:
+            if self.fold().active_for_tenant(tenant) >= quota:
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} at admission quota ({quota} active)"
+                )
+        job_id = self._next_id()
+        prio = spec.priority if priority is None else int(priority)
+        if spec.portfolio is None:
+            self.journal.append(
+                "fleet_submitted", job=job_id, tenant=tenant,
+                priority=prio, spec=spec.to_dict(), worker=worker_id(),
+            )
+            return job_id
+        # Portfolio expansion: the parent is a bookkeeping record (never
+        # claimable); each diversified member becomes an ordinary fleet
+        # job any worker can claim.
+        from ..serve.portfolio import diversify
+        from ..serve.workloads import build_model
+
+        pf = spec.portfolio
+        base = spec.to_dict()
+        base.pop("portfolio")
+        base_kwargs = dict(spec.engine_kwargs)
+        try:
+            _, cli, _ = build_model(spec.workload, spec.n, spec.network)
+            if spec.engine == "tpu":
+                merged = dict(cli.tpu_kwargs)
+                merged.update(base_kwargs)
+                base_kwargs = merged
+        except Exception:
+            pass
+        members = diversify(
+            size=int(pf["size"]), seed=int(pf.get("seed", 0)),
+            base_engine=spec.engine, base_kwargs=base_kwargs,
+            symmetry_capable=False,
+            include_simulation=bool(pf.get("simulation", True)),
+        )
+        member_ids = []
+        for m in members:
+            mid = f"{job_id}.m{m.index}"
+            mspec = dict(
+                base, engine=m.engine, engine_kwargs=m.engine_kwargs,
+                symmetry=m.symmetry, seed=m.seed or spec.seed,
+                finish_when=spec.finish_when or "any_failures",
+            )
+            if m.kind == "simulation" and spec.target_state_count is None:
+                mspec["target_state_count"] = m.target_state_count
+            JobSpec.from_dict(mspec)  # loud validation before admission
+            member_ids.append(mid)
+            self.journal.append(
+                "fleet_submitted", job=mid, tenant=tenant, priority=prio,
+                spec=mspec, group=job_id, member=m.index,
+                worker=worker_id(),
+            )
+        self.journal.append(
+            "fleet_submitted", job=job_id, tenant=tenant, priority=prio,
+            spec=spec.to_dict(), portfolio_parent=True,
+            worker=worker_id(),
+        )
+        self.journal.append(
+            "fleet_portfolio", job=job_id, members=member_ids,
+            worker=worker_id(),
+        )
+        return job_id
+
+    # -- fold -----------------------------------------------------------------
+
+    def fold(self) -> FleetView:
+        """Replay the journal into the current fleet state.  The fold is
+        the ONLY reader of fleet semantics — workers, the service view,
+        report/watch, and the tests all agree by construction."""
+        events, torn = read_journal_stats(self.journal_path)
+        return self.fold_events(events, torn)
+
+    @staticmethod
+    def fold_events(events, torn: int = 0) -> FleetView:
+        """The fold itself, over a pre-read event list — report/watch
+        (obs/) reuse it on journals they already parsed."""
+        jobs: Dict[str, dict] = {}
+        workers: Dict[str, dict] = {}
+        counters = {k: 0 for k in COUNTERS}
+        for ev in events:
+            e = ev.get("event", "")
+            jid = ev.get("job")
+            rec = jobs.get(jid) if jid else None
+            if e == "gang_dispatch":
+                # Carries a ``jobs`` list, not a ``job`` id — count it
+                # before the per-job guard below skips it.
+                counters["gang_dispatches"] += 1
+                counters["gang_jobs_batched"] += len(ev.get("jobs", ()))
+                continue
+            if e == "fleet_submitted":
+                counters["fleet_submitted"] += 1
+                jobs[jid] = {
+                    "id": jid,
+                    "spec": ev.get("spec") or {},
+                    "tenant": ev.get("tenant", "default"),
+                    "priority": int(ev.get("priority", 0)),
+                    "group": ev.get("group"),
+                    "member": ev.get("member"),
+                    "portfolio_parent": bool(ev.get("portfolio_parent")),
+                    "state": QUEUED,
+                    "attempt": 0,
+                    "worker": None,
+                    "lease_t": None,
+                    "resume": None,
+                    "solo": False,
+                    "submitted_at": float(ev.get("t", 0.0)),
+                    "finished_at": None,
+                    "unique": None,
+                    "violation": None,
+                    "error": None,
+                    "gang": None,
+                }
+            elif rec is None:
+                continue  # event for a job whose submit we never saw
+            elif e == "fleet_claimed":
+                counters["fleet_claims"] += 1
+                if (rec["state"] == QUEUED
+                        and int(ev.get("attempt", -1)) == rec["attempt"]):
+                    rec["state"] = RUNNING
+                    rec["worker"] = ev.get("worker")
+                    rec["lease_t"] = float(ev.get("t", 0.0))
+            elif e == "fleet_claim_lost":
+                counters["fleet_claims_lost"] += 1
+            elif e == "fleet_lease":
+                if (rec["state"] == RUNNING
+                        and int(ev.get("attempt", -1)) == rec["attempt"]):
+                    rec["lease_t"] = float(ev.get("t", 0.0))
+            elif e == "fleet_requeued":
+                reason = ev.get("reason", "")
+                if reason == "orphan_claim":
+                    counters["fleet_orphan_requeues"] += 1
+                else:
+                    counters["fleet_lease_requeues"] += 1
+                if rec["state"] not in TERMINAL:
+                    rec["state"] = QUEUED
+                    rec["attempt"] = int(ev.get("attempt", rec["attempt"]))
+                    rec["worker"] = None
+                    rec["lease_t"] = None
+                    rec["resume"] = ev.get("resume")
+                    rec["solo"] = rec["solo"] or bool(ev.get("solo"))
+            elif e == "fleet_preempted":
+                counters["fleet_preemptions"] += 1
+            elif e == "fleet_done":
+                # A verdict is a verdict even from a lease-lost attempt
+                # that finished late: runs are deterministic, so the
+                # first terminal event wins and later ones are no-ops.
+                if rec["state"] not in TERMINAL:
+                    rec["state"] = DONE
+                    rec["worker"] = ev.get("worker", rec["worker"])
+                    rec["finished_at"] = float(ev.get("t", 0.0))
+                    rec["unique"] = ev.get("unique")
+                    rec["violation"] = ev.get("violation")
+                    rec["gang"] = ev.get("gang")
+            elif e == "fleet_failed":
+                # Unlike fleet_done, a stale attempt's failure does NOT
+                # terminate a retried job — only the current attempt
+                # (or an attempt-less admission failure) may fail it.
+                att = ev.get("attempt")
+                if rec["state"] not in TERMINAL and (
+                        att is None or int(att) == rec["attempt"]):
+                    rec["state"] = FAILED
+                    rec["finished_at"] = float(ev.get("t", 0.0))
+                    rec["error"] = ev.get("error")
+            elif e == "fleet_cancelled":
+                if rec["state"] not in TERMINAL:
+                    rec["state"] = CANCELLED
+                    rec["finished_at"] = float(ev.get("t", 0.0))
+            elif e == "gang_eject":
+                counters["gang_ejects"] += 1
+        # Worker registry events carry no job id; second pass is
+        # cheaper than special-casing the None-jid branch above.
+        for ev in events:
+            e = ev.get("event", "")
+            wid = ev.get("worker")
+            if not wid:
+                continue
+            if e == "fleet_worker":
+                workers[wid] = {
+                    "worker": wid,
+                    "desc": {
+                        k: ev.get(k)
+                        for k in ("platform", "device_kind", "memory_mb",
+                                  "engines", "accept_big")
+                    },
+                    "started_at": float(ev.get("t", 0.0)),
+                    "last_seen": float(ev.get("t", 0.0)),
+                    "vitals": None,
+                    "stopped": False,
+                }
+            elif e == "fleet_worker_stop" and wid in workers:
+                workers[wid]["stopped"] = True
+                workers[wid]["last_seen"] = float(ev.get("t", 0.0))
+            elif e == "fleet_worker_vitals" and wid in workers:
+                workers[wid]["vitals"] = ev.get("vitals")
+                workers[wid]["last_seen"] = float(ev.get("t", 0.0))
+            elif e in ("fleet_claimed", "fleet_lease") and wid in workers:
+                workers[wid]["last_seen"] = max(
+                    workers[wid]["last_seen"], float(ev.get("t", 0.0))
+                )
+        return FleetView(jobs, workers, counters, torn)
+
+    # -- claims / leases ------------------------------------------------------
+
+    def claim(self, job: dict, worker: Optional[str] = None) -> bool:
+        """Race for one queued job at its current attempt.  Both
+        outcomes are journaled: the loser's ``fleet_claim_lost`` is the
+        auditable proof the race happened and was resolved."""
+        wid = worker or worker_id()
+        attempt = job["attempt"]
+        if _try_lock(self._lock(f"{job['id']}.claim.{attempt}")):
+            self.journal.append(
+                "fleet_claimed", job=job["id"], attempt=attempt,
+                worker=wid, tenant=job["tenant"],
+            )
+            return True
+        self.journal.append(
+            "fleet_claim_lost", job=job["id"], attempt=attempt, worker=wid,
+        )
+        return False
+
+    def lease(self, job_id: str, attempt: int,
+              worker: Optional[str] = None) -> None:
+        """Heartbeat: extends the lease so siblings don't requeue a job
+        that is merely slow.  Workers beat well inside ``lease_sec``."""
+        self.journal.append(
+            "fleet_lease", job=job_id, attempt=attempt,
+            worker=worker or worker_id(),
+        )
+
+    def lease_expired(self, job: dict, now: Optional[float] = None) -> bool:
+        if job["state"] != RUNNING or job["lease_t"] is None:
+            return False
+        return (now or time.time()) - job["lease_t"] > self.lease_sec
+
+    def _orphan_claim(self, job: dict,
+                      now: Optional[float] = None) -> bool:
+        """A worker killed BETWEEN winning the claim lock and journaling
+        ``fleet_claimed`` leaves the job queued but unclaimable (the
+        lock for its attempt exists, so every future claim loses).  The
+        lock file's age is the tiebreaker: older than a lease with no
+        matching claim event means the winner is dead."""
+        if job["state"] != QUEUED:
+            return False
+        path = self._lock(f"{job['id']}.claim.{job['attempt']}")
+        try:
+            age = (now or time.time()) - os.stat(path).st_mtime
+        except FileNotFoundError:
+            return False
+        return age > self.lease_sec
+
+    def requeue(self, job: dict, reason: str,
+                resume: Optional[str] = None, solo: bool = False) -> bool:
+        """Move a stuck job back to queued at ``attempt+1`` (exactly one
+        sibling wins the per-attempt requeue lock).  At the attempt cap
+        the job fails instead — a job that kills every worker that
+        touches it must not poison the fleet forever.  ``solo=True``
+        marks the job gang-ineligible from here on (a gang-ejected
+        member must not be re-batched into the geometry it overgrew)."""
+        attempt = job["attempt"]
+        if not _try_lock(self._lock(f"{job['id']}.requeue.{attempt}")):
+            return False
+        if attempt + 1 >= self.max_attempts:
+            self.journal.append(
+                "fleet_failed", job=job["id"], attempt=attempt,
+                worker=worker_id(),
+                error=f"gave up after {attempt + 1} attempts ({reason})",
+            )
+            return True
+        self.journal.append(
+            "fleet_requeued", job=job["id"], attempt=attempt + 1,
+            reason=reason, resume=resume, worker=worker_id(),
+            solo=bool(solo or job.get("solo")),
+        )
+        return True
+
+    def requeue_expired(self) -> int:
+        """Sweep for jobs whose owner died: expired leases and orphaned
+        claims.  Any worker runs this on every loop pass; the requeue
+        lock keeps concurrent sweeps from double-requeueing."""
+        view = self.fold()
+        now = time.time()
+        requeued = 0
+        for job in view.jobs.values():
+            if self.lease_expired(job, now):
+                if self.requeue(job, "lease_expired"):
+                    requeued += 1
+            elif self._orphan_claim(job, now):
+                if self.requeue(job, "orphan_claim"):
+                    requeued += 1
+        return requeued
+
+    # -- completion -----------------------------------------------------------
+
+    def finish(self, job: dict, result: dict,
+               gang: Optional[str] = None) -> None:
+        """Result file FIRST (atomic), then the terminal event: a crash
+        between the two reruns the job, never serves a dangling DONE."""
+        _atomic_write_json(self.result_path(job["id"]), result)
+        self.journal.append(
+            "fleet_done", job=job["id"], attempt=job["attempt"],
+            worker=worker_id(),
+            unique=result.get("unique_state_count"),
+            violation=result.get("violation"), gang=gang,
+        )
+
+    def fail(self, job: dict, error: str) -> None:
+        self.journal.append(
+            "fleet_failed", job=job["id"], attempt=job["attempt"],
+            worker=worker_id(), error=str(error)[:500],
+        )
+
+    def preempt(self, job: dict, resume: Optional[str],
+                reason: str) -> None:
+        """Journal the preemption, then requeue WITH the snapshot path:
+        the next claimant resumes mid-run instead of restarting."""
+        self.journal.append(
+            "fleet_preempted", job=job["id"], attempt=job["attempt"],
+            worker=worker_id(), reason=reason, resume=resume,
+        )
+        self.journal.append(
+            "fleet_requeued", job=job["id"], attempt=job["attempt"] + 1,
+            reason=f"preempted:{reason}", resume=resume,
+            worker=worker_id(),
+        )
+
+    def cancel(self, job_id: str) -> bool:
+        """Request cancellation.  The flag file is the cross-process
+        signal a running worker polls; a still-queued job is terminally
+        cancelled right here (claim attempts race the fold, but a
+        worker that wins the claim then sees the flag and stands
+        down)."""
+        view = self.fold()
+        job = view.jobs.get(job_id)
+        if job is None or job["state"] in TERMINAL:
+            return False
+        try:
+            with open(self._cancel_flag(job_id), "w") as fh:
+                fh.write(worker_id())
+        except OSError:
+            pass
+        if job["state"] == QUEUED:
+            self.journal.append(
+                "fleet_cancelled", job=job_id, worker=worker_id(),
+                reason="while queued",
+            )
+        return True
+
+    def cancel_requested(self, job_id: str) -> bool:
+        return os.path.exists(self._cancel_flag(job_id))
+
+    def mark_cancelled(self, job: dict, **fields) -> None:
+        self.journal.append(
+            "fleet_cancelled", job=job["id"], attempt=job["attempt"],
+            worker=worker_id(), **fields
+        )
+
+    def read_result(self, job_id: str) -> Optional[dict]:
+        try:
+            with open(self.result_path(job_id), "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    # -- worker registry ------------------------------------------------------
+
+    def register_worker(self, desc: dict) -> None:
+        self.journal.append("fleet_worker", worker=worker_id(), **desc)
+
+    def worker_stop(self, **fields) -> None:
+        self.journal.append(
+            "fleet_worker_stop", worker=worker_id(), **fields
+        )
+
+    def worker_vitals(self, vitals: dict) -> None:
+        self.journal.append(
+            "fleet_worker_vitals", worker=worker_id(), vitals=vitals,
+        )
+
+    # -- portfolio groups -----------------------------------------------------
+
+    def resolve_portfolios(self, view: Optional[FleetView] = None) -> int:
+        """Swarm resolution across workers: the first member whose
+        verdict names a violation wins its group — remaining members
+        are cancelled (their partial work stands in the journal) and
+        the parent's result is written from the winner.  With no
+        violation the parent resolves once every member is terminal,
+        anchored on the first completed member.  The per-parent resolve
+        lock makes exactly one sweeping worker the resolver."""
+        view = view or self.fold()
+        resolved = 0
+        groups: Dict[str, List[dict]] = {}
+        for job in view.jobs.values():
+            if job["group"]:
+                groups.setdefault(job["group"], []).append(job)
+        for parent_id, members in groups.items():
+            parent = view.jobs.get(parent_id)
+            if parent is None or parent["state"] in TERMINAL:
+                continue
+            members.sort(key=lambda j: j["member"] or 0)
+            winner = next(
+                (m for m in members
+                 if m["state"] == DONE and m["violation"]), None
+            )
+            all_terminal = all(m["state"] in TERMINAL for m in members)
+            if winner is None and not all_terminal:
+                continue
+            if not _try_lock(self._lock(f"{parent_id}.resolve")):
+                resolved += 1  # someone else is resolving it
+                continue
+            if winner is not None:
+                for m in members:
+                    if m["state"] not in TERMINAL:
+                        self.cancel(m["id"])
+            anchor = winner or next(
+                (m for m in members if m["state"] == DONE), None
+            )
+            if anchor is None:
+                self.fail(parent, "every portfolio member failed")
+                resolved += 1
+                continue
+            result = dict(self.read_result(anchor["id"]) or {})
+            result["portfolio"] = {
+                "size": len(members),
+                "winner": (winner or {}).get("member"),
+                "members": [
+                    {"job": m["id"], "member": m["member"],
+                     "state": m["state"], "violation": m["violation"],
+                     "worker": m["worker"]}
+                    for m in members
+                ],
+            }
+            self.journal.append(
+                "fleet_portfolio_winner", job=parent_id,
+                member=(winner or {}).get("member"),
+                member_job=(winner or anchor)["id"], worker=worker_id(),
+            )
+            self.finish(parent, result)
+            resolved += 1
+        return resolved

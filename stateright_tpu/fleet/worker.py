@@ -1,0 +1,462 @@
+"""Fleet worker: one process, one backend, zero coordination.
+
+A worker is a loop over the shared fleet directory (fleet/store.py):
+sweep for dead siblings' jobs (lease-expiry requeue), resolve finished
+portfolio groups, then claim work the placement policy
+(fleet/placement.py) says this backend should take.  Compatible small
+jobs are gang-batched into one device dispatch (fleet/gang.py);
+everything else runs solo through EXACTLY the in-process scheduler's
+builder/spawn/knob-cache path (the module-level helpers in
+serve/scheduler.py), so a job produces the same result whether a serve
+thread or a fleet worker ran it.
+
+Unlike the in-process scheduler, the worker drives its solo checkers
+directly: the poll loop is also where lease heartbeats fire, where
+cross-process cancel flags are honored, and where SLO preemption
+happens — a long-running job whose backend a strictly-higher-priority
+job is queued for gets a cooperative ``request_stop``, its state saved
+(``save_snapshot``), and a requeue carrying the snapshot path; the
+next claimant spawns with ``resume_from=`` and continues mid-run
+instead of restarting (runtime/supervisor.py proved this identity
+under kill -9; preemption reuses the same machinery voluntarily).
+
+``kill -9`` of a worker at ANY point loses no accepted job: every
+state change it made was an fsync'd journal event, and whatever it was
+holding comes back via the sibling sweep.  tests/test_fleet.py and the
+CI fleet smoke exercise exactly that.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from ..serve.jobs import JobCancelled, JobSpec, worker_id
+from ..serve.portfolio import checker_summary
+from ..serve.scheduler import (
+    _SIM_ENGINES, bound_simulation, knob_engine_tag, final_geometry,
+    make_builder, spawn_engine,
+)
+from ..serve.workloads import workload_label
+from .gang import gang_eligibility, run_gang
+from .placement import describe_worker, placement_order
+from .store import FleetStore
+
+
+class FleetWorker:
+    def __init__(
+        self,
+        fleet_dir: str,
+        knob_cache_dir: Optional[str] = None,
+        lease_sec: float = 15.0,
+        poll_interval: float = 0.05,
+        gang_max: int = 8,
+        gang_min: int = 2,
+        gang_frontier: int = 256,
+        accept_big: bool = False,
+        preempt_after: Optional[float] = None,
+        max_jobs: Optional[int] = None,
+    ):
+        self.store = FleetStore(fleet_dir, lease_sec=lease_sec)
+        self.knob_cache_dir = knob_cache_dir
+        self.poll = float(poll_interval)
+        self.gang_max = max(1, int(gang_max))
+        self.gang_min = max(2, int(gang_min))
+        self.gang_frontier = int(gang_frontier)
+        self.preempt_after = preempt_after
+        self.max_jobs = max_jobs
+        self.desc = describe_worker(accept_big=accept_big)
+        self.jobs_done = 0
+        self.gang_dispatches = 0
+        self.preemptions = 0
+        self._started = time.time()
+        self._stop = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self, once: bool = False) -> int:
+        """The worker loop.  ``once=True`` drains the queue this worker
+        can serve and returns (tests and CI); otherwise runs until
+        ``max_jobs`` or SIGTERM."""
+        self.store.register_worker(self.desc)
+        idle_passes = 0
+        try:
+            while not self._stop:
+                did = self._pass()
+                if did:
+                    idle_passes = 0
+                    self._vitals()
+                else:
+                    idle_passes += 1
+                if self.max_jobs is not None and \
+                        self.jobs_done >= self.max_jobs:
+                    break
+                if once and not did and idle_passes >= 3:
+                    # Three empty passes, not one: requeue sweeps and
+                    # portfolio resolution may unlock work between
+                    # passes right after a sibling dies.
+                    break
+                if not did:
+                    time.sleep(self.poll)
+        finally:
+            self.store.worker_stop(
+                jobs_done=self.jobs_done,
+                gang_dispatches=self.gang_dispatches,
+            )
+        return 0
+
+    def _vitals(self) -> None:
+        self.store.worker_vitals({
+            "jobs_done": self.jobs_done,
+            "gang_dispatches": self.gang_dispatches,
+            "preemptions": self.preemptions,
+            "uptime_sec": round(time.time() - self._started, 1),
+            "platform": self.desc["platform"],
+        })
+
+    # -- one scheduling pass --------------------------------------------------
+
+    def _pass(self) -> bool:
+        """One pass: sweep, resolve, then claim-and-run.  Returns True
+        when any job was run (solo or gang)."""
+        self.store.requeue_expired()
+        view = self.store.fold()
+        self.store.resolve_portfolios(view)
+        mine = placement_order(
+            view.queued(), self.desc, self.knob_cache_dir
+        )
+        if not mine:
+            return False
+        gang = self._plan_gang(mine)
+        if len(gang) >= self.gang_min:
+            claimed = [j for j in gang if self.store.claim(j)]
+            claimed = [
+                j for j in claimed if not self._drop_if_cancelled(j)
+            ]
+            if len(claimed) >= 2:
+                self._run_gang(claimed)
+                return True
+            if claimed:
+                self._run_solo(claimed[0])
+                return True
+            return False
+        for job in mine:
+            if not self.store.claim(job):
+                continue
+            if self._drop_if_cancelled(job):
+                return True
+            self._run_solo(job)
+            return True
+        return False
+
+    def _drop_if_cancelled(self, job: dict) -> bool:
+        """A cancel can land between the fold and a claim win; the
+        winner honors it instead of running a cancelled job."""
+        if self.store.cancel_requested(job["id"]):
+            self.store.mark_cancelled(job, reason="cancelled before start")
+            return True
+        return False
+
+    def _plan_gang(self, mine: List[dict]) -> List[dict]:
+        """The largest same-family group among the claimable queue, up
+        to ``gang_max``.  Ineligibility is per-spec and journaled only
+        at dispatch time (``gang_eject`` with the reason) to keep the
+        planning pass quiet."""
+        families: dict = {}
+        for job in mine:
+            if job.get("solo") or job.get("resume"):
+                continue
+            try:
+                spec = JobSpec.from_dict(job["spec"])
+            except ValueError:
+                continue
+            compat, _reason = gang_eligibility(spec)
+            if compat is None:
+                continue
+            families.setdefault(compat, []).append(job)
+        best: List[dict] = []
+        for group in families.values():
+            if len(group) > len(best):
+                best = group
+        return best[: self.gang_max]
+
+    # -- gang dispatch --------------------------------------------------------
+
+    def _run_gang(self, claimed: List[dict]) -> None:
+        from ..serve.workloads import build_model
+
+        members = []
+        for job in claimed:
+            spec = JobSpec.from_dict(job["spec"])
+            model, _cli, _n = build_model(
+                spec.workload, spec.n, spec.network
+            )
+            cm = model.compiled()
+            members.append({
+                "tag": job, "model": model, "cm": cm,
+                "consts": cm.gang_constants(),
+            })
+        gang_id = f"gang-{claimed[0]['id']}"
+        self.store.journal.append(
+            "gang_dispatch", gang=gang_id, worker=worker_id(),
+            jobs=[j["id"] for j in claimed],
+            key=str(members[0]["cm"].gang_key()),
+        )
+        self.gang_dispatches += 1
+        beat = {"t": time.monotonic()}
+
+        def on_wave(_wave, alive):
+            now = time.monotonic()
+            if now - beat["t"] >= self.store.lease_sec / 3.0:
+                beat["t"] = now
+                for job in alive:
+                    self.store.lease(job["id"], job["attempt"])
+
+        try:
+            results, waves = run_gang(
+                members, journal=self.store.journal,
+                max_frontier=self.gang_frontier, on_wave=on_wave,
+            )
+        except Exception as exc:
+            for job in claimed:
+                self.store.fail(job, f"gang dispatch failed: {exc}")
+            self.jobs_done += len(claimed)
+            return
+        for job, checker, eject_reason in results:
+            if checker is None:
+                # Overgrew the gang geometry: journal why and requeue
+                # to run solo (and never gang again).
+                self.store.journal.append(
+                    "gang_eject", gang=gang_id, job=job["id"],
+                    worker=worker_id(), reason=eject_reason,
+                )
+                self.store.requeue(
+                    job, f"gang_eject: {eject_reason}", solo=True
+                )
+                continue
+            summary = checker_summary(checker)
+            summary["completed"] = True
+            summary["engine"] = "tpu"
+            summary["gang"] = {
+                "id": gang_id, "size": len(claimed), "waves": waves,
+            }
+            summary["worker"] = worker_id()
+            self.store.finish(job, summary, gang=gang_id)
+            self.jobs_done += 1
+
+    # -- solo jobs ------------------------------------------------------------
+
+    def _run_solo(self, job: dict, _retry: bool = False) -> None:
+        """One claimed job end-to-end on this process's backend — the
+        in-process scheduler's engine-kwargs layering (workload defaults
+        < cached knobs < explicit overrides) via the shared helpers, plus
+        the fleet-only concerns: heartbeats, cross-process cancel, resume
+        snapshots, and SLO preemption."""
+        from ..runtime.knob_cache import (
+            drop_knobs, knob_key, load_knobs, store_knobs,
+        )
+
+        try:
+            spec = JobSpec.from_dict(job["spec"])
+        except ValueError as exc:
+            self.store.fail(job, f"invalid spec: {exc}")
+            self.jobs_done += 1
+            return
+        cache_key = None
+        cache_hit = False
+        device_engine = spec.engine in (
+            "tpu", "tiered", "sharded", "tiered-sharded",
+        )
+        try:
+            model, cli, builder, n = make_builder(
+                spec, spec.engine, spec.symmetry
+            )
+            if spec.engine in _SIM_ENGINES:
+                bound_simulation(builder, spec)
+            engine_kwargs = (
+                dict(cli.tpu_kwargs)
+                if spec.engine in ("tpu", "tiered") else {}
+            )
+            if (device_engine and spec.use_knob_cache
+                    and self.knob_cache_dir is not None):
+                label = workload_label(
+                    spec.workload, n, spec.network, spec.symmetry
+                )
+                if spec.engine in ("tiered", "tiered-sharded"):
+                    label += ":mb={}".format(
+                        spec.engine_kwargs.get("memory_budget_mb")
+                    )
+                cache_key = knob_key(
+                    label, engine=knob_engine_tag(spec.engine)
+                )
+                cached = None if _retry else load_knobs(
+                    self.knob_cache_dir, cache_key
+                )
+                if cached is not None:
+                    engine_kwargs.update(cached)
+                    cache_hit = True
+            engine_kwargs.update(spec.engine_kwargs)
+            if job.get("resume") and spec.engine == "tpu":
+                # A preempted (or supervised-restart) attempt: continue
+                # from the saved snapshot instead of re-exploring.
+                engine_kwargs["resume_from"] = job["resume"]
+
+            checker = spawn_engine(
+                builder, spec, spec.engine, engine_kwargs, spec.seed
+            )
+            preempted = self._poll(job, checker)
+            if preempted:
+                return
+        except JobCancelled as c:
+            partial = dict(c.partial)
+            partial["completed"] = False
+            self.store.mark_cancelled(
+                job, unique=partial.get("unique_state_count")
+            )
+            self.jobs_done += 1
+            return
+        except Exception as exc:
+            if cache_hit and cache_key is not None and not _retry:
+                # Stale cached geometry: drop and rerun once fresh —
+                # the knob-cache staleness contract.
+                drop_knobs(self.knob_cache_dir, cache_key)
+                self.store.journal.append(
+                    "knobs_dropped", job=job["id"], key=cache_key,
+                    worker=worker_id(),
+                )
+                return self._run_solo(job, _retry=True)
+            self.store.fail(job, f"{type(exc).__name__}: {exc}")
+            self.jobs_done += 1
+            return
+
+        summary = checker_summary(checker)
+        summary["completed"] = True
+        summary["engine"] = spec.engine
+        summary["n"] = n
+        summary["knob_cache_hit"] = cache_hit
+        summary["worker"] = worker_id()
+        hand_tuned = set(spec.engine_kwargs) - {"memory_budget_mb"}
+        if (cache_key is not None and not cache_hit and device_engine
+                and not hand_tuned and not job.get("resume")):
+            knobs = final_geometry(checker)
+            if knobs:
+                store_knobs(
+                    self.knob_cache_dir, cache_key, knobs,
+                    unique=summary["unique_state_count"],
+                    depth=summary["max_depth"],
+                    source=f"fleet:{job['id']}",
+                )
+        self.store.finish(job, summary)
+        self.jobs_done += 1
+
+    def _poll(self, job: dict, checker) -> bool:
+        """Drive one solo checker: heartbeat the lease, forward cancel
+        flags, and preempt when SLO policy says to.  Returns True when
+        the job was preempted (requeued with a resume snapshot — no
+        terminal event belongs here)."""
+        last_beat = time.monotonic()
+        started = time.monotonic()
+        cancelled = False
+        while not checker.is_done():
+            now = time.monotonic()
+            if now - last_beat >= self.store.lease_sec / 3.0:
+                last_beat = now
+                self.store.lease(job["id"], job["attempt"])
+                if self.store.cancel_requested(job["id"]):
+                    cancelled = True
+                    checker.request_stop()
+                elif self._should_preempt(job, now - started):
+                    if self._preempt(job, checker):
+                        return True
+            time.sleep(self.poll)
+        checker.join()
+        if cancelled or self.store.cancel_requested(job["id"]):
+            raise JobCancelled(partial=checker_summary(checker))
+        return False
+
+    def _should_preempt(self, job: dict, running_sec: float) -> bool:
+        """SLO preemption policy: only after the grace window, only for
+        snapshot-capable engines, and only when a STRICTLY higher
+        priority job this worker could serve is waiting."""
+        if self.preempt_after is None or running_sec < self.preempt_after:
+            return False
+        if (job.get("spec") or {}).get("engine", "tpu") != "tpu":
+            return False
+        view = self.store.fold()
+        return any(
+            q["priority"] > job["priority"]
+            for q in view.queued()
+        )
+
+    def _preempt(self, job: dict, checker) -> bool:
+        """Cooperatively stop, snapshot, and requeue-with-resume.  A
+        checker without snapshot support just keeps running (False)."""
+        save = getattr(checker, "save_snapshot", None)
+        if save is None:
+            return False
+        checker.request_stop()
+        checker.join()
+        snap = self.store.snapshot_path(job["id"], job["attempt"])
+        try:
+            save(snap)
+        except Exception as exc:
+            # No snapshot -> no resume; finish the job from the partial
+            # run rather than discarding the work.
+            self.store.journal.append(
+                "fleet_preempt_failed", job=job["id"],
+                worker=worker_id(), error=str(exc)[:200],
+            )
+            summary = checker_summary(checker)
+            summary["completed"] = checker.is_done()
+            self.store.finish(job, summary)
+            self.jobs_done += 1
+            return True
+        self.preemptions += 1
+        self.store.preempt(job, snap, "higher-priority job queued")
+        return True
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry for one fleet worker process (``fleet-worker`` verb,
+    cli.py; also ``python -m stateright_tpu.fleet worker``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="fleet-worker",
+        description="serve jobs from a shared fleet directory",
+    )
+    ap.add_argument("--fleet-dir", required=True,
+                    help="shared durable job store directory")
+    ap.add_argument("--knob-cache", default=None,
+                    help="persisted engine-knob cache directory")
+    ap.add_argument("--lease-sec", type=float, default=15.0,
+                    help="claim lease; siblings requeue after expiry")
+    ap.add_argument("--poll", type=float, default=0.05)
+    ap.add_argument("--gang-max", type=int, default=8,
+                    help="max compatible jobs batched into one dispatch")
+    ap.add_argument("--gang-frontier", type=int, default=256,
+                    help="per-member frontier budget inside a gang; "
+                         "overgrowing members are ejected to run solo")
+    ap.add_argument("--accept-big", action="store_true",
+                    help="claim big jobs even off-TPU (single-backend "
+                         "fleets)")
+    ap.add_argument("--preempt-after", type=float, default=None,
+                    help="seconds before a running job may be preempted "
+                         "for a higher-priority one")
+    ap.add_argument("--max-jobs", type=int, default=None)
+    ap.add_argument("--once", action="store_true",
+                    help="drain the claimable queue and exit")
+    args = ap.parse_args(argv)
+    worker = FleetWorker(
+        args.fleet_dir,
+        knob_cache_dir=args.knob_cache,
+        lease_sec=args.lease_sec,
+        poll_interval=args.poll,
+        gang_max=args.gang_max,
+        gang_frontier=args.gang_frontier,
+        accept_big=args.accept_big,
+        preempt_after=args.preempt_after,
+        max_jobs=args.max_jobs,
+    )
+    return worker.run(once=args.once)

@@ -373,6 +373,130 @@ class GridWalkCompiled(CompiledModel):
             old_bound <= self.model.bound
         )
 
+    # --- gang batching (fleet/gang.py): the canonical gang family —
+    # the codec is bound-independent, so differently-bounded walks
+    # share one program with ``bound`` riding the consts lane.
+
+    def gang_key(self):
+        return ("GridWalk", self.state_width, self.max_actions, 2)
+
+    def gang_constants(self):
+        return np.array([self.model.bound], np.uint32)
+
+    def gang_step(self, state, consts):
+        del consts  # successors are bound-independent; boundary prunes
+        return self.step(state)
+
+    def gang_boundary(self, state, consts):
+        import jax.numpy as jnp
+
+        w = state[0]
+        b = consts[0]
+        return ((w & jnp.uint32(0xFFFF)) <= b) & ((w >> jnp.uint32(16)) <= b)
+
+    def gang_property_conds(self, state, consts):
+        import jax.numpy as jnp
+
+        w = state[0]
+        b = consts[0]
+        x = w & jnp.uint32(0xFFFF)
+        y = w >> jnp.uint32(16)
+        return jnp.stack([(x <= b) & (y <= b), (x == b) & (y == b)])
+
+
+@dataclass(frozen=True)
+class CapCounter(Model):
+    """Counter 0 → 1 → … → ``limit`` with an ALWAYS cap property — the
+    gang-batch VIOLATION fixture (fleet/gang.py): "within cap" violates
+    exactly when ``limit > cap``, so one gang can mix violating and
+    clean members and each must report its own verdict (the per-job
+    ``VIOLATION_RC`` parity gate).  The "counts up" ALWAYS property
+    never violates, so — like GridWalk's "in bounds" — every state
+    stays awaited and a completed run is EXHAUSTIVE whether or not the
+    cap property discovered, which is what makes gang-vs-solo
+    fingerprint parity independent of discovery timing."""
+
+    limit: int = 6
+    cap: int = 10
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        if state < self.limit:
+            actions.append("inc")
+
+    def next_state(self, state, action):
+        return state + 1
+
+    def properties(self):
+        return [
+            Property.always("counts up", lambda _m, s: s >= 0),
+            Property.always("within cap", lambda m, s: s <= m.cap),
+            Property.sometimes("reaches limit", lambda m, s: s == m.limit),
+        ]
+
+    def compiled(self):
+        return CapCounterCompiled(self)
+
+
+class CapCounterCompiled(CompiledModel):
+    state_width = 1
+    max_actions = 1
+
+    def __init__(self, model: CapCounter):
+        self.model = model
+
+    def encode(self, state):
+        return np.array([state], np.uint32)
+
+    def decode(self, words):
+        return int(words[0])
+
+    def step(self, state):
+        import jax.numpy as jnp
+
+        n = state[0]
+        nexts = jnp.stack([jnp.stack([n + jnp.uint32(1)])])
+        valid = jnp.stack([n < jnp.uint32(self.model.limit)])
+        return nexts, valid
+
+    def property_conds(self, state):
+        import jax.numpy as jnp
+
+        n = state[0]
+        return jnp.stack([
+            n >= jnp.uint32(0),
+            n <= jnp.uint32(self.model.cap),
+            n == jnp.uint32(self.model.limit),
+        ])
+
+    # consts = [limit, cap]: the step's enable mask and the cap
+    # property both become data, so every CapCounter shares one traced
+    # gang program regardless of parameters.
+
+    def gang_key(self):
+        return ("CapCounter", self.state_width, self.max_actions, 3)
+
+    def gang_constants(self):
+        return np.array([self.model.limit, self.model.cap], np.uint32)
+
+    def gang_step(self, state, consts):
+        import jax.numpy as jnp
+
+        n = state[0]
+        nexts = jnp.stack([jnp.stack([n + jnp.uint32(1)])])
+        valid = jnp.stack([n < consts[0]])
+        return nexts, valid
+
+    def gang_property_conds(self, state, consts):
+        import jax.numpy as jnp
+
+        n = state[0]
+        return jnp.stack([
+            n >= jnp.uint32(0), n <= consts[1], n == consts[0],
+        ])
+
 
 class TwoPhaseEdited:
     """The "one-line model edit" fixture for the incremental store's

@@ -1,0 +1,38 @@
+"""Servable grid-walk workload: the monotone walk on ``[0, bound]²``
+(models/fixtures.GridWalk), promoted from test fixture to registered
+workload so the fleet's gang batcher (fleet/gang.py) has a real
+allowlisted family to batch — differently-bounded walks share one
+compiled gang program, which is exactly the "many small jobs, one
+dispatch" case ROADMAP #3 names.  ``(bound+1)²`` unique states at depth
+``2·bound``; the ALWAYS property never violates, so every completed
+check is exhaustive.
+"""
+
+from __future__ import annotations
+
+from .fixtures import GridWalk
+
+
+def cli_spec():
+    from ..cli import CliSpec
+
+    return CliSpec(
+        name="grid walk",
+        build=lambda n: GridWalk(bound=n),
+        default_n=8,
+        n_meta="BOUND",
+        tpu=True,
+        tpu_kwargs=dict(capacity=1 << 12, max_frontier=1 << 7),
+    )
+
+
+def main(argv=None) -> int:
+    from ..cli import example_main
+
+    return example_main(cli_spec(), argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -187,19 +187,60 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
             w for w in out["warnings"] if w != "audit-inconsistent"
         ]
 
-    # Service journals: job counts by their latest lifecycle event.
+    # Service journals: job counts by their latest lifecycle event,
+    # plus which worker ran the latest event (the pid@host stamp on
+    # every job_* row, serve/jobs.py).
     job_state: dict = {}
+    job_workers = set()
     for e in events:
         ev = str(e.get("event", ""))
         if ev in ("job_submitted", "job_running", "job_done", "job_failed",
                   "job_cancelled") and e.get("job"):
             job_state[e["job"]] = ev[len("job_"):]
+            if e.get("worker"):
+                job_workers.add(e["worker"])
     if job_state:
         counts: dict = {}
         for s in job_state.values():
             s = "queued" if s == "submitted" else s
             counts[s] = counts.get(s, 0) + 1
         out["jobs"] = counts
+        if job_workers:
+            out["job_workers"] = len(job_workers)
+
+    # Fleet journals (fleet/store.py): fold with the store's own
+    # reader so watch and the service /.metrics agree by construction.
+    if any(str(e.get("event", "")).startswith(("fleet_", "gang_"))
+           for e in events):
+        from ..fleet.store import FleetStore
+
+        view = FleetStore.fold_events(events, skipped)
+        out["fleet"] = {
+            k: v for k, v in view.counts().items() if v
+        }
+        out["fleet_workers"] = sum(
+            1 for w in view.workers.values() if not w.get("stopped")
+        )
+        c = view.counters
+        if c.get("gang_dispatches"):
+            out["gang_occupancy"] = round(
+                c.get("gang_jobs_batched", 0) / c["gang_dispatches"], 2
+            )
+        requeues = (c.get("fleet_lease_requeues", 0)
+                    + c.get("fleet_orphan_requeues", 0))
+        if requeues:
+            out["fleet_requeues"] = requeues
+            out["warnings"].append(f"lease-requeues={requeues}")
+        if c.get("fleet_preemptions"):
+            out["fleet_preemptions"] = c["fleet_preemptions"]
+        active = any(
+            j["state"] in ("queued", "running")
+            for j in view.jobs.values()
+        )
+        if view.jobs and not active and "service_stop" not in {
+            e.get("event") for e in events
+        }:
+            out["fleet_drained"] = True
 
     # Recompile storms: the journaled storm flag, or enough compile
     # events inside the trailing window to cross the threshold now.
@@ -332,6 +373,21 @@ def render_line(s: dict) -> str:
                 f"{k}={v}" for k, v in sorted(s["jobs"].items())
             )
         )
+        if "job_workers" in s:
+            parts.append(f"workers={s['job_workers']}")
+    if "fleet" in s:
+        parts.append(
+            "fleet " + " ".join(
+                f"{k}={v}" for k, v in sorted(s["fleet"].items())
+            )
+        )
+        parts.append(f"fleet_workers={s.get('fleet_workers', 0)}")
+        if "gang_occupancy" in s:
+            parts.append(f"gang_occ={_fmt(s['gang_occupancy'])}")
+        if "fleet_preemptions" in s:
+            parts.append(f"preempted={s['fleet_preemptions']}")
+        if s.get("fleet_drained"):
+            parts.append("drained")
     if "unique" in s or "depth" in s:
         parts.append(f"depth={_fmt(s.get('depth'))}")
         parts.append(f"unique={_fmt(s.get('unique'))}")

@@ -171,6 +171,49 @@ class CompiledModel:
             }
         return None
 
+    # --- gang batching (fleet/gang.py) ----------------------------------------
+
+    def gang_key(self) -> Optional[tuple]:
+        """Family key under which compiled models may be GANG-BATCHED:
+        K queued jobs whose compiled models share a gang_key run as one
+        device dispatch with a leading *jobs* axis (fleet/gang.py) —
+        the same trick as the batch over states.  Two models with equal
+        keys must trace IDENTICAL device programs through the
+        ``gang_*`` hooks below (their differing constants travel as
+        traced array inputs, never baked into the trace), so the key
+        must pin everything that shapes the program: codec widths,
+        action arity, property count/order, and the hook code itself
+        (the type).  None (default) = not gang-capable; the fleet
+        scheduler then runs the job solo."""
+        return None
+
+    def gang_constants(self) -> np.ndarray:
+        """The model's constants as one uint32 vector — the per-job
+        lane of the gang dispatch's ``consts`` input.  Same length for
+        every member of a gang_key family; each ``gang_*`` hook reads
+        its constants from here instead of closing over Python ints."""
+        raise NotImplementedError
+
+    def gang_step(self, state, consts):
+        """:meth:`step`, with constants as a traced input:
+        ``(uint32[W], uint32[C]) -> (uint32[A, W], bool[A])``.  Must
+        compute exactly what ``step`` computes when ``consts`` equals
+        this model's :meth:`gang_constants` — the gang parity gate
+        (per-job ``discovered_fingerprints()`` bit-equal to the solo
+        run) holds only if the two never disagree."""
+        raise NotImplementedError
+
+    def gang_property_conds(self, state, consts):
+        """:meth:`property_conds` with constants as a traced input."""
+        raise NotImplementedError
+
+    def gang_boundary(self, state, consts):
+        """:meth:`boundary` with constants as a traced input; None
+        (default) means unbounded, exactly like :meth:`boundary` —
+        but the choice must MATCH ``boundary`` (a model bounded solo
+        and unbounded in a gang explores different spaces)."""
+        return None
+
     def spec_widens(self, old_constants: dict) -> bool:
         """Does THIS model's constant set describe a monotone
         reachable-set WIDENING of ``old_constants`` (a prior run of the

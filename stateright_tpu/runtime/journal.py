@@ -32,7 +32,15 @@ docs/ACTORS.md): ``chaos_start``, ``chaos_drop``, ``chaos_duplicate``,
 reason), ``incr_verdict_hit``, ``incr_property_recheck``,
 ``incr_seeded``, ``incr_stored``, ``incr_store_skipped`` — rendered by
 the ``watch`` verb and obs/report.py's "Incremental re-checking"
-section.
+section.  Fleet events (``fleet/``, see docs/SERVING.md "Fleet mode"):
+``fleet_submitted``, ``fleet_claimed``, ``fleet_claim_lost``,
+``fleet_lease``, ``fleet_requeued``, ``fleet_done``, ``fleet_failed``,
+``fleet_cancelled``, ``fleet_preempted``, ``fleet_worker`` /
+``fleet_worker_stop``, ``fleet_portfolio`` /
+``fleet_portfolio_winner``, and the gang-batch family ``gang_dispatch``
+/ ``gang_eject`` — every row carries the ``worker`` id (pid@host) that
+acted, so the fleet journal alone reconstructs the full
+claim/lease/requeue history of every job.
 """
 
 from __future__ import annotations
@@ -75,7 +83,13 @@ class Journal:
     (no rotation, exactly the old behavior)."""
 
     def __init__(self, path: str, max_bytes: Optional[int] = None,
-                 max_segments: int = 8):
+                 max_segments: int = 8, fsync: bool = False):
+        """``fsync=True`` follows every append with an ``os.fsync`` —
+        the durability discipline the fleet store (fleet/store.py)
+        relies on: a ``kill -9`` immediately after ``append`` returns
+        must not lose the event, because the fleet journal IS the job
+        store's source of truth.  Default off: run/serve telemetry
+        journals value throughput over power-loss durability."""
         self.path = str(path)
         parent = os.path.dirname(self.path)
         if parent:
@@ -84,6 +98,7 @@ class Journal:
             raise ValueError("max_bytes must be positive (or None)")
         self.max_bytes = max_bytes
         self.max_segments = max(1, int(max_segments))
+        self.fsync = bool(fsync)
         self._fd: Optional[int] = None
         self._lock = threading.Lock()
 
@@ -124,6 +139,8 @@ class Journal:
                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644,
                     )
             os.write(self._fd, line)
+            if self.fsync:
+                os.fsync(self._fd)
         return record
 
     def close(self) -> None:

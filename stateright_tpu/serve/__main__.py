@@ -2,7 +2,7 @@
 
     python -m stateright_tpu.serve [HOST:PORT]
         [--journal PATH] [--journal-max-mb MB] [--knob-cache DIR]
-        [--workers N] [--store-dir DIR]
+        [--workers N] [--store-dir DIR] [--fleet-dir DIR]
 
 ``--journal-max-mb`` size-caps the journal into rotated segments
 (``journal.jsonl.1..N``, runtime/journal.py) so a long-lived daemon
@@ -12,6 +12,20 @@ verification store for jobs submitted with ``store: true``
 (docs/INCREMENTAL.md): identical resubmissions short-circuit to the
 journaled verdict, near-identical ones take the cheapest sound
 re-check path.
+
+``--workers N`` (N ≥ 1) sizes the in-process scheduler pool.  These
+workers are THREADS sharing the one accelerator mesh this process
+owns — more of them overlaps host-side work (spec validation, journal
+writes, knob-cache lookups) around serialized device runs; it does not
+multiply device throughput.  For workers that each own a backend, use
+fleet mode instead: ``--fleet-dir DIR`` makes this server a thin front
+over the durable fleet store at DIR, with jobs run by separately
+launched ``fleet-worker`` processes — one per CPU container, GPU box,
+or TPU mesh (fleet/, docs/SERVING.md "Fleet mode").  ``--fleet-dir``
+replaces the in-process backend, so it cannot be combined with
+``--workers``, ``--journal``, ``--knob-cache``, or ``--store-dir``
+(the fleet store has its own journal; knob caches belong to the worker
+processes).
 
 Serves until interrupted.  docs/SERVING.md documents the endpoints,
 the job lifecycle, and the journal layout.
@@ -34,7 +48,8 @@ def main(argv=None) -> int:
     journal_max_mb = None
     knob_cache = None
     store_dir = None
-    workers = 1
+    fleet_dir = None
+    workers = None
     positional = []
     i = 0
     while i < len(args):
@@ -68,12 +83,29 @@ def main(argv=None) -> int:
                 print("--store-dir requires a directory", file=sys.stderr)
                 return 2
             store_dir = args[i]
+        elif a == "--fleet-dir":
+            i += 1
+            if i >= len(args):
+                print("--fleet-dir requires a directory", file=sys.stderr)
+                return 2
+            fleet_dir = args[i]
         elif a == "--workers":
             i += 1
             try:
                 workers = int(args[i])
             except (IndexError, ValueError):
                 print("--workers requires an integer", file=sys.stderr)
+                return 2
+            if workers < 1:
+                # A pool of zero threads would accept jobs that can
+                # never run; refuse at the CLI boundary, loudly.
+                print(
+                    f"--workers must be >= 1, got {workers} (in-process "
+                    "workers are threads sharing this process's one "
+                    "mesh; for per-backend workers use --fleet-dir and "
+                    "fleet-worker processes)",
+                    file=sys.stderr,
+                )
                 return 2
         else:
             positional.append(a)
@@ -89,6 +121,33 @@ def main(argv=None) -> int:
 
     from .server import serve
     from .workloads import workload_names
+
+    if fleet_dir is not None:
+        incompatible = [
+            flag for flag, val in (
+                ("--workers", workers), ("--journal", journal),
+                ("--journal-max-mb", journal_max_mb),
+                ("--knob-cache", knob_cache), ("--store-dir", store_dir),
+            ) if val is not None
+        ]
+        if incompatible:
+            print(
+                "--fleet-dir replaces the in-process backend and cannot "
+                "be combined with " + ", ".join(incompatible) +
+                " (the fleet store journals itself; knob caches and "
+                "worker counts belong to the fleet-worker processes)",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"Checking service on http://{host}:{port} "
+            f"(fleet mode, store: {fleet_dir}, workloads: "
+            f"{', '.join(workload_names())})",
+            flush=True,
+        )
+        serve((host, port), block=True, fleet_dir=fleet_dir)
+        return 0
+    workers = 1 if workers is None else workers
 
     if journal_max_mb is not None:
         if journal is None:

@@ -23,6 +23,8 @@ here.  docs/SERVING.md documents the lifecycle and the JSON shapes.
 
 from __future__ import annotations
 
+import os
+import socket
 import threading
 import time
 from typing import Dict, List, Optional
@@ -33,6 +35,16 @@ RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
+
+
+def worker_id() -> str:
+    """This process's worker identity (``pid@host``) — stamped on every
+    ``job_*`` / ``fleet_*`` journal event so a multi-worker journal can
+    attribute each lifecycle step to the process that performed it
+    (without it, a failed job in a merged fleet journal names no
+    culprit).  Computed per call: a forked worker must not inherit its
+    parent's pid."""
+    return f"{os.getpid()}@{socket.gethostname()}"
 
 _ENGINES = (
     "tpu", "tiered", "sharded", "tiered-sharded", "bfs", "dfs",
@@ -314,4 +326,6 @@ class JobStore:
 
     def _log(self, event: str, job: Job, **fields) -> None:
         if self._journal is not None:
-            self._journal.append(event, job=job.id, **fields)
+            self._journal.append(
+                event, job=job.id, worker=worker_id(), **fields
+            )

@@ -32,7 +32,7 @@ from ..runtime.knob_cache import (
 )
 from .jobs import (
     CANCELLED, DONE, FAILED, QUEUED, RUNNING,
-    Job, JobCancelled, JobSpec, JobStore,
+    Job, JobCancelled, JobSpec, JobStore, worker_id,
 )
 from .portfolio import checker_summary, diversify, run_portfolio
 from .workloads import build_model, workload_label
@@ -41,6 +41,146 @@ _SIM_ENGINES = ("simulation", "tpu_simulation")
 # A simulation job with no stopping condition would walk forever; the
 # service bounds it like the CLI's check-simulation does.
 _SIM_DEFAULT_TARGET = 1_000_000
+
+
+# -- builder assembly (module-level: shared by the in-process scheduler
+# and the fleet worker, fleet/worker.py — one definition of how a
+# JobSpec maps onto the CheckerBuilder, so the two run paths cannot
+# drift) -----------------------------------------------------------------------
+
+
+def make_builder(spec: JobSpec, engine: str, symmetry: bool):
+    """(model, cli_spec, builder, resolved_n) for one run — the one
+    place job fields map onto the CheckerBuilder, shared by single
+    runs, every portfolio member, and fleet workers."""
+    model, cli, n = build_model(spec.workload, spec.n, spec.network)
+    builder = model.checker().threads(
+        spec.threads or (os.cpu_count() or 1)
+    )
+    device = engine in (
+        "tpu", "tiered", "sharded", "tiered-sharded", "tpu_simulation",
+    )
+    depth = spec.target_max_depth
+    if depth is None:
+        depth = (
+            cli.tpu_target_max_depth
+            if device and cli.tpu_target_max_depth is not None
+            else cli.target_max_depth
+        )
+    if depth is not None:
+        builder = builder.target_max_depth(depth)
+    if spec.target_state_count is not None:
+        builder = builder.target_state_count(spec.target_state_count)
+    if spec.timeout is not None:
+        builder = builder.timeout(spec.timeout)
+    policy = spec.finish_when_policy()
+    if policy is not None:
+        builder = builder.finish_when(policy)
+    if symmetry:
+        builder = builder.symmetry()
+    return model, cli, builder, n
+
+
+def spawn_engine(builder, spec: JobSpec, engine: str,
+                 engine_kwargs: dict, seed: int):
+    if engine == "tpu":
+        return builder.spawn_tpu(**engine_kwargs)
+    if engine == "tiered":
+        return builder.spawn_tpu_tiered(**engine_kwargs)
+    if engine == "sharded":
+        return builder.spawn_tpu_sharded(**engine_kwargs)
+    if engine == "tiered-sharded":
+        return builder.spawn_tpu_tiered_sharded(**engine_kwargs)
+    if engine == "bfs":
+        return builder.spawn_bfs()
+    if engine == "dfs":
+        return builder.spawn_dfs()
+    if engine == "tpu_simulation":
+        return builder.spawn_tpu_simulation(seed, **engine_kwargs)
+    if engine == "simulation":
+        return builder.spawn_simulation(seed)
+    raise ValueError(engine)
+
+
+def bound_simulation(builder, spec: JobSpec) -> None:
+    """Simulation engines only stop on a policy/target/timeout; give
+    unbounded specs the service default instead of an immortal job."""
+    from ..core.has_discoveries import HasDiscoveries
+
+    if spec.finish_when is None:
+        builder.finish_when(HasDiscoveries.ANY_FAILURES)
+    if spec.target_state_count is None and spec.timeout is None:
+        builder.target_state_count(_SIM_DEFAULT_TARGET)
+
+
+def knob_engine_tag(engine: str) -> str:
+    """The knob_key engine tag for a job's engine: sharded and
+    tiered entries live under their own tags (their knob sets and
+    sizing rules differ from the single-chip engine's); everything
+    else uses the single-chip default (simulation winners only ever
+    land under the portfolio-only label, so the tag is inert for
+    them)."""
+    from ..runtime.knob_cache import (
+        SHARDED_ENGINE, SINGLE_CHIP_ENGINE, TIERED_ENGINE,
+        TIERED_SHARDED_ENGINE,
+    )
+
+    if engine == "sharded":
+        return SHARDED_ENGINE
+    if engine == "tiered":
+        return TIERED_ENGINE
+    if engine == "tiered-sharded":
+        return TIERED_SHARDED_ENGINE
+    return SINGLE_CHIP_ENGINE
+
+
+def final_geometry(checker) -> dict:
+    # The keys are exactly the engines' spawn kwargs: single-chip
+    # (and tiered, whose budget-derived capacity lands here as the
+    # capacity it pinned) exposes capacity/log_capacity/
+    # max_frontier/dedup_factor/sort_lanes, the sharded engine
+    # capacity/chunk_size/dedup_factor/bucket_slack/sort_lanes (the
+    # discovered exchange-bucket and sort-geometry rungs —
+    # persisting them is what lets a warm repeat skip the
+    # overflow-retry ramps, not just the auto-tune growth).  Each
+    # engine's metrics() emits its own subset; the `in m` filter
+    # picks the right one.
+    m = checker.metrics()
+    out = {
+        k: int(m[k])
+        for k in ("capacity", "log_capacity", "max_frontier",
+                  "chunk_size", "dedup_factor", "bucket_slack")
+        if k in m
+    }
+    # The rungs persist ONLY when the run actually pinned one
+    # (sort_lanes_rung/step_lanes_rung; 0 = full buffer, tuner
+    # armed): storing the live full width from a too-short-to-tune
+    # run would spawn every warm repeat with an explicit rung and
+    # disarm its tuner.  The dedup PATH persists always — a
+    # sortless→sort fallback is a per-workload selection a warm
+    # repeat must not re-discover with another aborted wave.
+    # ...and the sort rung NEVER persists off a sortless run: there
+    # it is the claim compaction buffer's tuner detail, and an
+    # explicit sort_lanes under sortless is the fallback-forcing
+    # budget cap — a warm repeat must re-arm the tuner instead.
+    rung = int(m.get("sort_lanes_rung", 0) or 0)
+    if rung and not m.get("sortless"):
+        out["sort_lanes"] = rung
+    step_rung = int(m.get("step_lanes_rung", 0) or 0)
+    if step_rung:
+        out["step_lanes"] = step_rung
+    if "sortless" in m:
+        out["sortless"] = int(bool(m["sortless"]))
+    # The tiered-sharded engine's PER-SHARD budget is part of its
+    # geometry identity (it derives cap_s, which the snapshot and
+    # the warm start must agree on); a float, so it bypasses the
+    # int() cast above.  The budget-keyed cache label already
+    # separates budgets — storing it here makes the warm-started
+    # spawn self-describing even without the label.
+    if m.get("engine") == "tpu-tiered-sharded" and \
+            m.get("memory_budget_mb") is not None:
+        out["memory_budget_mb"] = float(m["memory_budget_mb"])
+    return out
 
 
 class Scheduler:
@@ -140,6 +280,7 @@ class Scheduler:
             self.journal.append(
                 "job_span", job=job.id, span=span,
                 sec=round(sec, 6), state=job.state,
+                worker=worker_id(),
             )
 
     def _finish_spans(self, job: Job) -> None:
@@ -279,69 +420,19 @@ class Scheduler:
                     keep.append(j)
             self._retained = keep
 
-    # -- builder assembly -----------------------------------------------------
+    # -- builder assembly (delegates to the module-level helpers shared
+    # with fleet/worker.py) ---------------------------------------------------
 
     def _make_builder(self, spec: JobSpec, engine: str,
                       symmetry: bool):
-        """(model, cli_spec, builder, resolved_n) for one run — the one
-        place job fields map onto the CheckerBuilder, shared by single
-        runs and every portfolio member."""
-        model, cli, n = build_model(spec.workload, spec.n, spec.network)
-        builder = model.checker().threads(
-            spec.threads or (os.cpu_count() or 1)
-        )
-        device = engine in (
-            "tpu", "tiered", "sharded", "tiered-sharded", "tpu_simulation",
-        )
-        depth = spec.target_max_depth
-        if depth is None:
-            depth = (
-                cli.tpu_target_max_depth
-                if device and cli.tpu_target_max_depth is not None
-                else cli.target_max_depth
-            )
-        if depth is not None:
-            builder = builder.target_max_depth(depth)
-        if spec.target_state_count is not None:
-            builder = builder.target_state_count(spec.target_state_count)
-        if spec.timeout is not None:
-            builder = builder.timeout(spec.timeout)
-        policy = spec.finish_when_policy()
-        if policy is not None:
-            builder = builder.finish_when(policy)
-        if symmetry:
-            builder = builder.symmetry()
-        return model, cli, builder, n
+        return make_builder(spec, engine, symmetry)
 
     def _spawn(self, builder, spec: JobSpec, engine: str,
                engine_kwargs: dict, seed: int):
-        if engine == "tpu":
-            return builder.spawn_tpu(**engine_kwargs)
-        if engine == "tiered":
-            return builder.spawn_tpu_tiered(**engine_kwargs)
-        if engine == "sharded":
-            return builder.spawn_tpu_sharded(**engine_kwargs)
-        if engine == "tiered-sharded":
-            return builder.spawn_tpu_tiered_sharded(**engine_kwargs)
-        if engine == "bfs":
-            return builder.spawn_bfs()
-        if engine == "dfs":
-            return builder.spawn_dfs()
-        if engine == "tpu_simulation":
-            return builder.spawn_tpu_simulation(seed, **engine_kwargs)
-        if engine == "simulation":
-            return builder.spawn_simulation(seed)
-        raise ValueError(engine)
+        return spawn_engine(builder, spec, engine, engine_kwargs, seed)
 
     def _bound_simulation(self, builder, spec: JobSpec) -> None:
-        """Simulation engines only stop on a policy/target/timeout; give
-        unbounded specs the service default instead of an immortal job."""
-        from ..core.has_discoveries import HasDiscoveries
-
-        if spec.finish_when is None:
-            builder.finish_when(HasDiscoveries.ANY_FAILURES)
-        if spec.target_state_count is None and spec.timeout is None:
-            builder.target_state_count(_SIM_DEFAULT_TARGET)
+        bound_simulation(builder, spec)
 
     # -- single-run jobs ------------------------------------------------------
 
@@ -572,73 +663,11 @@ class Scheduler:
 
     @staticmethod
     def _knob_engine_tag(engine: str) -> str:
-        """The knob_key engine tag for a job's engine: sharded and
-        tiered entries live under their own tags (their knob sets and
-        sizing rules differ from the single-chip engine's); everything
-        else uses the single-chip default (simulation winners only ever
-        land under the portfolio-only label, so the tag is inert for
-        them)."""
-        from ..runtime.knob_cache import (
-            SHARDED_ENGINE, SINGLE_CHIP_ENGINE, TIERED_ENGINE,
-            TIERED_SHARDED_ENGINE,
-        )
-
-        if engine == "sharded":
-            return SHARDED_ENGINE
-        if engine == "tiered":
-            return TIERED_ENGINE
-        if engine == "tiered-sharded":
-            return TIERED_SHARDED_ENGINE
-        return SINGLE_CHIP_ENGINE
+        return knob_engine_tag(engine)
 
     @staticmethod
     def _final_geometry(checker) -> dict:
-        # The keys are exactly the engines' spawn kwargs: single-chip
-        # (and tiered, whose budget-derived capacity lands here as the
-        # capacity it pinned) exposes capacity/log_capacity/
-        # max_frontier/dedup_factor/sort_lanes, the sharded engine
-        # capacity/chunk_size/dedup_factor/bucket_slack/sort_lanes (the
-        # discovered exchange-bucket and sort-geometry rungs —
-        # persisting them is what lets a warm repeat skip the
-        # overflow-retry ramps, not just the auto-tune growth).  Each
-        # engine's metrics() emits its own subset; the `in m` filter
-        # picks the right one.
-        m = checker.metrics()
-        out = {
-            k: int(m[k])
-            for k in ("capacity", "log_capacity", "max_frontier",
-                      "chunk_size", "dedup_factor", "bucket_slack")
-            if k in m
-        }
-        # The rungs persist ONLY when the run actually pinned one
-        # (sort_lanes_rung/step_lanes_rung; 0 = full buffer, tuner
-        # armed): storing the live full width from a too-short-to-tune
-        # run would spawn every warm repeat with an explicit rung and
-        # disarm its tuner.  The dedup PATH persists always — a
-        # sortless→sort fallback is a per-workload selection a warm
-        # repeat must not re-discover with another aborted wave.
-        # ...and the sort rung NEVER persists off a sortless run: there
-        # it is the claim compaction buffer's tuner detail, and an
-        # explicit sort_lanes under sortless is the fallback-forcing
-        # budget cap — a warm repeat must re-arm the tuner instead.
-        rung = int(m.get("sort_lanes_rung", 0) or 0)
-        if rung and not m.get("sortless"):
-            out["sort_lanes"] = rung
-        step_rung = int(m.get("step_lanes_rung", 0) or 0)
-        if step_rung:
-            out["step_lanes"] = step_rung
-        if "sortless" in m:
-            out["sortless"] = int(bool(m["sortless"]))
-        # The tiered-sharded engine's PER-SHARD budget is part of its
-        # geometry identity (it derives cap_s, which the snapshot and
-        # the warm start must agree on); a float, so it bypasses the
-        # int() cast above.  The budget-keyed cache label already
-        # separates budgets — storing it here makes the warm-started
-        # spawn self-describing even without the label.
-        if m.get("engine") == "tpu-tiered-sharded" and \
-                m.get("memory_budget_mb") is not None:
-            out["memory_budget_mb"] = float(m["memory_budget_mb"])
-        return out
+        return final_geometry(checker)
 
     def _poll_to_completion(self, job: Job, checker) -> None:
         while not checker.is_done():

@@ -177,16 +177,29 @@ def serve(
     workers: int = 1,
     retain_checkers: int = 4,
     store_dir: Optional[str] = None,
+    fleet_dir: Optional[str] = None,
 ) -> CheckService:
     """Start the checking service on ``address`` ((host, port); port 0
     binds an ephemeral one).  ``block=False`` serves on a background
     thread and returns the service immediately (``service.address``
     carries the bound port).  ``store_dir`` enables the persistent
-    verification store for ``store: true`` jobs (docs/INCREMENTAL.md)."""
-    service = CheckService(
-        journal=journal, knob_cache_dir=knob_cache_dir, workers=workers,
-        retain_checkers=retain_checkers, store_dir=store_dir,
-    )
+    verification store for ``store: true`` jobs (docs/INCREMENTAL.md).
+
+    ``fleet_dir`` swaps the backend: the HTTP surface is unchanged, but
+    jobs are appended to the durable fleet store at that directory and
+    run by separately-launched ``fleet-worker`` processes instead of
+    this process's scheduler threads (fleet/, docs/SERVING.md "Fleet
+    mode").  The other backend knobs don't apply in that mode."""
+    if fleet_dir is not None:
+        from ..fleet.service import FleetService
+
+        service = FleetService(fleet_dir)
+    else:
+        service = CheckService(
+            journal=journal, knob_cache_dir=knob_cache_dir,
+            workers=workers, retain_checkers=retain_checkers,
+            store_dir=store_dir,
+        )
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet

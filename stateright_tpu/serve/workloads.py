@@ -18,7 +18,10 @@ import importlib
 from typing import List, Optional, Tuple
 
 # Model modules with a cli_spec(); fixtures is the known-violating
-# TrapCounter workload the service's own smoke tests submit.
+# TrapCounter workload the service's own smoke tests submit, and
+# grid_walk is the gang-batchable family (fleet/gang.py) — small,
+# bound-parameterized, and exhaustive, so K differently-bounded
+# submissions fold into one device dispatch.
 SERVABLE = (
     "twophase",
     "paxos",
@@ -29,6 +32,7 @@ SERVABLE = (
     "single_copy_register",
     "increment",
     "fixtures",
+    "grid_walk",
 )
 
 

@@ -1,0 +1,506 @@
+"""Fleet serving (fleet/, docs/SERVING.md "Fleet mode"): the durable
+multi-worker store, gang batching, heterogeneous placement, and
+preemption.
+
+The two PR acceptance gates live here: gang parity (a K>=4 gang's
+per-job fingerprints and verdicts are bit-equal to K solo runs) and
+durability (kill -9 a worker mid-job; a sibling requeues and completes
+it with an identical result, and the fleet journal alone reconstructs
+the history).  The CI fleet smoke re-runs both through real processes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.fleet import (  # noqa: E402
+    DONE, FleetService, FleetStore, FleetWorker, QUEUED, QuotaExceeded,
+    RUNNING, gang_eligibility, is_big, placement_order, run_gang,
+    worker_takes,
+)
+from stateright_tpu.models.fixtures import (  # noqa: E402
+    CapCounter, GridWalk, TrapCounter,
+)
+from stateright_tpu.serve.jobs import JobSpec  # noqa: E402
+from stateright_tpu.serve.portfolio import checker_summary  # noqa: E402
+
+GRID = {"workload": "grid_walk", "engine": "tpu"}
+
+
+def grid_spec(bound):
+    return JobSpec.from_dict(dict(GRID, n=bound))
+
+
+def drain(root, **kw):
+    kw.setdefault("lease_sec", 5.0)
+    kw.setdefault("poll_interval", 0.01)
+    w = FleetWorker(str(root), **kw)
+    w.run(once=True)
+    return w
+
+
+# --- durable store -----------------------------------------------------------
+
+
+def test_journal_alone_reconstructs_history(tmp_path):
+    store = FleetStore(str(tmp_path))
+    jid = store.submit(grid_spec(3), tenant="acme", priority=2)
+    drain(tmp_path)
+    # A fresh store instance (a different process, as far as the store
+    # is concerned) folds the same journal to the same state.
+    again = FleetStore(str(tmp_path)).fold()
+    rec = again.jobs[jid]
+    assert rec["state"] == DONE
+    assert rec["tenant"] == "acme" and rec["priority"] == 2
+    assert rec["worker"] is not None
+    result = FleetStore(str(tmp_path)).read_result(jid)
+    assert result["unique_state_count"] == 16  # (bound+1)^2
+
+
+def test_claim_race_exactly_one_winner(tmp_path):
+    a = FleetStore(str(tmp_path))
+    b = FleetStore(str(tmp_path))
+    a.submit(grid_spec(3))
+    job_a = a.fold().queued()[0]
+    job_b = b.fold().queued()[0]
+    wins = [a.claim(job_a, worker="w-a"), b.claim(job_b, worker="w-b")]
+    assert sorted(wins) == [False, True]
+    events = [e["event"] for e in _events(tmp_path)]
+    assert events.count("fleet_claimed") == 1
+    # The loser's race is journaled, not silently swallowed.
+    assert events.count("fleet_claim_lost") == 1
+    assert a.fold().jobs[job_a["id"]]["state"] == RUNNING
+
+
+def test_quota_refuses_admission_at_limit(tmp_path):
+    store = FleetStore(str(tmp_path))
+    store.set_quota("acme", 2)
+    store.submit(grid_spec(3), tenant="acme")
+    store.submit(grid_spec(4), tenant="acme")
+    with pytest.raises(QuotaExceeded):
+        store.submit(grid_spec(5), tenant="acme")
+    # Another tenant is unaffected; finishing work frees the quota.
+    store.submit(grid_spec(5), tenant="other")
+    drain(tmp_path)
+    store.submit(grid_spec(5), tenant="acme")
+
+
+def test_cancel_queued_job_without_worker(tmp_path):
+    store = FleetStore(str(tmp_path))
+    jid = store.submit(grid_spec(3))
+    assert store.cancel(jid) is True
+    assert store.fold().jobs[jid]["state"] == "cancelled"
+    assert store.cancel(jid) is False  # already terminal
+    drain(tmp_path)  # a worker must not resurrect it
+    assert store.fold().jobs[jid]["state"] == "cancelled"
+
+
+def _events(root):
+    out = []
+    with open(os.path.join(str(root), "journal.jsonl")) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# --- kill -9 durability (the acceptance gate) --------------------------------
+
+
+def test_sigkill_mid_job_requeued_by_sibling_with_identical_result(
+    tmp_path,
+):
+    """A worker claims a job and dies with kill -9 (no atexit, no
+    journal flush beyond what already hit disk).  After one lease
+    period a sibling requeues and completes it; the result matches a
+    clean run bit-for-bit."""
+    store = FleetStore(str(tmp_path), lease_sec=1.0)
+    jid = store.submit(grid_spec(5))
+    # The doomed worker: claims + leases, then SIGKILLs itself mid-job.
+    script = textwrap.dedent(f"""
+        import os, signal
+        from stateright_tpu.fleet import FleetStore
+        store = FleetStore({str(tmp_path)!r}, lease_sec=1.0)
+        job = store.fold().queued()[0]
+        assert store.claim(job, worker="doomed@test")
+        store.lease(job["id"], job["attempt"])
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL
+    assert store.fold().jobs[jid]["state"] == RUNNING  # orphaned claim
+    time.sleep(1.1)  # one lease period
+    sibling = FleetWorker(str(tmp_path), lease_sec=1.0,
+                          poll_interval=0.01)
+    sibling.run(once=True)
+    view = store.fold()
+    rec = view.jobs[jid]
+    assert rec["state"] == DONE
+    assert rec["attempt"] == 1
+    assert view.counters["fleet_lease_requeues"] >= 1
+    requeued = store.read_result(jid)
+    # Identical verdict to a clean run of the same spec.
+    clean_store = FleetStore(str(tmp_path / "clean"))
+    cid = clean_store.submit(grid_spec(5))
+    drain(tmp_path / "clean")
+    clean = clean_store.read_result(cid)
+    for key in ("unique_state_count", "state_count", "max_depth",
+                "violation", "properties"):
+        assert requeued[key] == clean[key], key
+
+
+def test_orphan_claim_requeued_when_claimant_died_before_lease(tmp_path):
+    """Sharper crash window: the claim lock exists but the fold shows
+    QUEUED (the claimant died between taking the lock and journaling).
+    The orphan sweep must free it."""
+    store = FleetStore(str(tmp_path), lease_sec=0.2)
+    jid = store.submit(grid_spec(3))
+    job = store.fold().queued()[0]
+    lock = os.path.join(str(tmp_path), "locks", f"{jid}.claim.0")
+    with open(lock, "w") as fh:
+        fh.write("dead@test")
+    past = time.time() - 5.0
+    os.utime(lock, (past, past))
+    assert store.requeue_expired() == 1
+    rec = store.fold().jobs[jid]
+    assert rec["state"] == QUEUED and rec["attempt"] == 1
+    drain(tmp_path, lease_sec=0.2)
+    assert store.fold().jobs[jid]["state"] == DONE
+
+
+# --- gang batching (the parity gate) -----------------------------------------
+
+
+def _solo_summaries(models):
+    out = []
+    for model in models:
+        checker = model.checker().spawn_tpu(
+            capacity=1 << 12, max_frontier=1 << 7
+        )
+        checker.join()
+        out.append((checker_summary(checker),
+                    checker.discovered_fingerprints()))
+    return out
+
+
+def _gang_members(models):
+    members = []
+    for i, model in enumerate(models):
+        cm = model.compiled()
+        members.append({
+            "tag": i, "model": model, "cm": cm,
+            "consts": cm.gang_constants(),
+        })
+    return members
+
+
+def test_gang_of_four_bit_equal_to_solo_runs():
+    """THE parity gate: one K=4 device dispatch produces, per member,
+    the same discovered fingerprints, counts, depths, and property
+    verdicts as four solo engine runs."""
+    bounds = (3, 5, 6, 8)
+    models = [GridWalk(bound=b) for b in bounds]
+    results, waves = run_gang(_gang_members(models))
+    assert waves > 0
+    solos = [s for s, _ in _solo_summaries(models)]
+    solo_fps = [f for _, f in _solo_summaries(models)]
+    for (tag, checker, reason), solo, fps in zip(
+        results, solos, solo_fps
+    ):
+        assert checker is not None, reason
+        assert checker_summary(checker) == solo
+        np.testing.assert_array_equal(
+            checker.discovered_fingerprints(), fps
+        )
+
+
+def test_gang_mixed_verdicts_violating_member_isolated():
+    """A violating member's verdict (and VIOLATION_RC-worthy
+    ``violation`` field) matches its solo run while its gang-mates
+    stay clean — no verdict bleed across the jobs axis."""
+    params = [(4, 10), (12, 8), (6, 6), (9, 20)]
+    models = [CapCounter(limit=lim, cap=cap) for lim, cap in params]
+    results, _ = run_gang(_gang_members(models))
+    solos = _solo_summaries(models)
+    for (tag, checker, _), (solo, fps) in zip(results, solos):
+        assert checker_summary(checker) == solo
+        np.testing.assert_array_equal(
+            checker.discovered_fingerprints(), fps
+        )
+    # (12, 8) counts past its cap: that member alone reports it.
+    violations = [
+        checker_summary(c)["violation"] for _, c, _ in results
+    ]
+    assert violations == [None, "within cap", None, None]
+
+
+def test_gang_member_overgrowing_geometry_is_ejected():
+    models = [GridWalk(bound=2), GridWalk(bound=12)]
+    results, _ = run_gang(_gang_members(models), max_frontier=8)
+    small, big = results
+    assert small[1] is not None  # completed inside the budget
+    assert big[1] is None and "frontier" in big[2]
+    assert checker_summary(small[1])["unique_state_count"] == 9
+
+
+def test_gang_eligibility_reasons():
+    ok, _ = gang_eligibility(grid_spec(4))
+    assert ok is not None
+    # Same family, different constants: compatible keys.
+    ok2, _ = gang_eligibility(grid_spec(7))
+    assert ok2 == ok
+    ineligible = [
+        dict(GRID, engine="bfs"),              # host engine
+        dict(GRID, target_state_count=10),     # early-stop target
+        dict(GRID, engine_kwargs={"resume_from": "x"}),  # non-geometry
+        {"workload": "fixtures", "engine": "tpu"},  # EVENTUALLY props
+    ]
+    for spec in ineligible:
+        compat, reason = gang_eligibility(JobSpec.from_dict(spec))
+        assert compat is None and reason
+
+
+def test_worker_gang_dispatch_ejects_and_requeues_solo(tmp_path):
+    """Through the worker: a gang member that overgrows is requeued
+    ``solo`` and completed by the next pass, never gang-planned again."""
+    store = FleetStore(str(tmp_path))
+    small = [store.submit(grid_spec(b)) for b in (2, 3, 4)]
+    big = store.submit(grid_spec(12))  # frontier outgrows the gang's
+    w = FleetWorker(str(tmp_path), lease_sec=5.0, poll_interval=0.01,
+                    gang_max=8, gang_frontier=8)
+    w.run(once=True)
+    view = store.fold()
+    assert all(view.jobs[j]["state"] == DONE for j in small + [big])
+    assert view.jobs[big]["gang"] is None  # completed solo
+    assert view.jobs[big]["solo"] is True
+    assert view.counters["gang_ejects"] == 1
+    assert view.counters["gang_dispatches"] >= 1
+    gang_sizes = [
+        len(e.get("jobs", ())) for e in _events(tmp_path)
+        if e["event"] == "gang_dispatch"
+    ]
+    assert max(gang_sizes) >= 3
+    assert store.read_result(big)["unique_state_count"] == 13 * 13
+
+
+# --- placement ---------------------------------------------------------------
+
+
+CPU_DESC = {"platform": "cpu", "device_kind": "cpu", "memory_mb": 4096,
+            "engines": ["tpu", "tiered", "bfs", "dfs", "simulation",
+                        "tpu_simulation"],
+            "accept_big": False}
+TPU_DESC = {"platform": "tpu", "device_kind": "TPU v4",
+            "memory_mb": 32768,
+            "engines": ["tpu", "tiered", "sharded", "tiered-sharded",
+                        "bfs", "dfs", "simulation", "tpu_simulation"],
+            "accept_big": False}
+
+
+def _knob_history(tmp_path, label_prefix, unique):
+    knob_dir = tmp_path / "knobs"
+    knob_dir.mkdir(exist_ok=True)
+    (knob_dir / "knobs.json").write_text(json.dumps({
+        f"{label_prefix}|cpu|cpu|tpu-wavefront-v3": {
+            "knobs": {"capacity": 1 << 12}, "unique": unique,
+        },
+    }))
+    return str(knob_dir)
+
+
+def test_big_jobs_reserved_for_tpu_workers(tmp_path):
+    from stateright_tpu.serve.workloads import workload_label
+
+    label = workload_label("grid_walk", 5, None, False)
+    knobs = _knob_history(tmp_path, label, unique=1 << 21)
+    spec = {"workload": "grid_walk", "n": 5, "engine": "tpu"}
+    assert is_big(spec, knobs) is True
+    job = {"spec": spec}
+    assert worker_takes(job, CPU_DESC, knobs) is False
+    assert worker_takes(job, TPU_DESC, knobs) is True
+    assert worker_takes(job, dict(CPU_DESC, accept_big=True),
+                        knobs) is True
+    # Unknown workloads default small; huge explicit capacity is big.
+    assert is_big({"workload": "grid_walk", "n": 9}, knobs) is False
+    assert is_big({"workload": "grid_walk", "n": 9,
+                   "engine_kwargs": {"capacity": 1 << 22}}, None) is True
+    # Mesh engines are big AND need the capability.
+    mesh = {"spec": {"workload": "grid_walk", "engine": "sharded"}}
+    assert worker_takes(mesh, CPU_DESC, None) is False
+    assert worker_takes(mesh, TPU_DESC, None) is True
+
+
+def test_tpu_workers_drain_big_jobs_first(tmp_path):
+    from stateright_tpu.serve.workloads import workload_label
+
+    label = workload_label("grid_walk", 5, None, False)
+    knobs = _knob_history(tmp_path, label, unique=1 << 21)
+    small = {"id": "s", "spec": {"workload": "grid_walk", "n": 3},
+             "priority": 5}
+    big = {"id": "b", "spec": {"workload": "grid_walk", "n": 5},
+           "priority": 0}
+    queue = [small, big]  # priority-sorted: small first
+    assert [j["id"] for j in placement_order(queue, TPU_DESC, knobs)] \
+        == ["b", "s"]
+    assert [j["id"] for j in placement_order(queue, CPU_DESC, knobs)] \
+        == ["s"]
+
+
+# --- preemption / resume -----------------------------------------------------
+
+
+def test_preempted_job_resumes_from_snapshot_with_identical_result(
+    tmp_path,
+):
+    """store.preempt's requeue-with-resume contract end-to-end: the
+    next claimant spawns with ``resume_from=`` and the final result
+    matches an uninterrupted run."""
+    store = FleetStore(str(tmp_path))
+    jid = store.submit(grid_spec(8))
+    job = store.fold().queued()[0]
+    assert store.claim(job, worker="preemptor@test")
+    # A real partial run: stop early via target_state_count, snapshot.
+    partial = (
+        GridWalk(bound=8).checker().target_state_count(20)
+        .spawn_tpu(capacity=1 << 12, max_frontier=1 << 7)
+    )
+    partial.join()
+    assert partial.unique_state_count() < 81
+    snap = store.snapshot_path(jid, job["attempt"])
+    partial.save_snapshot(snap)
+    store.preempt(job, snap, "higher-priority job queued")
+    rec = store.fold().jobs[jid]
+    assert rec["state"] == QUEUED and rec["attempt"] == 1
+    assert rec["resume"] == snap
+    assert store.fold().counters["fleet_preemptions"] == 1
+    drain(tmp_path)
+    result = store.read_result(jid)
+    assert result["unique_state_count"] == 81
+    assert result["violation"] is None
+
+
+# --- fleet service (the unchanged HTTP surface) ------------------------------
+
+
+def test_fleet_service_matches_handler_surface(tmp_path):
+    svc = FleetService(str(tmp_path))
+    view = svc.submit(dict(GRID, n=3, tenant="acme", priority=1))
+    assert view.state == QUEUED
+    assert svc.get(view.id).id == view.id
+    assert svc.get("nope") is None
+    drain(tmp_path)
+    assert view.wait(10.0)
+    snap = view.snapshot()
+    assert snap["state"] == DONE
+    assert snap["tenant"] == "acme"
+    assert snap["result"]["unique_state_count"] == 16
+    assert snap["worker"] is not None
+    with pytest.raises(ValueError):
+        svc.explore(view)
+    m = svc.metrics()
+    assert m["mode"] == "fleet"
+    assert m["jobs"]["done"] == 1
+    assert "fleet_claims" in m
+    assert svc.status()["jobs"]["done"] == 1
+
+
+def test_fleet_backed_http_server(tmp_path):
+    import threading
+    import urllib.request
+
+    from stateright_tpu.serve.server import serve
+
+    svc = serve(("127.0.0.1", 0), block=False,
+                fleet_dir=str(tmp_path))
+    try:
+        host, port = svc.address[:2]
+        base = f"http://{host}:{port}"
+
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read())
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as resp:
+                return json.loads(resp.read())
+
+        created = post("/jobs", dict(GRID, n=3))
+        assert created["state"] == QUEUED
+        worker = threading.Thread(target=drain, args=(tmp_path,))
+        worker.start()
+        done = get(f"/jobs/{created['id']}/result?wait=60")
+        worker.join()
+        assert done["state"] == DONE
+        assert done["result"]["unique_state_count"] == 16
+        metrics = get("/.metrics")
+        assert metrics["mode"] == "fleet"
+        assert metrics["jobs"]["done"] == 1
+        assert metrics["workers_alive"] >= 0
+        assert get("/.status")["workloads"]
+        assert len(get("/jobs")) == 1
+    finally:
+        svc.shutdown()
+
+
+def test_fleet_report_and_watch_render(tmp_path):
+    """The journal a fleet run leaves behind feeds report/watch: the
+    fleet section carries the counters and the gang occupancy."""
+    store = FleetStore(str(tmp_path))
+    for b in (3, 4, 5, 6):
+        store.submit(grid_spec(b))
+    drain(tmp_path)
+    from stateright_tpu.obs.report import analyze_journal, render_markdown
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    journal = os.path.join(str(tmp_path), "journal.jsonl")
+    report = analyze_journal(journal)
+    assert report["kind"] == "fleet"
+    fleet = report["fleet"]
+    assert fleet["jobs"]["done"] == 4
+    assert fleet["gang_occupancy"] == 4.0
+    md = render_markdown(report)
+    assert "## Fleet" in md and "gang occupancy" in md
+    s = summarize_events(_events(tmp_path))
+    assert s["fleet"]["done"] == 4
+    line = render_line(s)
+    assert "fleet done=4" in line and "gang_occ=4" in line
+
+
+def test_portfolio_diversifies_across_fleet(tmp_path):
+    """A portfolio submission expands into member jobs any worker can
+    claim; the group resolves from the members' verdicts."""
+    store = FleetStore(str(tmp_path))
+    parent = store.submit(JobSpec.from_dict({
+        "workload": "fixtures", "engine": "tpu",
+        "portfolio": {"size": 3, "seed": 7},
+    }))
+    view = store.fold()
+    members = [j for j in view.jobs.values() if j["group"] == parent]
+    assert len(members) == 3
+    assert view.jobs[parent]["portfolio_parent"] is True
+    assert all(j["id"].startswith(parent + ".m") for j in members)
+    # Parents are bookkeeping: never claimable.
+    assert parent not in [j["id"] for j in view.queued()]
+    drain(tmp_path)
+    final = store.fold()
+    assert final.jobs[parent]["state"] == DONE
+    result = store.read_result(parent)
+    # fixtures (TrapCounter) violates: the first violating member wins.
+    assert result["violation"] is not None

@@ -628,3 +628,78 @@ def test_running_job_vitals_over_http(http_service):
     if saw_vitals is not None:
         assert saw_vitals["unique_state_count"] >= 0
         assert "table_load_factor" in saw_vitals
+
+
+# --- servable-spec round-trips and worker attribution ------------------------
+
+
+@pytest.mark.parametrize("name", workload_names())
+def test_every_servable_cli_spec_defaults_validate_as_jobspec(name):
+    """Every SERVABLE name must resolve a cli_spec() whose defaults
+    survive JobSpec validation end-to-end — a workload registered but
+    unsubmittable is a registration bug, caught here instead of by the
+    first user."""
+    from stateright_tpu.serve.workloads import cli_spec_for
+
+    cli = cli_spec_for(name)
+    spec = JobSpec.from_dict({
+        "workload": name, "n": cli.default_n,
+        "network": cli.default_network,
+    })
+    # The dict round-trip is exact (what the fleet store journals).
+    assert JobSpec.from_dict(spec.to_dict()).to_dict() == spec.to_dict()
+    model, _cli, n = build_model(spec.workload, spec.n, spec.network)
+    assert n == cli.default_n
+    assert model.properties()
+
+
+def test_every_job_event_carries_worker_stamp(tmp_path):
+    """Satellite: multi-worker attribution — every job_* lifecycle row
+    is stamped with the worker (pid@host) that wrote it, and the report
+    job table renders it."""
+    import os as _os
+    import socket as _socket
+
+    journal = tmp_path / "journal.jsonl"
+    svc = CheckService(journal=str(journal))
+    try:
+        job = svc.submit(SMALL_2PC)
+        assert job.wait(300)
+    finally:
+        svc.scheduler.shutdown()
+    stamp = f"{_os.getpid()}@{_socket.gethostname()}"
+    job_events = [
+        e for e in read_journal(str(journal))
+        if str(e.get("event", "")).startswith("job_")
+    ]
+    assert job_events
+    assert all(e.get("worker") == stamp for e in job_events)
+    from stateright_tpu.obs.report import analyze_journal, render_markdown
+
+    report = analyze_journal(str(journal))
+    detail = report["jobs"]["detail"]
+    assert all(j.get("worker") == stamp for j in detail.values())
+    md = render_markdown(report)
+    assert "| worker |" in md and stamp in md
+
+
+def test_serve_main_rejects_nonpositive_workers(capsys):
+    from stateright_tpu.serve.__main__ import main as serve_main
+
+    for bad in ("0", "-3"):
+        assert serve_main(["--workers", bad]) == 2
+        err = capsys.readouterr().err
+        assert "--workers must be >= 1" in err
+        assert "fleet" in err  # points at the per-backend alternative
+
+
+def test_serve_main_rejects_fleet_dir_with_inprocess_flags(
+    tmp_path, capsys
+):
+    from stateright_tpu.serve.__main__ import main as serve_main
+
+    rc = serve_main([
+        "--fleet-dir", str(tmp_path), "--workers", "2",
+    ])
+    assert rc == 2
+    assert "--fleet-dir" in capsys.readouterr().err
