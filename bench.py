@@ -242,13 +242,18 @@ REFERENCE_SUITE = [
 ]
 
 
-def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
+def discover_and_measure(label: str, mk, want_unique: int, want_depth: int,
+                         extras: dict = None):
     """THE measurement protocol, shared by the headline and every suite
     workload so the two cannot drift: a timed default-knob discovery run
     (auto-tune does all sizing) — SKIPPED when the knob cache already
     holds this workload's tuned sizes — a (unique, depth) golden gate,
     then up to MEASURED_REPEATS measured runs at ``tuned_kwargs()`` —
-    each re-gated — with big workloads (>120s) measured once.  Returns
+    each re-gated — with big workloads (>120s) measured once.  When
+    ``extras`` (an out-dict) is given, the last measured run's
+    ``host_share`` gauge (obs/timeline.host_share_of — host tail over
+    host+device loop time) is captured into it before the checker is
+    torn down.  Returns
     ``(discovery_sec, tuned, samples, knobs_cached)``; raises on any
     golden mismatch or device error (a wrong answer must never post a
     rate).  A cached entry that fails its first golden gate is dropped
@@ -289,6 +294,12 @@ def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
             lambda: mk().checker().spawn_tpu(**tuned)
         )
         unique, depth = ck.unique_state_count(), ck.max_depth()
+        if extras is not None:
+            from stateright_tpu.obs.timeline import host_share_of
+
+            hs = host_share_of(ck.metrics())
+            if hs is not None:
+                extras["host_share"] = round(hs, 4)
         del ck
         gc.collect()
         if (unique, depth) != (want_unique, want_depth):
@@ -301,7 +312,9 @@ def discover_and_measure(label: str, mk, want_unique: int, want_depth: int):
                     f"(unique={unique} depth={depth}); dropping cache "
                     "entry and rediscovering")
                 drop_knobs(KNOB_CACHE_DIR, key)
-                return discover_and_measure(label, mk, want_unique, want_depth)
+                return discover_and_measure(
+                    label, mk, want_unique, want_depth, extras=extras
+                )
             raise AssertionError(
                 f"{label}: measured golden mismatch: unique={unique} "
                 f"depth={depth} != {want_unique}/{want_depth}"
@@ -1671,9 +1684,11 @@ def phase_headline(record: dict, threads: int) -> dict:
     # False already here if the smoke phase had to fall back.
     two_phase = hasattr(PaxosCompiled, "step_valid")
     single_phase_reason = record.get("single_phase_reason")
+    extras: dict = {}
     try:
         discovery, tuned, samples, knobs_cached = discover_and_measure(
-            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
+            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH,
+            extras=extras,
         )
     except Exception as exc:
         # Deterministic worker crashes surface as UNAVAILABLE, the same
@@ -1689,7 +1704,8 @@ def phase_headline(record: dict, threads: int) -> dict:
         log("headline: device run failed; retrying single-phase:")
         log(traceback.format_exc(limit=5))
         discovery, tuned, samples, knobs_cached = discover_and_measure(
-            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH
+            "headline", lambda: paxos_model(3), GOLDEN_UNIQUE, GOLDEN_DEPTH,
+            extras=extras,
         )
     best = min(samples)
     tpu_rate = GOLDEN_UNIQUE / best
@@ -1741,6 +1757,12 @@ def phase_headline(record: dict, threads: int) -> dict:
         "tuned_kwargs_cached": knobs_cached,
         "two_phase": two_phase,
     })
+    if "host_share" in extras:
+        # The host-tail gauge (obs/timeline.py): host / (host + device)
+        # loop time of the last measured run — the trajectory table
+        # tracks it so a creeping host tail is visible across rounds
+        # even while uniq/s holds.
+        record["host_share"] = extras["host_share"]
     if single_phase_reason:
         record["single_phase_reason"] = single_phase_reason
     # The score of record: emitted the moment it exists, so no later phase

@@ -45,7 +45,8 @@ def _usage(name: str, spec: "CliSpec") -> str:
                      " [--sort-lanes N] [--sortless|--no-sortless]"
                      " [--step-lanes N]"
                      " [--tiered] [--memory-budget-mb MB]"
-                     " [--store-dir DIR] [--incremental]")
+                     " [--store-dir DIR] [--incremental]"
+                     " [--xprof-dir DIR]")
         lines.append(f"  reshard [{n_meta}] IN.npz OUT.npz --shards M{net}")
     lines.append(f"  explore [{n_meta}] [ADDRESS]{net}")
     lines.append(
@@ -69,9 +70,13 @@ def _usage(name: str, spec: "CliSpec") -> str:
     lines.append("  status [JOB_ID] [--address ADDR]")
     lines.append(
         "  report <journal.jsonl | BENCH-glob | dir> [--json]"
-        " [--out FILE] [--threshold FRAC]"
+        " [--out FILE] [--threshold FRAC] [--timeline-out FILE]"
     )
     lines.append("  watch <journal.jsonl> [--interval SEC] [--once]")
+    lines.append(
+        "  timeline export <journal.jsonl | run-dir | fleet-dir>..."
+        " [--out FILE]"
+    )
     if spec.spawn is not None:
         lines.append(
             "  spawn [--chaos SPEC_JSON] [--seed N] [--audit]"
@@ -149,7 +154,11 @@ def _extract_runtime_flags(args):
     budget flag alone implies ``--tiered``); ``store_dir`` /
     ``incremental`` route the check through the persistent verification
     store (docs/INCREMENTAL.md: ``--store-dir`` alone records the run,
-    ``--incremental`` additionally reuses stored entries) — or raises
+    ``--incremental`` additionally reuses stored entries);
+    ``xprof_dir`` wraps the run in a JAX profiler trace
+    (``jax.profiler.start_trace``) with per-quantum step annotations
+    whose names match the journal's host-span phases
+    (docs/OBSERVABILITY.md "Timeline export and profiling") — or raises
     ``ValueError`` on a malformed flag."""
     supervise = False
     resume = False
@@ -164,6 +173,7 @@ def _extract_runtime_flags(args):
     memory_budget_mb = None
     store_dir = None
     incremental = False
+    xprof_dir = None
     out = []
     i = 0
     while i < len(args):
@@ -279,6 +289,18 @@ def _extract_runtime_flags(args):
                 ) from None
             if step_lanes < 1:
                 raise ValueError("--step-lanes must be >= 1")
+        elif a == "--xprof-dir" or a.startswith("--xprof-dir="):
+            if a == "--xprof-dir":
+                i += 1
+                if i >= len(args):
+                    raise ValueError("--xprof-dir requires a directory")
+                xprof_dir = args[i]
+            else:
+                xprof_dir = a.split("=", 1)[1]
+            if not xprof_dir:
+                raise ValueError(
+                    "--xprof-dir requires a non-empty directory"
+                )
         elif a == "--checkpoint-dir":
             i += 1
             if i >= len(args):
@@ -299,7 +321,7 @@ def _extract_runtime_flags(args):
     return (
         out, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
         sort_lanes, sortless, step_lanes, tiered, memory_budget_mb,
-        store_dir, incremental,
+        store_dir, incremental, xprof_dir,
     )
 
 
@@ -910,7 +932,7 @@ def example_main(spec: CliSpec, argv=None) -> int:
         (
             args, supervise, ckpt_dir, resume, trace, sharded, bucket_slack,
             sort_lanes, sortless, step_lanes, tiered, memory_budget_mb,
-            store_dir, incremental,
+            store_dir, incremental, xprof_dir,
         ) = _extract_runtime_flags(args)
     except ValueError as e:
         print(e, file=sys.stderr)
@@ -940,6 +962,14 @@ def example_main(spec: CliSpec, argv=None) -> int:
             "--trace/--supervise/--checkpoint-dir/--resume (the store "
             "journals plain spawn_tpu runs; run those modes without the "
             "store)",
+            file=sys.stderr,
+        )
+        return 2
+    if xprof_dir is not None and (sub != "check-tpu" or supervise):
+        print(
+            "--xprof-dir requires the check-tpu subcommand without "
+            "--supervise (the profiler wraps one in-process run; "
+            "docs/OBSERVABILITY.md \"Timeline export and profiling\")",
             file=sys.stderr,
         )
         return 2
@@ -1059,6 +1089,28 @@ def example_main(spec: CliSpec, argv=None) -> int:
                 tiered=tiered, memory_budget_mb=memory_budget_mb,
                 sharded=sharded,
             )
+        xprof_active = False
+        if xprof_dir is not None:
+            # Hardware profiler hook (docs/OBSERVABILITY.md "Timeline
+            # export and profiling"): wrap the whole run in a JAX
+            # profiler trace.  The fused loop's per-quantum
+            # StepTraceAnnotation names match the journal's host-span
+            # phases, so the xprof timeline aligns with the journal's
+            # `timeline export` view.
+            from .obs.timeline import set_xprof
+
+            try:
+                import jax
+
+                jax.profiler.start_trace(os.path.abspath(xprof_dir))
+            except Exception as e:
+                print(
+                    f"--xprof-dir: profiler unavailable: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+            set_xprof(True)
+            xprof_active = True
         model = _build(spec, n, network)
         print(f"Checking {spec.name} with {spec.n_meta.lower()}={n}"
               + (f", network={network.kind}" if network is not None else ""))
@@ -1179,6 +1231,14 @@ def example_main(spec: CliSpec, argv=None) -> int:
         else:
             checker = builder.spawn_bfs()
         checker.join_and_report(WriteReporter(sys.stdout))
+        if xprof_active:
+            from .obs.timeline import set_xprof
+
+            import jax
+
+            set_xprof(False)
+            jax.profiler.stop_trace()
+            print(f"xprof: profiler trace written under {xprof_dir}")
         if sub == "check-tpu" and store_dir is not None:
             # One parseable line with the recheck classification, so
             # shell pipelines and the CI smoke can gate on the mode
@@ -1347,6 +1407,15 @@ def example_main(spec: CliSpec, argv=None) -> int:
         from .obs.watch import watch_main
 
         return watch_main(args)
+
+    if sub == "timeline":
+        # Journal -> Chrome trace-event export (obs/timeline.py,
+        # docs/OBSERVABILITY.md "Timeline export and profiling"):
+        # merges run/serve/fleet journals onto one aligned timeline for
+        # Perfetto / chrome://tracing.  Model-agnostic like `report`.
+        from .obs.timeline import timeline_main
+
+        return timeline_main(args)
 
     if sub == "reshard":
         return _run_reshard(spec, args)

@@ -183,6 +183,19 @@ class FleetService:
         out["workers_alive"] = sum(
             1 for w in view.workers.values() if not w.get("stopped")
         )
+        # Fleet-wide histogram merge: per-worker vitals ship histogram
+        # SNAPSHOTS (wave latency, host spans, job spans) through the
+        # journal; bucket-wise addition folds them into one fleet view.
+        # Commutative, so the merged view cannot depend on worker
+        # enumeration order (pinned in tests/test_timeline.py).
+        from ..obs.metrics import merge_histogram_snapshots
+
+        merged = merge_histogram_snapshots(*(
+            (w.get("vitals") or {}).get("histograms") or {}
+            for w in view.workers.values()
+        ))
+        if merged:
+            out["histograms"] = merged
         return out
 
     def status(self) -> dict:

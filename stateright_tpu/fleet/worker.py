@@ -72,6 +72,14 @@ class FleetWorker:
         self.preemptions = 0
         self._started = time.time()
         self._stop = False
+        # Per-worker histogram accumulation (wave latency, host spans
+        # from finished checkers; job-run spans observed here): shipped
+        # as snapshots inside ``fleet_worker_vitals`` so the fleet
+        # ``/.metrics`` can merge them bucket-wise (fleet/service.py).
+        from ..obs.metrics import MetricsRegistry
+
+        self._span_metrics = MetricsRegistry()
+        self._hists: dict = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -107,13 +115,34 @@ class FleetWorker:
         return 0
 
     def _vitals(self) -> None:
-        self.store.worker_vitals({
+        from ..obs.metrics import merge_histogram_snapshots
+
+        vitals = {
             "jobs_done": self.jobs_done,
             "gang_dispatches": self.gang_dispatches,
             "preemptions": self.preemptions,
             "uptime_sec": round(time.time() - self._started, 1),
             "platform": self.desc["platform"],
-        })
+        }
+        hists = merge_histogram_snapshots(
+            self._hists, self._span_metrics.snapshot_histograms()
+        )
+        if hists:
+            vitals["histograms"] = hists
+        self.store.worker_vitals(vitals)
+
+    def _fold_checker_hists(self, checker) -> None:
+        """Accumulate a finished checker's histograms (wave latency,
+        ``host_*_sec`` spans) into this worker's published vitals —
+        bucket-wise, so the fleet-level merge stays exact."""
+        from ..obs.metrics import merge_histogram_snapshots
+
+        try:
+            hists = (checker.metrics() or {}).get("histograms") or {}
+        except Exception:
+            return
+        if hists:
+            self._hists = merge_histogram_snapshots(self._hists, hists)
 
     # -- one scheduling pass --------------------------------------------------
 
@@ -184,6 +213,7 @@ class FleetWorker:
     # -- gang dispatch --------------------------------------------------------
 
     def _run_gang(self, claimed: List[dict]) -> None:
+        from ..obs.metrics import LATENCY_BUCKETS
         from ..serve.workloads import build_model
 
         members = []
@@ -204,7 +234,8 @@ class FleetWorker:
             key=str(members[0]["cm"].gang_key()),
         )
         self.gang_dispatches += 1
-        beat = {"t": time.monotonic()}
+        t_gang = time.monotonic()
+        beat = {"t": t_gang}
 
         def on_wave(_wave, alive):
             now = time.monotonic()
@@ -236,6 +267,15 @@ class FleetWorker:
                 )
                 continue
             summary = checker_summary(checker)
+            self._fold_checker_hists(checker)
+            # Gang members share one device program, so each finished
+            # job is charged the gang's wall time — the same
+            # ``job_run_sec`` family the solo path observes, keeping
+            # fleet /.metrics histograms populated on gang-only runs.
+            self._span_metrics.observe(
+                "job_run_sec", time.monotonic() - t_gang,
+                boundaries=LATENCY_BUCKETS,
+            )
             summary["completed"] = True
             summary["engine"] = "tpu"
             summary["gang"] = {
@@ -257,6 +297,7 @@ class FleetWorker:
             drop_knobs, knob_key, load_knobs, store_knobs,
         )
 
+        t_job = time.monotonic()
         try:
             spec = JobSpec.from_dict(job["spec"])
         except ValueError as exc:
@@ -331,6 +372,13 @@ class FleetWorker:
             return
 
         summary = checker_summary(checker)
+        self._fold_checker_hists(checker)
+        from ..obs.metrics import LATENCY_BUCKETS
+
+        self._span_metrics.observe(
+            "job_run_sec", time.monotonic() - t_job,
+            boundaries=LATENCY_BUCKETS,
+        )
         summary["completed"] = True
         summary["engine"] = spec.engine
         summary["n"] = n
@@ -341,11 +389,18 @@ class FleetWorker:
                 and not hand_tuned and not job.get("resume")):
             knobs = final_geometry(checker)
             if knobs:
+                from ..obs.timeline import record_oneshot_span
+
+                t_kc = time.monotonic()
                 store_knobs(
                     self.knob_cache_dir, cache_key, knobs,
                     unique=summary["unique_state_count"],
                     depth=summary["max_depth"],
                     source=f"fleet:{job['id']}",
+                )
+                record_oneshot_span(
+                    self.store.journal, self._span_metrics, "knob_cache",
+                    time.monotonic() - t_kc, job=job["id"],
                 )
         self.store.finish(job, summary)
         self.jobs_done += 1

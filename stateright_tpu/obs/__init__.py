@@ -23,13 +23,27 @@ runtime journal, the Explorer's ``GET /.metrics`` endpoint, the CLI's
 - :mod:`.roofline` — the per-device-peak table and the bytes-touched
   model that reduce a wave's phase records into ``hbm_util_frac``
   (fraction of the device's peak HBM bandwidth the wave achieved).
+- :mod:`.timeline` — the unified timeline: host-tail span decomposition
+  of the fused loop's per-quantum host work (``host_span`` journal
+  events + per-phase histograms), the Chrome trace-event exporter that
+  folds run/serve/fleet journals — multi-worker fleets included — onto
+  one clock-aligned Perfetto view (``timeline export``), and the JAX
+  profiler hooks (``check-tpu --xprof-dir``).
 
 Schema and methodology: docs/OBSERVABILITY.md.
 """
 
-from .metrics import Histogram, MetricsRegistry
+from .metrics import Histogram, MetricsRegistry, merge_histogram_snapshots
 from .prometheus import parse_prometheus, render_prometheus
 from .report import analyze_journal, bench_trajectory, render_markdown
+from .timeline import (
+    SpanRecorder,
+    build_trace,
+    export_timeline,
+    host_share_of,
+    host_tail_sums,
+    validate_trace,
+)
 from .roofline import (
     DEVICE_PEAKS,
     hbm_util_frac,
@@ -43,14 +57,21 @@ __all__ = [
     "DEVICE_PEAKS",
     "Histogram",
     "MetricsRegistry",
+    "SpanRecorder",
     "WaveTracer",
     "analyze_journal",
     "bench_trajectory",
+    "build_trace",
+    "export_timeline",
     "hbm_util_frac",
+    "host_share_of",
+    "host_tail_sums",
+    "merge_histogram_snapshots",
     "parse_prometheus",
     "peaks_for_device",
     "probe_bytes",
     "render_markdown",
     "render_prometheus",
     "sort_bytes",
+    "validate_trace",
 ]

@@ -127,6 +127,61 @@ class Histogram:
             "p99": round(self.quantile(0.99), 6),
         }
 
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "Histogram":
+        """Rehydrate a histogram from its :meth:`snapshot` dict — the
+        fleet metrics merge (fleet/service.py) folds per-worker
+        snapshots shipped through journal ``fleet_worker_vitals``
+        events back into live histograms this way."""
+        h = cls(snap["boundaries"])
+        counts = list(snap.get("counts") or ())
+        if len(counts) != len(h.counts):
+            raise ValueError(
+                "snapshot counts do not match boundaries "
+                f"({len(counts)} buckets for {len(h.counts)} expected)"
+            )
+        h.counts = [int(c) for c in counts]
+        h.sum = float(snap.get("sum", 0.0))
+        h.count = int(snap.get("count", 0))
+        return h
+
+    def merge(self, snap: dict) -> None:
+        """Bucket-wise addition of another histogram's snapshot.  Only
+        identical boundary ladders merge — the ladders are module
+        constants shared by every writer, so a mismatch means two
+        incompatible schema versions, surfaced loudly rather than
+        silently misbinned."""
+        if tuple(float(b) for b in snap["boundaries"]) != self.boundaries:
+            raise ValueError("histogram boundary ladders differ")
+        counts = list(snap.get("counts") or ())
+        if len(counts) != len(self.counts):
+            raise ValueError("histogram bucket counts differ")
+        for i, c in enumerate(counts):
+            self.counts[i] += int(c)
+        self.sum += float(snap.get("sum", 0.0))
+        self.count += int(snap.get("count", 0))
+
+
+def merge_histogram_snapshots(*snaps: Dict[str, dict]) -> Dict[str, dict]:
+    """Merge several ``{name: histogram-snapshot}`` maps bucket-wise
+    into one (quantiles recomputed from the summed buckets).
+    Commutative and associative by construction — bucket addition is —
+    which the fleet ``/.metrics`` merge relies on: the merged view must
+    not depend on worker enumeration order (pinned in
+    tests/test_timeline.py)."""
+    merged: Dict[str, Histogram] = {}
+    for snap_map in snaps:
+        for name in sorted(snap_map or {}):
+            snap = snap_map[name]
+            if not isinstance(snap, dict) or "boundaries" not in snap:
+                continue
+            h = merged.get(name)
+            if h is None:
+                merged[name] = Histogram.from_snapshot(snap)
+            else:
+                h.merge(snap)
+    return {n: h.snapshot() for n, h in merged.items()}
+
 
 class MetricsRegistry:
     """Flat name -> value store with counter and gauge semantics.

@@ -113,6 +113,40 @@ def summarize_events(events: List[dict], skipped: int = 0) -> dict:
             out["bottleneck"] = (
                 "device" if device >= max(0.0, wall - device) else "host"
             )
+        # Host share: per-quantum host tail / (tail + device call), EMA
+        # over the trailing quanta — the ROADMAP #2 regression gauge.
+        # The ``host_span`` events (obs/timeline.py) pair each quantum's
+        # measured tail with the preceding wave's call_sec; traced
+        # journals without them fall back to the wave_breakdown's
+        # host-classed phases.
+        from .timeline import SPAN_EVENT
+
+        ratios: List[float] = []
+        last_call: Optional[float] = None
+        for e in events:
+            ev = e.get("event")
+            if ev == "wave":
+                c = e.get("call_sec")
+                last_call = float(c) if isinstance(c, (int, float)) else None
+            elif (ev == SPAN_EVENT and e.get("scope") != "run"
+                    and last_call):
+                h = e.get("host_sec")
+                if isinstance(h, (int, float)) and h >= 0:
+                    ratios.append(h / (h + last_call))
+        if not ratios and phases:
+            host = sum(v for k, v in phases.items() if k in HOST_PHASES)
+            total = sum(phases.values())
+            if total > 0:
+                ratios.append(host / total)
+        hs_ema: Optional[float] = None
+        for r in ratios[-_EMA_TAIL:]:
+            hs_ema = (
+                r if hs_ema is None else hs_ema + EMA_ALPHA * (r - hs_ema)
+            )
+        if hs_ema is not None:
+            out["host_share"] = round(hs_ema, 4)
+            if hs_ema > 0.5:
+                out["warnings"].append(f"host-share={round(hs_ema, 2)}")
 
     # Actor/chaos journals (runtime/chaos.py, actor/obs.py): the
     # periodic ``actor_stats`` stream gives a msgs/s EMA + retransmit
@@ -362,8 +396,9 @@ def _fmt(v, digits: int = 4) -> str:
 
 def render_line(s: dict) -> str:
     """The one-line progress view.  Field names are part of the
-    greppable surface (docs/OBSERVABILITY.md "watch"): ``density=`` and
-    ``bottleneck=`` always appear on run journals (— when unknown)."""
+    greppable surface (docs/OBSERVABILITY.md "watch"): ``density=``,
+    ``bottleneck=``, and ``host_share=`` always appear on run journals
+    (— when unknown)."""
     parts = []
     if "t" in s:
         parts.append(f"t+{s['t']}s")
@@ -401,6 +436,7 @@ def render_line(s: dict) -> str:
         if "dedup" in s:
             parts.append(f"dedup={s['dedup']}")
         parts.append(f"bottleneck={_fmt(s.get('bottleneck'))}")
+        parts.append(f"host_share={_fmt(s.get('host_share'))}")
         if "waves" in s:
             parts.append(f"waves={s['waves']}")
         if s.get("grows"):

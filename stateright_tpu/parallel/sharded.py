@@ -1523,6 +1523,13 @@ class ShardedTpuChecker(Checker):
             )
             t0 = _time.perf_counter()
             disc_before = disc  # restored on a retryable-overflow re-run
+            # xprof hook (obs/timeline.py): under --xprof-dir the wave's
+            # device phases land in a StepTraceAnnotation so hardware
+            # profiles align with journal wave events; nullcontext
+            # otherwise.
+            from ..obs.timeline import step_annotation
+            _step_ann = step_annotation(waves)
+            _step_ann.__enter__()
             (
                 disc, rows_v, gid_v, eb_v, v_act, local_ovf_d, gen_d,
                 stepflag_d,
@@ -1562,6 +1569,7 @@ class ShardedTpuChecker(Checker):
             if ovf_d is not None and bool(np.asarray(ovf_d).any()):
                 retry_flags |= 32
             if retry_flags:
+                _step_ann.__exit__(None, None, None)
                 if self._grow_knobs(retry_flags) is None:
                     raise RuntimeError(
                         self._wl_overflow_message(retry_flags)
@@ -1587,6 +1595,7 @@ class ShardedTpuChecker(Checker):
                 r_new, r_origin, tailctrl,
             )
             jax.block_until_ready(queue)
+            _step_ann.__exit__(None, None, None)
             t6 = _time.perf_counter()
             # Host readback: the per-wave scalar sync.
             n_new = np.asarray(n_new_d).astype(np.int64)

@@ -659,6 +659,8 @@ class FusedWaveLoop:
         self.eng = eng
 
     def run(self, carry, deadline=None):
+        from ..obs.timeline import SpanRecorder
+
         eng = self.eng
         cadence = CheckpointCadence(eng._ckpt_every_waves, eng._ckpt_every_sec)
         vitals = LoopVitals(
@@ -666,16 +668,29 @@ class FusedWaveLoop:
             initial_unique=getattr(eng, "_unique_count", None),
             initial_states=getattr(eng, "_state_count", None),
         )
+        # Host-tail span decomposition (obs/timeline.py): every named
+        # section of the between-calls tail below runs under
+        # ``spans.span(...)`` — two extra ``time.monotonic()`` calls per
+        # section, no device traffic, so the trace=False fused program
+        # stays byte-for-byte pinned.  The recorder flushes ONE
+        # ``host_span`` journal event per quantum at the same boundary
+        # ``vitals.call_started`` accounts into ``host_sec_total``.
+        spans = SpanRecorder(eng._journal, eng._metrics)
         journal_geometry(eng)
         waves_total = 0
         while True:
+            spans.quantum_start(time.monotonic())
             t_call = time.monotonic()
             vitals.call_started(t_call)
-            carry = eng._wl_call(carry)
-            view = eng._wl_view(carry)
+            with spans.step():
+                carry = eng._wl_call(carry)
+            with spans.span("readback"):
+                view = eng._wl_view(carry)
+            spans.collect(eng)
             t_done = time.monotonic()
             call_sec = t_done - t_call
             vitals.call_ended(t_done)
+            spans.tail_start(t_done)
             cand_lanes = getattr(eng, "_wl_cand_lanes", None)
             vitals.record_quantum(
                 call_sec, view.waves_this_call, view.unique,
@@ -692,22 +707,24 @@ class FusedWaveLoop:
                 for name, ident in view.discoveries:
                     eng._wl_set_discovery(name, ident)
             if eng._journal:
-                eng._journal.append(
-                    "wave",
-                    waves=waves_total,
-                    remaining=view.remaining,
-                    unique=view.unique,
-                    states=view.states,
-                    depth=view.depth,
-                    flags=view.flags,
-                    call_sec=round(call_sec, 4),
-                    occupancy=round(view.occupancy, 6),
-                    **(
-                        {"density": round(vitals.last_density, 6)}
-                        if vitals.last_density is not None else {}
-                    ),
-                    **view.extra,
-                )
+                with spans.span("journal"):
+                    eng._journal.append(
+                        "wave",
+                        waves=waves_total,
+                        remaining=view.remaining,
+                        unique=view.unique,
+                        states=view.states,
+                        depth=view.depth,
+                        flags=view.flags,
+                        call_sec=round(call_sec, 4),
+                        mono=round(t_call, 6),
+                        occupancy=round(view.occupancy, 6),
+                        **(
+                            {"density": round(vitals.last_density, 6)}
+                            if vitals.last_density is not None else {}
+                        ),
+                        **view.extra,
+                    )
             eng._metrics.update(
                 waves=waves_total,
                 table_occupancy=round(view.occupancy, 6),
@@ -721,33 +738,36 @@ class FusedWaveLoop:
                 # the post-spill tier state in the same pass.
                 after_commit = getattr(eng, "_wl_after_commit", None)
                 if after_commit is not None:
-                    carry = after_commit(carry, view) or carry
+                    with spans.span("spill"):
+                        carry = after_commit(carry, view) or carry
                 # Density-driven sort-rung downshift and frontier-driven
                 # step-rung downshift (engines with the hooks only): the
                 # carry is rung-independent — only the per-wave scratch
                 # buffers reshape — so a retune is a program swap
                 # between calls, never a migration.
-                maybe_retune_sort(eng, vitals.last_density)
-                # remaining == 0 means the run is about to break — a
-                # downshift there would recompile for zero waves.
-                maybe_retune_step(eng, view.remaining or None)
+                with spans.span("retune"):
+                    maybe_retune_sort(eng, vitals.last_density)
+                    # remaining == 0 means the run is about to break — a
+                    # downshift there would recompile for zero waves.
+                    maybe_retune_step(eng, view.remaining or None)
             if (
                 eng._checkpoint_path is not None
                 and view.flags == 0
                 and cadence.due(view.waves_this_call)
             ):
-                t_ck = time.monotonic()
-                ck_extra = eng._wl_write_checkpoint(carry) or {}
-                cadence.mark()
-                if eng._journal:
-                    eng._journal.append(
-                        "checkpoint",
-                        path=eng._checkpoint_path,
-                        unique=view.unique,
-                        depth=view.depth,
-                        write_sec=round(time.monotonic() - t_ck, 4),
-                        **ck_extra,
-                    )
+                with spans.span("checkpoint"):
+                    t_ck = time.monotonic()
+                    ck_extra = eng._wl_write_checkpoint(carry) or {}
+                    cadence.mark()
+                    if eng._journal:
+                        eng._journal.append(
+                            "checkpoint",
+                            path=eng._checkpoint_path,
+                            unique=view.unique,
+                            depth=view.depth,
+                            write_sec=round(time.monotonic() - t_ck, 4),
+                            **ck_extra,
+                        )
             if view.flags:
                 fatal = view.flags & ~eng._wl_retryable_flags()
                 if fatal:
@@ -770,7 +790,8 @@ class FusedWaveLoop:
                     if cleanup is not None:
                         carry = cleanup(carry) or carry
                     break
-                grown = eng._wl_grow(view.flags, carry)
+                with spans.span("grow"):
+                    grown = eng._wl_grow(view.flags, carry)
                 if grown is None:
                     raise RuntimeError(eng._wl_overflow_message(view.flags))
                 vitals.record_overflow_recovery()
@@ -778,6 +799,11 @@ class FusedWaveLoop:
                 continue
             if loop_should_break(eng, view.remaining, view.depth, deadline):
                 break
+        # The final quantum's tail has no next call to account it into
+        # ``host_sec_total`` via the between-calls gap — measure it here
+        # (before the flush write) and fold it in directly, so the
+        # journaled decomposition and the counter stay reconciled.
+        vitals.record_host(spans.finish(time.monotonic()))
         return carry, waves_total
 
 

@@ -1816,23 +1816,30 @@ class TpuChecker(Checker):
                 count = min(level_end - level_start, f_eff)
                 t0 = _time.perf_counter()
                 disc_prev = disc  # t_step does not donate it
-                (
-                    disc, eb, states, cand_rows, cand_src, cand_act,
-                    n_valid_d, v_ovf_d, gen_d, stepflag_d,
-                ) = progs["step"](
-                    rows, ebits, disc_prev,
-                    jnp.uint32(level_start), jnp.uint32(level_end),
-                )
-                jax.block_until_ready(cand_rows)
-                t1 = _time.perf_counter()
-                hi, lo = progs["fp"](cand_rows)
-                jax.block_until_ready(lo)
-                t2 = _time.perf_counter()
-                (
-                    key_hi, key_lo, u_new, u_origin, n_new_d, probe_ok_d,
-                    dd_ovf_d, rounds_d,
-                ) = progs["insert"](key_hi, key_lo, hi, lo, cand_act)
-                jax.block_until_ready(key_lo)
+                # xprof hook (obs/timeline.py): under --xprof-dir each
+                # traced wave's device phases land in a
+                # StepTraceAnnotation so the hardware profile's steps
+                # line up with the journal's wave events; a nullcontext
+                # otherwise.
+                from ..obs.timeline import step_annotation
+                with step_annotation(wave_idx):
+                    (
+                        disc, eb, states, cand_rows, cand_src, cand_act,
+                        n_valid_d, v_ovf_d, gen_d, stepflag_d,
+                    ) = progs["step"](
+                        rows, ebits, disc_prev,
+                        jnp.uint32(level_start), jnp.uint32(level_end),
+                    )
+                    jax.block_until_ready(cand_rows)
+                    t1 = _time.perf_counter()
+                    hi, lo = progs["fp"](cand_rows)
+                    jax.block_until_ready(lo)
+                    t2 = _time.perf_counter()
+                    (
+                        key_hi, key_lo, u_new, u_origin, n_new_d,
+                        probe_ok_d, dd_ovf_d, rounds_d,
+                    ) = progs["insert"](key_hi, key_lo, hi, lo, cand_act)
+                    jax.block_until_ready(key_lo)
                 t3 = _time.perf_counter()
                 # Host readback: the per-wave scalar sync, plus the chunk
                 # states when a visitor is attached (the device visitor

@@ -10,6 +10,15 @@ per-call deadline is declared hung and restarted from the last checkpoint.
 
 Event schema (full field lists in docs/RUNTIME.md): every event carries
 ``t`` (unix wall time, float seconds) and ``event`` (a string tag).
+Every segment opens with a ``clock_sync`` header — a paired wall +
+monotonic timestamp plus the writer's ``pid@host`` stamp — because the
+other events mix ``time.time()`` stamps with ``time.monotonic()``
+durations: the pair anchors each process's monotonic clock to wall
+time once, so the timeline exporter (obs/timeline.py) can fold
+multi-process fleet journals onto one aligned axis even on hosts whose
+wall clocks step mid-run.  Readers never see it unless they ask
+(``read_journal(path, include_sync=True)`` or
+:func:`read_clock_syncs`).
 Engine events: ``resume``, ``wave``, ``checkpoint``, ``grow``,
 ``geometry`` (the run's live knobs, once per loop start), ``compile``
 (program-cache misses with first-call timing + key provenance,
@@ -47,9 +56,15 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import threading
 import time
 from typing import Dict, List, Optional
+
+# The once-per-segment header pairing wall and monotonic clocks (plus
+# the writer's pid@host stamp) — the alignment anchor obs/timeline.py
+# uses to merge multi-process journals onto one wall-clock axis.
+CLOCK_SYNC_EVENT = "clock_sync"
 
 
 class Journal:
@@ -101,6 +116,22 @@ class Journal:
         self.fsync = bool(fsync)
         self._fd: Optional[int] = None
         self._lock = threading.Lock()
+        self._synced = False  # this instance has stamped a clock_sync
+
+    def _sync_line(self) -> bytes:
+        """One encoded ``clock_sync`` header line: the wall/monotonic
+        pair is read back-to-back so the offset between the two clocks
+        is captured to within a few microseconds."""
+        host = socket.gethostname()
+        rec = {
+            "t": time.time(),
+            "event": CLOCK_SYNC_EVENT,
+            "mono": time.monotonic(),
+            "pid": os.getpid(),
+            "host": host,
+            "worker": f"{os.getpid()}@{host}",
+        }
+        return (json.dumps(rec, sort_keys=True) + "\n").encode("utf-8")
 
     def _rollover(self) -> None:
         """Shift segments up and move the live file to ``.1`` (caller
@@ -130,14 +161,24 @@ class Journal:
                 self._fd = os.open(
                     self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
                 )
+            if event == CLOCK_SYNC_EVENT:
+                self._synced = True  # the caller IS the header
+            sync = b"" if self._synced else self._sync_line()
             if self.max_bytes is not None:
                 size = os.fstat(self._fd).st_size
-                if size > 0 and size + len(line) > self.max_bytes:
+                if size > 0 and size + len(sync) + len(line) > self.max_bytes:
                     self._rollover()
                     self._fd = os.open(
                         self.path,
                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644,
                     )
+                    # Every fresh segment re-anchors the clocks, so a
+                    # reader holding any single segment can align it.
+                    if event != CLOCK_SYNC_EVENT:
+                        sync = self._sync_line()
+            if sync:
+                os.write(self._fd, sync)
+                self._synced = True
             os.write(self._fd, line)
             if self.fsync:
                 os.fsync(self._fd)
@@ -175,15 +216,28 @@ def _segment_paths(path: str) -> List[str]:
     return segs
 
 
-def read_journal(path: str) -> List[Dict]:
+def read_journal(path: str, include_sync: bool = False) -> List[Dict]:
     """Parse a journal file into a list of event dicts, merging rotated
     segments (oldest first) when present.  Tolerates a torn trailing
     line (a writer killed mid-``write``); see
-    :func:`read_journal_stats` for the skip count."""
-    return read_journal_stats(path)[0]
+    :func:`read_journal_stats` for the skip count.
+
+    ``clock_sync`` headers are filtered out by default — they are
+    per-segment clock plumbing, not run telemetry, and every existing
+    consumer indexes events positionally (``events[0]``) or asserts
+    exact event sequences.  Pass ``include_sync=True`` (or use
+    :func:`read_clock_syncs`) to see them."""
+    return read_journal_stats(path, include_sync=include_sync)[0]
 
 
-def read_journal_stats(path: str):
+def read_clock_syncs(path: str) -> List[Dict]:
+    """Just the ``clock_sync`` headers of a journal, oldest first — one
+    wall/monotonic anchor per (writer instance x segment)."""
+    events, _ = read_journal_stats(path, include_sync=True)
+    return [e for e in events if e.get("event") == CLOCK_SYNC_EVENT]
+
+
+def read_journal_stats(path: str, include_sync: bool = False):
     """Like :func:`read_journal`, but also returns how many lines were
     SKIPPED as torn/garbled (undecodable JSON, or a truncation that
     still parses but is not an event object — ``{"t": 17`` torn after
@@ -219,6 +273,9 @@ def read_journal_stats(path: str):
                         if not isinstance(rec, dict):
                             skipped += 1  # truncation that still parses
                             continue
+                        if (not include_sync
+                                and rec.get("event") == CLOCK_SYNC_EVENT):
+                            continue  # per-segment clock plumbing
                         events.append(rec)
             except FileNotFoundError:
                 continue  # racing a rollover; the re-check below catches it

@@ -547,11 +547,16 @@ class Scheduler:
             # phase measures.
             knobs = self._final_geometry(checker)
             if knobs:
+                t_kc = time.monotonic()
                 store_knobs(
                     self.knob_cache_dir, cache_key, knobs,
                     unique=summary["unique_state_count"],
                     depth=summary["max_depth"], source=f"serve:{job.id}",
                 )
+                # The knob-cache write is part of the job's host tail:
+                # journaled like every other lifecycle span so the
+                # timeline exporter can place it.
+                self._span(job, "knob_cache", time.monotonic() - t_kc)
         return summary
 
     # -- verification-store jobs (incr/, docs/INCREMENTAL.md) -----------------
@@ -646,12 +651,14 @@ class Scheduler:
         ):
             knobs = self._final_geometry(job.checker)
             if knobs:
+                t_kc = time.monotonic()
                 store_knobs(
                     self.knob_cache_dir, cache_key, knobs,
                     unique=summary["unique_state_count"],
                     depth=summary["max_depth"],
                     source=f"serve:{job.id}:store",
                 )
+                self._span(job, "knob_cache", time.monotonic() - t_kc)
         summary["engine"] = spec.engine
         summary["n"] = n
         summary["knob_cache_hit"] = cache_hit
@@ -802,9 +809,11 @@ class Scheduler:
             label += ":portfolio-winner"
             knobs = member.engine_kwargs or {"seed": member.seed}
         key = knob_key(label, engine=self._knob_engine_tag(member.engine))
+        t_kc = time.monotonic()
         store_knobs(
             self.knob_cache_dir, key, knobs,
             portfolio_winner=True, member=member.index,
             member_engine=member.engine, job=job.id,
             violation=entries[winner_idx].get("violation"),
         )
+        self._span(job, "knob_cache", time.monotonic() - t_kc)

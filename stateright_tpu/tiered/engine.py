@@ -178,6 +178,7 @@ class TieredTpuChecker(TpuChecker):
         self._t_disc = None  # device uint32[P] discovery slots
         self._t_disc_h = None
         self._t_cold_last = None  # last wave's cold-probe accounting
+        self._t_host_spans = []  # in-call host spans for _wl_host_spans
         super().__init__(options, **kwargs)
 
     # --- budget enforcement ---------------------------------------------------
@@ -410,8 +411,15 @@ class TieredTpuChecker(TpuChecker):
         cold = None
         fresh, n_fresh = u_new, n_new_hot
         if flags == 0 and n_new_hot and self._cold.run_count:
+            t_cp = time.monotonic()
             fresh, n_fresh, cold = self._cold_filter(
                 hi, lo, u_new, u_origin, n_new_hot
+            )
+            # Host-side cold windowing inside the call window: handed to
+            # the shared loop's SpanRecorder via _wl_host_spans so the
+            # timeline decomposes it without a second timer pass.
+            self._t_host_spans.append(
+                ("cold_probe", t_cp, time.monotonic() - t_cp)
             )
         if trace:
             t.append(time.perf_counter())
@@ -512,6 +520,17 @@ class TieredTpuChecker(TpuChecker):
             discoveries=tuple(disc),
             extra=extra,
         )
+
+    def _wl_host_spans(self):
+        """Fused-loop hook (obs/timeline.py ``SpanRecorder.collect``):
+        drain the in-call host spans ``_wl_call`` measured itself —
+        the cold-run windowing (``cold_probe``), which runs on the host
+        INSIDE the device-call window and would otherwise vanish into
+        the opaque ``call_sec``."""
+        spans = self._t_host_spans
+        if spans:
+            self._t_host_spans = []
+        return spans
 
     # --- spill / recovery -----------------------------------------------------
 

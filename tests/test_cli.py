@@ -424,8 +424,9 @@ def test_spawn_chaos_audit_end_to_end(tmp_path):
     verdict = json.loads(r.stdout.strip().splitlines()[-1])
     assert verdict["consistent"] is True
     assert verdict["returned"] >= 1
-    events = [json.loads(ln) for ln in open(journal) if ln.strip()]
-    kinds = [e["event"] for e in events]
+    from stateright_tpu.runtime.journal import read_journal
+
+    kinds = [e["event"] for e in read_journal(journal)]
     assert kinds[0] == "chaos_start"
     assert "audit" in kinds
     assert any(k.startswith("chaos_") for k in kinds[1:])

@@ -545,12 +545,19 @@ def test_service_journal_lines_never_tear_under_concurrent_writers(tmp_path):
         t.join()
     with open(path, encoding="utf-8") as fh:
         lines = fh.read().splitlines()
-    assert len(lines) == writers * per
     seen = set()
+    syncs = 0
     for line in lines:
         rec = json.loads(line)  # raises on any torn/interleaved line
+        if rec["event"] == "clock_sync":
+            syncs += 1
+            continue
         seen.add((rec["writer"], rec["i"]))
     assert len(seen) == writers * per
+    # Each Journal instance contributes exactly one clock_sync header
+    # (its monotonic anchor), itself a whole line like any other.
+    assert syncs == writers
+    assert len(lines) == writers * per + syncs
 
 
 def test_running_job_snapshot_carries_live_vitals():

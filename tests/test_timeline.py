@@ -1,0 +1,439 @@
+"""Unified timeline (obs/timeline.py): host-tail span decomposition,
+clock_sync journal headers, the Chrome trace exporter, and the
+surfaces that ride on them (watch/report host_share, fleet histogram
+merge).
+
+The contracts that matter (ISSUE 19 acceptance):
+
+- the SpanRecorder's per-quantum ``host_span`` records decompose
+  ``host_sec_total`` into named parts — on a real fused run their sum
+  reconciles within 10% of the counter;
+- trace=False stays zero-new-readback (the existing test_obs wave-event
+  pin covers the device program; here we pin that span events are
+  host-side journal lines only);
+- ``timeline export`` emits valid Chrome trace JSON (well-nested X
+  slices, resolving flows) and multi-journal merges are deterministic;
+- the fleet ``/.metrics`` histogram merge is commutative.
+"""
+
+import json
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twophase import TwoPhaseSys  # noqa: E402
+from stateright_tpu.obs.metrics import (  # noqa: E402
+    LATENCY_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    merge_histogram_snapshots,
+)
+from stateright_tpu.obs.timeline import (  # noqa: E402
+    SPAN_EVENT,
+    SpanRecorder,
+    build_trace,
+    export_timeline,
+    host_share_of,
+    host_tail_sums,
+    record_oneshot_span,
+    timeline_main,
+    validate_trace,
+)
+from stateright_tpu.runtime.journal import (  # noqa: E402
+    CLOCK_SYNC_EVENT,
+    Journal,
+    read_clock_syncs,
+    read_journal,
+)
+
+
+def _cpu():
+    return jax.devices("cpu")[0]
+
+
+class _ListJournal:
+    """Journal stand-in capturing appended records in-memory."""
+
+    def __init__(self):
+        self.records = []
+
+    def append(self, event, **fields):
+        rec = {"t": 0.0, "event": event, **fields}
+        self.records.append(rec)
+        return rec
+
+
+# --- SpanRecorder unit --------------------------------------------------------
+
+
+def test_span_recorder_decomposes_tail():
+    journal = _ListJournal()
+    metrics = MetricsRegistry()
+    rec = SpanRecorder(journal, metrics, worker="1@test")
+
+    # Quantum 1: a tail with two named sections (real monotonic marks —
+    # the recorder's span timestamps come from the same clock).
+    with rec.step():
+        pass
+    rec.tail_start(time.monotonic())
+    with rec.span("journal"):
+        time.sleep(0.01)
+    with rec.span("checkpoint"):
+        time.sleep(0.01)
+    # Quantum 2 opens: the previous tail flushes against this mark.
+    rec.quantum_start(time.monotonic())
+    assert len(journal.records) == 1
+    ev = journal.records[0]
+    assert ev["event"] == SPAN_EVENT
+    assert ev["worker"] == "1@test"
+    assert ev["quantum"] == 1
+    spans = ev["spans"]
+    # Named sections plus the residual: durations sum to the tail.
+    assert set(spans) >= {"journal", "checkpoint", "other"}
+    assert spans["journal"][1] >= 0.01
+    assert sum(d for _rel, d in spans.values()) == pytest.approx(
+        ev["host_sec"], rel=1e-2
+    )
+    # Per-phase histograms observed under the shared latency ladder.
+    hists = metrics.snapshot_histograms()
+    assert "host_journal_sec" in hists
+    assert "host_other_sec" in hists
+    assert hists["host_journal_sec"]["boundaries"] == list(LATENCY_BUCKETS)
+
+    # Quantum 2: the flush write's own cost surfaces as a ``flush``
+    # span in THIS record (negative rel — before this tail started).
+    with rec.step():
+        pass
+    rec.tail_start(time.monotonic())
+    time.sleep(0.005)
+    tail2 = rec.finish(time.monotonic())
+    assert tail2 >= 0.005
+    ev2 = journal.records[1]
+    assert ev2["quantum"] == 2
+    assert "flush" in ev2["spans"]
+    assert ev2["spans"]["flush"][0] < 0  # positioned at its true time
+    # host_tail_sums reconciles the journal against the two tails
+    # (the flush span rides along but measures real host work).
+    sums = host_tail_sums(journal.records)
+    assert sum(sums.values()) >= 0.025
+
+
+def test_oneshot_span_excluded_from_tail_reconciliation():
+    journal = _ListJournal()
+    metrics = MetricsRegistry()
+    record_oneshot_span(journal, metrics, "knob_cache", 0.125, job="j1")
+    ev = journal.records[0]
+    assert ev["event"] == SPAN_EVENT
+    assert ev["scope"] == "run"
+    assert ev["job"] == "j1"
+    assert host_tail_sums(journal.records) == {}
+    assert "host_knob_cache_sec" in metrics.snapshot_histograms()
+
+
+def test_host_share_of():
+    assert host_share_of(
+        {"host_sec_total": 1.0, "device_call_sec_total": 3.0}
+    ) == pytest.approx(0.25)
+    assert host_share_of({"host_sec_total": 1.0}) is None
+    assert host_share_of({}) is None
+
+
+# --- runtime reconciliation ---------------------------------------------------
+
+
+def test_fused_run_spans_reconcile_with_host_counter(tmp_path):
+    """A real fused CPU run: the journal's host_span decomposition sums
+    to within 10% of the engine's ``host_sec_total`` counter, and the
+    run exports as a valid Chrome trace."""
+    journal = str(tmp_path / "journal.jsonl")
+    ck = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu(
+            capacity=1 << 14, max_frontier=1 << 9, device=_cpu(),
+            journal=journal,
+        )
+        .join()
+    )
+    assert ck.unique_state_count() == 288
+    m = ck.metrics()
+    events = read_journal(journal)
+    span_events = [
+        e for e in events
+        if e["event"] == SPAN_EVENT and e.get("scope") != "run"
+    ]
+    assert span_events, "fused loop must journal host_span records"
+    sums = host_tail_sums(events)
+    total = sum(sums.values())
+    host_counter = m["host_sec_total"]
+    assert host_counter > 0
+    assert total == pytest.approx(host_counter, rel=0.10)
+    # Per-phase histograms ride the same metrics snapshot.
+    hists = m.get("histograms") or {}
+    assert any(n.startswith("host_") for n in hists)
+
+    trace = export_timeline(journal)
+    assert validate_trace(trace) == []
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "wave" in names and "host" in names
+
+
+# --- clock_sync headers -------------------------------------------------------
+
+
+def test_clock_sync_header_written_once_and_filtered(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with Journal(path) as j:
+        j.append("a")
+        j.append("b")
+    events = read_journal(path)
+    assert [e["event"] for e in events] == ["a", "b"]  # filtered
+    syncs = read_clock_syncs(path)
+    assert len(syncs) == 1
+    s = syncs[0]
+    assert s["event"] == CLOCK_SYNC_EVENT
+    assert isinstance(s["mono"], float) and isinstance(s["t"], float)
+    assert s["worker"] == f"{s['pid']}@{s['host']}"
+    # The header precedes the first event in the raw stream.
+    raw = read_journal(path, include_sync=True)
+    assert raw[0]["event"] == CLOCK_SYNC_EVENT
+
+
+def test_clock_sync_reanchors_each_segment(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with Journal(path, max_bytes=256, max_segments=64) as j:
+        for i in range(40):
+            j.append("tick", i=i, pad="x" * 40)
+    events = read_journal(path)
+    assert [e["i"] for e in events] == list(range(40))  # nothing lost
+    syncs = read_clock_syncs(path)
+    assert len(syncs) >= 2  # every fresh segment re-anchors
+
+
+# --- the exporter -------------------------------------------------------------
+
+
+def _write_journal(path, events):
+    with open(path, "w", encoding="utf-8") as fh:
+        for e in events:
+            fh.write(json.dumps(e, sort_keys=True) + "\n")
+    return str(path)
+
+
+def _worker_events(worker, t0, job):
+    pid, host = worker.split("@")
+    return [
+        {"t": t0, "event": CLOCK_SYNC_EVENT, "mono": 1000.0,
+         "pid": int(pid), "host": host, "worker": worker},
+        {"t": t0 + 0.1, "event": "fleet_submitted", "job": job},
+        {"t": t0 + 0.2, "event": "fleet_claimed", "job": job,
+         "worker": worker},
+        {"t": t0 + 1.2, "event": "wave", "worker": worker,
+         "mono": 1000.2, "call_sec": 1.0, "waves": 8, "unique": 100},
+        {"t": t0 + 1.3, "event": SPAN_EVENT, "worker": worker,
+         "mono": 1001.2, "quantum": 1, "host_sec": 0.1,
+         "spans": {"journal": [0.01, 0.02], "other": [0.03, 0.07]}},
+        {"t": t0 + 1.4, "event": "job_span", "job": job, "span": "run",
+         "sec": 1.1, "worker": worker},
+        {"t": t0 + 1.5, "event": "fleet_done", "job": job,
+         "worker": worker},
+    ]
+
+
+def test_export_two_worker_merge_valid_and_deterministic(tmp_path):
+    a = _write_journal(
+        tmp_path / "a.jsonl", _worker_events("100@hosta", 50.0, "job-a")
+    )
+    b = _write_journal(
+        tmp_path / "b.jsonl", _worker_events("200@hostb", 50.05, "job-b")
+    )
+    ab = export_timeline([a, b])
+    ba = export_timeline([b, a])
+    assert json.dumps(ab, sort_keys=True) == json.dumps(ba, sort_keys=True)
+    assert validate_trace(ab) == []
+    evs = ab["traceEvents"]
+    # One process track per worker, named by its pid@host stamp.
+    procs = {
+        e["args"]["name"] for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert procs == {"100@hosta", "200@hostb"}
+    # Flow arrows: each job's lifecycle starts and finishes.
+    flow_phases = {}
+    for e in evs:
+        if e.get("ph") in ("s", "t", "f"):
+            flow_phases.setdefault(e["id"], set()).add(e["ph"])
+    assert len(flow_phases) == 2
+    for phases in flow_phases.values():
+        assert {"s", "f"} <= phases
+    # host_span children nest inside their host slice per track.
+    assert any(e.get("name") == "journal" for e in evs)
+
+
+def test_exported_trace_is_loadable_json(tmp_path):
+    a = _write_journal(
+        tmp_path / "a.jsonl", _worker_events("100@hosta", 50.0, "j")
+    )
+    out = str(tmp_path / "out.trace.json")
+    export_timeline([a], out=out)
+    with open(out, "r", encoding="utf-8") as fh:
+        loaded = json.load(fh)
+    assert loaded["displayTimeUnit"] == "ms"
+    assert validate_trace(loaded) == []
+
+
+def test_validate_trace_catches_structural_breaks():
+    # Overlapping, non-nesting X slices on one track.
+    bad = {"traceEvents": [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "a", "ts": 0, "dur": 10},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "b", "ts": 5, "dur": 10},
+    ]}
+    assert any("overlaps" in p for p in validate_trace(bad))
+    # A started flow that never finishes, bound to no slice.
+    bad = {"traceEvents": [
+        {"ph": "s", "pid": 1, "tid": 1, "id": 7, "ts": 0, "name": "j"},
+    ]}
+    problems = validate_trace(bad)
+    assert any("never finishes" in p for p in problems)
+    assert any("binds to no slice" in p for p in problems)
+    # Unbalanced B/E.
+    bad = {"traceEvents": [
+        {"ph": "B", "pid": 1, "tid": 1, "name": "x", "ts": 0},
+    ]}
+    assert any("unclosed B" in p for p in validate_trace(bad))
+    assert validate_trace({"traceEvents": []}) == []
+
+
+def test_timeline_cli_verb(tmp_path, capsys):
+    a = _write_journal(
+        tmp_path / "journal.jsonl", _worker_events("100@hosta", 50.0, "j")
+    )
+    out = str(tmp_path / "t.trace.json")
+    rc = timeline_main(["export", a, "--out", out])
+    assert rc == 0
+    assert "valid=yes" in capsys.readouterr().out
+    with open(out, "r", encoding="utf-8") as fh:
+        assert validate_trace(json.load(fh)) == []
+
+
+# --- fleet histogram merge ----------------------------------------------------
+
+
+def test_histogram_merge_commutative_and_ladder_checked():
+    h1, h2, h3 = (Histogram(LATENCY_BUCKETS) for _ in range(3))
+    for v in (0.001, 0.1, 4.0):
+        h1.observe(v)
+    for v in (0.002, 0.3):
+        h2.observe(v, count=2)
+    h3.observe(250.0)  # +Inf bucket
+    maps = [
+        {"wave_sec": h1.snapshot(), "host_journal_sec": h3.snapshot()},
+        {"wave_sec": h2.snapshot()},
+    ]
+    ab = merge_histogram_snapshots(*maps)
+    ba = merge_histogram_snapshots(*reversed(maps))
+    assert ab == ba  # commutative: fleet view independent of worker order
+    assert ab["wave_sec"]["count"] == 7
+    assert ab["wave_sec"]["sum"] == pytest.approx(
+        0.001 + 0.1 + 4.0 + 2 * 0.002 + 2 * 0.3
+    )
+    assert ab["host_journal_sec"]["count"] == 1
+    # Differing ladders must fail loudly, not misbin.
+    other = Histogram((1.0, 2.0))
+    other.observe(1.5)
+    with pytest.raises(ValueError):
+        merge_histogram_snapshots(
+            {"wave_sec": h1.snapshot()}, {"wave_sec": other.snapshot()}
+        )
+
+
+# --- watch / report surfaces --------------------------------------------------
+
+
+def _run_events(host_sec):
+    evs = []
+    for q in range(4):
+        t = 100.0 + q
+        evs.append({
+            "t": t, "event": "wave", "waves": 8 * (q + 1),
+            "unique": 100 * (q + 1), "depth": q + 1, "call_sec": 0.5,
+        })
+        evs.append({
+            "t": t + host_sec, "event": SPAN_EVENT, "quantum": q + 1,
+            "worker": "1@test", "host_sec": host_sec,
+            "spans": {"journal": [0.0, host_sec / 2],
+                      "other": [host_sec / 2, host_sec / 2]},
+        })
+    return evs
+
+
+def test_watch_host_share_and_badge():
+    from stateright_tpu.obs.watch import render_line, summarize_events
+
+    s = summarize_events(_run_events(0.1))
+    assert s["host_share"] == pytest.approx(0.1 / 0.6, abs=1e-3)
+    assert not any("host-share" in w for w in s["warnings"])
+    assert "host_share=" in render_line(s)
+
+    # A host-dominated loop (> 0.5) raises the ⚠ badge.
+    s = summarize_events(_run_events(1.5))
+    assert s["host_share"] > 0.5
+    assert any("host-share" in w for w in s["warnings"])
+
+
+def test_report_host_share_and_tail_breakdown():
+    from stateright_tpu.obs.report import analyze_journal
+
+    report = analyze_journal(_run_events(0.1))
+    assert report["kind"] == "run"
+    assert report["host_share"] == pytest.approx(0.1 / 0.6, abs=1e-3)
+    assert report["host_tail_breakdown"]["journal"] == pytest.approx(
+        0.2, abs=1e-6
+    )
+
+
+def test_trajectory_table_has_host_share_column(tmp_path):
+    from stateright_tpu.obs.report import (
+        bench_trajectory,
+        render_trajectory_markdown,
+    )
+
+    p = tmp_path / "BENCH_r19.json"
+    p.write_text(json.dumps({
+        "rc": 0,
+        "parsed": {"metric": "m", "value": 10.0, "host_share": 0.07},
+    }))
+    traj = bench_trajectory([str(p)])
+    assert traj["rounds"][0]["host_share"] == 0.07
+    md = render_trajectory_markdown(traj)
+    header = next(l for l in md.splitlines() if l.startswith("| round"))
+    assert "host share" in header
+    row = next(l for l in md.splitlines() if "| BENCH_r19 |" in l)
+    assert (
+        row.count("|") == header.count("|")
+    ), "host_share cell must keep the row aligned with the header"
+    assert " 0.07 |" in row
+
+
+def test_report_timeline_out_flag(tmp_path, capsys):
+    from stateright_tpu.obs.report import report_main
+
+    journal = _write_journal(tmp_path / "journal.jsonl", _run_events(0.1))
+    out = str(tmp_path / "run.trace.json")
+    rc = report_main([journal, "--timeline-out", out, "--json"])
+    assert rc == 0
+    with open(out, "r", encoding="utf-8") as fh:
+        assert validate_trace(json.load(fh)) == []
+
+
+def test_build_trace_wave_breakdown_children_nest():
+    evs = [{
+        "t": 10.0, "event": "wave", "call_sec": 1.0, "waves": 4,
+        "wave_breakdown": {"step": 0.4, "dedup": 0.5, "readback": 0.1},
+    }]
+    trace = build_trace(evs)
+    assert validate_trace(trace) == []
+    names = [e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert set(names) >= {"wave", "step", "dedup", "readback"}
